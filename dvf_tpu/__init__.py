@@ -1,0 +1,30 @@
+"""dvf_tpu — a TPU-native distributed video-filter framework.
+
+A brand-new framework with the capabilities of
+kylemcdonald/distributed-video-filter (reference @ /root/reference), re-architected
+TPU-first:
+
+- the reference's per-frame ZMQ task farm (``distributor.py`` fan-out,
+  ``worker.py`` pull loop) becomes a **batching frontend** that stacks frames
+  into device-sharded arrays executed by one traced, jitted program
+  (:mod:`dvf_tpu.runtime`);
+- filter plugins (the reference's ``Worker.__call__`` subclass boundary,
+  worker.py:78-80 / inverter.py:29-46) become pure ``jnp`` frame→frame
+  functions in a registry (:mod:`dvf_tpu.ops`);
+- ordering/drop semantics of the reference's reorder buffer
+  (distributor.py:291-344) live in a sink-side jitter buffer
+  (:mod:`dvf_tpu.sched`);
+- Perfetto frame-lifecycle tracing (distributor.py:63-171) lives in
+  :mod:`dvf_tpu.obs`;
+- host I/O (the reference's ZMQ transport, distributor.py:27-35 /
+  worker.py:17-25) becomes a C++ shared-memory ring plus an optional
+  ZMQ-wire-compatible TCP ingress (:mod:`dvf_tpu.transport`);
+- parallelism moves from "N worker processes" to named mesh axes
+  (``data`` / ``space`` / ``model``) with XLA collectives over ICI
+  (:mod:`dvf_tpu.parallel`).
+"""
+
+__version__ = "0.1.0"
+
+from dvf_tpu.api.filter import Filter, FilterChain  # noqa: F401
+from dvf_tpu.ops import get_filter, list_filters, register_filter  # noqa: F401

@@ -1,0 +1,1 @@
+from dvf_tpu.api.filter import Filter, FilterChain  # noqa: F401

@@ -1,0 +1,104 @@
+"""The Filter protocol — pure batch→batch functions with optional state.
+
+Reference counterpart: the abstract ``Worker.__call__(frame_bytes) -> bytes``
+(worker.py:78-80) that plugins like ``InverterWorker`` implement
+(inverter.py:29-46). Differences, by design:
+
+- **batched**: a filter maps a whole NHWC batch at once, so the device
+  program is one large fused kernel instead of N per-frame Python calls;
+- **pure + traceable**: no codec, no I/O, no Python side effects — the
+  runtime owns staging/codec, the filter owns math. That is what makes the
+  filter jit-able under a mesh;
+- **explicit state**: stateful filters (the optical-flow config's 2-frame
+  temporal window, BASELINE.json configs[3]) carry device-resident state as a
+  pytree threaded through the call, instead of mutable attributes on a worker
+  object. State stays on device across batches — no host round trip and no
+  re-trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+# A filter body maps (batch, state) -> (batch, state). ``state`` is an
+# arbitrary pytree (None for stateless filters).
+FilterFn = Callable[[jnp.ndarray, Any], Tuple[jnp.ndarray, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter:
+    """A named, pure, batched frame filter.
+
+    Attributes:
+      name: registry name (plus config, e.g. ``gaussian_blur(k=9)``).
+      fn: pure ``(batch, state) -> (batch, state)`` function over float
+        NHWC batches in [0, 1].
+      init_state: optional ``(batch_shape, dtype) -> pytree`` building the
+        initial device state (e.g. the previous-frame window for flow).
+      compute_dtype: dtype the runtime should cast uint8 frames to before
+        calling ``fn``. bfloat16 keeps HBM traffic halved and feeds the MXU
+        natively; pointwise filters may prefer uint8 passthrough.
+      uint8_ok: if True, ``fn`` can consume uint8 NHWC batches directly
+        (e.g. invert = 255 - x) and the runtime skips the float round trip.
+    """
+
+    name: str
+    fn: FilterFn
+    init_state: Optional[Callable[[Sequence[int], Any], Any]] = None
+    compute_dtype: Any = jnp.float32
+    uint8_ok: bool = False
+
+    @property
+    def stateful(self) -> bool:
+        return self.init_state is not None
+
+    def __call__(self, batch: jnp.ndarray, state: Any = None) -> Tuple[jnp.ndarray, Any]:
+        return self.fn(batch, state)
+
+
+def stateless(name: str, fn: Callable[[jnp.ndarray], jnp.ndarray], **kw) -> Filter:
+    """Wrap a plain ``batch -> batch`` function as a stateless Filter."""
+
+    def wrapped(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+        return fn(batch), state
+
+    return Filter(name=name, fn=wrapped, **kw)
+
+
+def FilterChain(*filters: Filter, name: Optional[str] = None) -> Filter:
+    """Compose filters left-to-right into one Filter.
+
+    The composed body stays a single traced function, so XLA fuses the whole
+    chain into one device program — the TPU analog of the reference's
+    "chain of workers" being one process pipeline. State is a tuple of the
+    member states.
+    """
+    chain_name = name or "|".join(f.name for f in filters)
+    stateful_members = [f.stateful for f in filters]
+
+    def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+        state = state if state is not None else tuple(None for _ in filters)
+        new_states = []
+        for f, s in zip(filters, state):
+            batch, s2 = f.fn(batch, s)
+            new_states.append(s2)
+        return batch, tuple(new_states)
+
+    init_state = None
+    if any(stateful_members):
+        def init_state(batch_shape, dtype):  # noqa: F811
+            return tuple(
+                f.init_state(batch_shape, dtype) if f.stateful else None
+                for f in filters
+            )
+
+    return Filter(
+        name=chain_name,
+        fn=fn,
+        init_state=init_state,
+        compute_dtype=filters[0].compute_dtype if filters else jnp.float32,
+        uint8_ok=all(f.uint8_ok for f in filters) if filters else False,
+    )

@@ -1,0 +1,2 @@
+from dvf_tpu.io.sources import SyntheticSource, VideoFileSource, WebcamSource  # noqa: F401
+from dvf_tpu.io.sinks import CallbackSink, NullSink  # noqa: F401

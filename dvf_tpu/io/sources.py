@@ -1,0 +1,136 @@
+"""Frame sources.
+
+The reference's only source is a webcam capture thread
+(webcam_app.py:67-116: cv2.VideoCapture at 1280x720@30, center-crop,
+BGR→RGB). The framework generalizes the source into an iterator protocol and
+adds the two SURVEY.md §4 test affordances the reference lacks: a synthetic
+source (no camera) for benchmarks/integration tests and a file source.
+
+A source yields ``(frame_u8, timestamp)``; ``None`` frame = end of stream.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+Frame = Tuple[Optional[np.ndarray], float]
+
+
+class SyntheticSource:
+    """Procedural moving-gradient frames — deterministic, camera-free.
+
+    ``rate``: target frames/sec; 0 = unthrottled (benchmark mode, the
+    analog of measuring pure pipeline capacity rather than the reference's
+    30fps camera ceiling, webcam_app.py:14).
+    """
+
+    def __init__(
+        self,
+        height: int = 1080,
+        width: int = 1920,
+        channels: int = 3,
+        n_frames: int = 300,
+        rate: float = 0.0,
+        seed: int = 0,
+        motion: bool = True,
+    ):
+        self.height, self.width, self.channels = height, width, channels
+        self.n_frames = n_frames
+        self.rate = rate
+        self.motion = motion
+        rng = np.random.default_rng(seed)
+        # One textured base frame; per-frame variation is a cheap roll +
+        # brightness ramp so generation never bottlenecks the pipeline.
+        base = rng.integers(0, 255, size=(height, width, channels), dtype=np.uint8)
+        ramp = np.linspace(0, 255, width, dtype=np.uint8)[None, :, None]
+        self._base = (base // 2 + ramp // 2).astype(np.uint8)
+
+    def __iter__(self) -> Iterator[Frame]:
+        period = 1.0 / self.rate if self.rate > 0 else 0.0
+        next_t = time.perf_counter()
+        for i in range(self.n_frames):
+            if period:
+                now = time.perf_counter()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                next_t += period
+            frame = np.roll(self._base, (i * 2) % self.width, axis=1) if self.motion else self._base
+            yield frame, time.time()
+        yield None, time.time()
+
+
+class VideoFileSource:
+    """Decode a video file via cv2 (RGB uint8)."""
+
+    def __init__(self, path: str, loop: bool = False, rate: float = 0.0):
+        self.path = path
+        self.loop = loop
+        self.rate = rate
+
+    def __iter__(self) -> Iterator[Frame]:
+        import cv2
+
+        period = 1.0 / self.rate if self.rate > 0 else 0.0
+        next_t = time.perf_counter()
+        while True:
+            cap = cv2.VideoCapture(self.path)
+            ok, frame = cap.read()
+            while ok:
+                if period:
+                    now = time.perf_counter()
+                    if now < next_t:
+                        time.sleep(next_t - now)
+                    next_t += period
+                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), time.time()
+                ok, frame = cap.read()
+            cap.release()
+            if not self.loop:
+                break
+        yield None, time.time()
+
+
+class WebcamSource:
+    """Live webcam capture — the reference's source (webcam_app.py:67-116).
+
+    Same settings: 1280x720@30 with a 1-frame driver buffer to minimize
+    latency (webcam_app.py:69-75), optional center-crop to ``target_size``²
+    (webcam_app.py:97-101), BGR→RGB (webcam_app.py:102).
+    """
+
+    def __init__(
+        self,
+        device: int = 0,
+        capture_size: Tuple[int, int] = (1280, 720),
+        fps: int = 30,
+        target_size: Optional[int] = 512,
+    ):
+        self.device = device
+        self.capture_size = capture_size
+        self.fps = fps
+        self.target_size = target_size
+
+    def __iter__(self) -> Iterator[Frame]:
+        import cv2
+
+        cap = cv2.VideoCapture(self.device)
+        cap.set(cv2.CAP_PROP_FRAME_WIDTH, self.capture_size[0])
+        cap.set(cv2.CAP_PROP_FRAME_HEIGHT, self.capture_size[1])
+        cap.set(cv2.CAP_PROP_FPS, self.fps)
+        cap.set(cv2.CAP_PROP_BUFFERSIZE, 1)
+        try:
+            while True:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                if self.target_size:
+                    h, w = frame.shape[:2]
+                    s = self.target_size
+                    top, left = (h - s) // 2, (w - s) // 2
+                    frame = frame[top : top + s, left : left + s]
+                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), time.time()
+        finally:
+            cap.release()
+        yield None, time.time()

@@ -1,0 +1,2 @@
+from dvf_tpu.obs.trace import Tracer  # noqa: F401
+from dvf_tpu.obs.metrics import LatencyStats  # noqa: F401

@@ -1,0 +1,111 @@
+"""Perfetto / Chrome-trace-event frame-lifecycle tracing.
+
+Port of the reference's opt-in trace subsystem (distributor.py:63-171):
+instant events at capture ('i', "frame_captured", distributor.py:63-73),
+complete events ('X') spanning processing with a *track id* mapped to the
+trace ``pid`` field so each executor gets its own lane (the reference uses
+the worker's OS pid, distributor.py:75-88,129; here tracks are pipeline
+stages / device ids, since workers are no longer processes). Timestamps are
+µs relative to trace start (distributor.py:40,118-127). The output opens in
+ui.perfetto.dev alongside `jax.profiler` device traces.
+
+Event names follow the frame lifecycle through this framework:
+frame_captured → batch_assembled → device_dispatch → batch_complete →
+frame_delivered.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Tracer:
+    def __init__(self, enabled: bool = False, process_name: str = "dvf_tpu"):
+        self.enabled = enabled
+        self.process_name = process_name
+        self.start_time = time.time()
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def _us(self, t: float) -> int:
+        return int((t - self.start_time) * 1e6)
+
+    def instant(self, name: str, ts: Optional[float] = None, track: int = 0, **args) -> None:
+        """'i' event — e.g. frame_captured at enqueue (distributor.py:63-73)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "i",
+            "ts": self._us(ts if ts is not None else time.time()),
+            "pid": track,
+            "tid": 0,
+            "s": "g",
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def complete(self, name: str, t0: float, t1: float, track: int = 0, **args) -> None:
+        """'X' event spanning [t0, t1] (distributor.py:75-88)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": self._us(t0),
+            "dur": max(0, int((t1 - t0) * 1e6)),
+            "pid": track,
+            "tid": 0,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    # ------------------------------------------------------------------
+
+    def export(self, path: str = "dvf_frame_timing.pftrace") -> Optional[str]:
+        """Write Chrome-trace JSON (the reference hand-serializes the same
+        format to webcam_frame_timing.pftrace, distributor.py:90-148)."""
+        if not self.enabled or not self._events:
+            return None
+        with self._lock:
+            events = list(self._events)
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"{self.process_name}/{pid}" if pid else self.process_name},
+            }
+            for pid in sorted({e["pid"] for e in events})
+        ]
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def summarize(self) -> Dict[str, float]:
+        """FPS statistics from the trace, like distributor.py:152-171."""
+        with self._lock:
+            events = list(self._events)
+        out: Dict[str, float] = {}
+        captures = sorted(e["ts"] for e in events if e["name"] == "frame_captured")
+        if len(captures) > 1:
+            ivals = [b - a for a, b in zip(captures, captures[1:])]
+            mean_us = sum(ivals) / len(ivals)
+            if mean_us > 0:
+                out["capture_fps"] = 1e6 / mean_us
+        durs = [e["dur"] for e in events if e["ph"] == "X" and e.get("dur", 0) > 0]
+        if durs:
+            out["mean_process_ms"] = sum(durs) / len(durs) / 1e3
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)

@@ -1,0 +1,62 @@
+"""Bilateral filter — edge-preserving smoothing (BASELINE.json configs[2]).
+
+Not present in the reference (its only op is invert, inverter.py:41); required
+by the Sobel+bilateral 1080p batch=16 north-star config.
+
+TPU mapping: the d×d window is unrolled at trace time into shifted-view
+elementwise work (25 shifts for d=5) — pure VPU math that XLA fuses into a
+single pass over HBM; no gathers, no data-dependent shapes. The range kernel
+uses Euclidean color distance like cv2.bilateralFilter. A Pallas version that
+tiles the image through VMEM and fuses the Sobel chain lives in
+:mod:`dvf_tpu.ops.pallas_kernels`; this module is the reference jnp path and
+the numerics golden for it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from dvf_tpu.api.filter import Filter, stateless
+from dvf_tpu.ops.registry import register_filter
+
+
+def bilateral_nhwc(
+    batch: jnp.ndarray,
+    d: int = 5,
+    sigma_color: float = 0.1,
+    sigma_space: float = 2.0,
+) -> jnp.ndarray:
+    """Bilateral filter over float NHWC in [0,1].
+
+    ``sigma_color`` is in [0,1] intensity units (cv2 uses [0,255] units; scale
+    by 255 to compare).
+    """
+    if d % 2 != 1:
+        raise ValueError(f"window d must be odd, got {d}")
+    r = d // 2
+    h, w = batch.shape[1], batch.shape[2]
+    pad = jnp.pad(batch, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
+
+    inv2sc = 1.0 / (2.0 * sigma_color * sigma_color)
+    num = jnp.zeros_like(batch)
+    den = jnp.zeros(batch.shape[:-1] + (1,), dtype=batch.dtype)
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            sw = math.exp(-(dy * dy + dx * dx) / (2.0 * sigma_space * sigma_space))
+            shifted = pad[:, r + dy : r + dy + h, r + dx : r + dx + w, :]
+            diff = shifted - batch
+            dist2 = jnp.sum(diff * diff, axis=-1, keepdims=True)
+            wgt = sw * jnp.exp(-dist2 * inv2sc)
+            num = num + wgt * shifted
+            den = den + wgt
+    return num / den
+
+
+@register_filter("bilateral")
+def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0) -> Filter:
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        return bilateral_nhwc(batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space)
+
+    return stateless(f"bilateral(d={d},sc={sigma_color},ss={sigma_space})", fn)

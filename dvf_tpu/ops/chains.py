@@ -1,0 +1,38 @@
+"""Prebuilt filter chains for the benchmark configs.
+
+``sobel_bilateral`` is BASELINE.json configs[2] ("Sobel-edge + bilateral
+filter chain, 1080p, batch=16"). Because a FilterChain is one traced
+function, XLA fuses the whole chain into a single device program — there is
+no inter-op host hop, unlike the reference where chaining ops would mean
+chaining worker processes over ZMQ.
+"""
+
+from __future__ import annotations
+
+from dvf_tpu.api.filter import Filter, FilterChain
+from dvf_tpu.ops.registry import get_filter, register_filter
+
+
+@register_filter("sobel_bilateral")
+def sobel_bilateral(
+    d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0,
+    magnitude_scale: float = 1.0,
+) -> Filter:
+    return FilterChain(
+        get_filter("sobel", magnitude_scale=magnitude_scale),
+        get_filter("bilateral", d=d, sigma_color=sigma_color, sigma_space=sigma_space),
+        name=f"sobel_bilateral(d={d})",
+    )
+
+
+@register_filter("chain")
+def chain(specs=()) -> Filter:
+    """Generic chain from a list of (name, config) pairs or names."""
+    members = []
+    for spec in specs:
+        if isinstance(spec, str):
+            members.append(get_filter(spec))
+        else:
+            name, cfg = spec
+            members.append(get_filter(name, **cfg))
+    return FilterChain(*members)

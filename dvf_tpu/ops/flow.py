@@ -1,0 +1,340 @@
+"""Dense optical flow via Farneback polynomial expansion + flow-warp filter.
+
+Covers BASELINE.json configs[3]: "Farneback optical-flow warp filter, 720p,
+2-frame temporal window". The reference has no temporal ops (every frame is
+independent, worker.py:57); this is the one *stateful* filter family, and it
+drives the framework's device-resident-state design
+(:class:`dvf_tpu.api.filter.Filter.init_state`).
+
+Algorithm (G. Farneback, "Two-frame motion estimation based on polynomial
+expansion", SCIA 2003 — same algorithm as cv2.calcOpticalFlowFarneback):
+
+1. Each gray frame is locally approximated as a quadratic polynomial
+   ``f(x) ≈ xᵀAx + bᵀx + c`` by weighted least squares under a Gaussian
+   applicability window. With a separable Gaussian weight, the six moment
+   images are six **separable cross-correlations** — exactly what XLA's
+   depthwise convs tile well on TPU; the 6×6 normal-equation inverse is a
+   compile-time constant.
+2. Displacement: A = ½(A1 + A2(x+d)), Δb = −½(b2(x+d) − b1) + A d, then the
+   per-pixel 2×2 system is averaged over a Gaussian neighborhood
+   (more separable convs) and solved in closed form.
+3. Coarse-to-fine pyramid with iterative warping (bilinear gather).
+
+Everything is static-shaped, elementwise + depthwise-conv work: no Python
+control flow under jit (pyramid levels unroll at trace time).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.ops.conv import sep_conv2d, gaussian_kernel_1d
+from dvf_tpu.ops.registry import register_filter
+from dvf_tpu.utils.image import rgb_to_gray
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling (the warp primitive)
+# ---------------------------------------------------------------------------
+
+def bilinear_sample(img: jnp.ndarray, ys: jnp.ndarray, xs: jnp.ndarray) -> jnp.ndarray:
+    """Sample ``img`` (B,H,W,C) at float coords ``ys``/``xs`` (B,H,W).
+
+    Out-of-range coordinates clamp to the border (cv2 BORDER_REPLICATE
+    behavior). Implemented as four flat gathers so XLA lowers to efficient
+    dynamic-gather on TPU.
+    """
+    b, h, w, c = img.shape
+    qshape = ys.shape  # (B, qh, qw) — query grid may differ from img size
+    ys = jnp.clip(ys, 0.0, h - 1.0)
+    xs = jnp.clip(xs, 0.0, w - 1.0)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = (ys - y0)[..., None]
+    wx = (xs - x0)[..., None]
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+    y1i = jnp.minimum(y0i + 1, h - 1)
+    x1i = jnp.minimum(x0i + 1, w - 1)
+
+    flat = img.reshape(b, h * w, c)
+    nq = qshape[1] * qshape[2]
+
+    def gather(yi, xi):
+        idx = (yi * w + xi).reshape(b, nq, 1)
+        return jnp.take_along_axis(flat, idx, axis=1).reshape(qshape + (c,))
+
+    v00 = gather(y0i, x0i)
+    v01 = gather(y0i, x1i)
+    v10 = gather(y1i, x0i)
+    v11 = gather(y1i, x1i)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def warp_by_flow(img: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
+    """Backward-warp ``img`` by ``flow`` (B,H,W,2; flow[...,0]=dx, [...,1]=dy).
+
+    Returns out(x) = img(x + flow(x)) — the standard cv2.remap convention for
+    Farneback flow (flow maps frame1 coords to frame2 positions).
+    """
+    b, h, w, _ = img.shape
+    gy = lax.broadcasted_iota(jnp.float32, (b, h, w), 1)
+    gx = lax.broadcasted_iota(jnp.float32, (b, h, w), 2)
+    return bilinear_sample(img, gy + flow[..., 1], gx + flow[..., 0])
+
+
+# ---------------------------------------------------------------------------
+# polynomial expansion
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _poly_exp_setup(n: int, sigma: float):
+    """Precompute (numpy, trace-time) the 1-D moment kernels and the 6x6
+    normal-equation inverse for basis [1, x, y, x², y², xy]."""
+    xs = np.arange(-n, n + 1, dtype=np.float64)
+    g = np.exp(-(xs ** 2) / (2.0 * sigma * sigma))
+    g /= g.sum()
+    # 1-D moment kernels (correlation kernels, not flipped — XLA convs are
+    # cross-correlations, matching).
+    k0, k1, k2 = g, xs * g, (xs ** 2) * g
+
+    # G[i,j] = sum_{x,y} w(x,y) b_i(x,y) b_j(x,y), b = [1, x, y, x^2, y^2, xy]
+    X, Y = np.meshgrid(xs, xs, indexing="xy")
+    wgt = np.outer(g, g)  # rows=y, cols=x
+    basis = [np.ones_like(X), X, Y, X ** 2, Y ** 2, X * Y]
+    G = np.zeros((6, 6))
+    for i in range(6):
+        for j in range(6):
+            G[i, j] = np.sum(wgt * basis[i] * basis[j])
+    Ginv = np.linalg.inv(G)
+    # Return numpy (not jnp): this function is lru_cached, and jnp arrays
+    # materialized inside a jit trace must not outlive it.
+    return (
+        np.asarray(k0, np.float32),
+        np.asarray(k1, np.float32),
+        np.asarray(k2, np.float32),
+        np.asarray(Ginv, np.float32),
+    )
+
+
+def poly_expansion(gray: jnp.ndarray, n: int = 5, sigma: float = 1.1):
+    """Quadratic polynomial coefficients per pixel.
+
+    Args:
+      gray: (B, H, W, 1) float frames.
+    Returns:
+      (A11, A12, A22, b1, b2): each (B, H, W, 1). A is the symmetric quadratic
+      form matrix, b the linear term, in (x, y) = (col, row) coordinates.
+    """
+    k0, k1, k2, Ginv = _poly_exp_setup(n, float(sigma))
+    # v_i = correlation of f with w * b_i; separable into row (x) and col (y)
+    # factors: b=1 -> k0⊗k0 ; x -> k0(y)k1(x) ; y -> k1(y)k0(x);
+    # x² -> k0(y)k2(x) ; y² -> k2(y)k0(x) ; xy -> k1(y)k1(x).
+    v1 = sep_conv2d(gray, k0, k0)
+    vx = sep_conv2d(gray, k0, k1)
+    vy = sep_conv2d(gray, k1, k0)
+    vxx = sep_conv2d(gray, k0, k2)
+    vyy = sep_conv2d(gray, k2, k0)
+    vxy = sep_conv2d(gray, k1, k1)
+    v = jnp.stack([v1, vx, vy, vxx, vyy, vxy], axis=-1)  # (B,H,W,1,6)
+    r = jnp.einsum("...i,ji->...j", v, Ginv)  # coeffs [c, bx, by, axx, ayy, axy]
+    b1 = r[..., 1]
+    b2 = r[..., 2]
+    A11 = r[..., 3]
+    A22 = r[..., 4]
+    A12 = r[..., 5] * 0.5
+    return A11, A12, A22, b1, b2
+
+
+# ---------------------------------------------------------------------------
+# displacement estimation
+# ---------------------------------------------------------------------------
+
+def _flow_level(
+    poly1, poly2, flow: jnp.ndarray, win_kern: jnp.ndarray, n_iters: int
+) -> jnp.ndarray:
+    """Refine ``flow`` at one pyramid level. poly*: stacked (B,H,W,5)."""
+    A11_1, A12_1, A22_1, b1_1, b2_1 = [poly1[..., i : i + 1] for i in range(5)]
+
+    for _ in range(n_iters):
+        poly2w = warp_by_flow(poly2, flow)
+        A11_2, A12_2, A22_2, b1_2, b2_2 = [poly2w[..., i : i + 1] for i in range(5)]
+        A11 = 0.5 * (A11_1 + A11_2)
+        A12 = 0.5 * (A12_1 + A12_2)
+        A22 = 0.5 * (A22_1 + A22_2)
+        fx = flow[..., 0:1]
+        fy = flow[..., 1:2]
+        db1 = -0.5 * (b1_2 - b1_1) + (A11 * fx + A12 * fy)
+        db2 = -0.5 * (b2_2 - b2_1) + (A12 * fx + A22 * fy)
+
+        # Per-pixel normal equations, averaged over the Gaussian window.
+        t11 = A11 * A11 + A12 * A12
+        t12 = A12 * (A11 + A22)
+        t22 = A12 * A12 + A22 * A22
+        h1 = A11 * db1 + A12 * db2
+        h2 = A12 * db1 + A22 * db2
+        stacked = jnp.concatenate([t11, t12, t22, h1, h2], axis=-1)
+        sm = sep_conv2d(stacked, win_kern, win_kern)
+        g11, g12, g22 = sm[..., 0:1], sm[..., 1:2], sm[..., 2:3]
+        s1, s2 = sm[..., 3:4], sm[..., 4:5]
+        # Scale-invariant Tikhonov: image intensities are O(1) but the
+        # structure-tensor entries are O(1e-4), so an absolute clamp would
+        # swamp the true determinant; regularize relative to the trace,
+        # which also damps weak-texture pixels toward zero flow.
+        lam = 1e-3 * (g11 + g22) + 1e-12
+        g11r = g11 + lam
+        g22r = g22 + lam
+        det = g11r * g22r - g12 * g12
+        fx_new = (g22r * s1 - g12 * s2) / det
+        fy_new = (g11r * s2 - g12 * s1) / det
+        flow = jnp.concatenate([fx_new, fy_new], axis=-1)
+    return flow
+
+
+def farneback_flow(
+    prev_gray: jnp.ndarray,
+    curr_gray: jnp.ndarray,
+    levels: int = 3,
+    pyr_scale: float = 0.5,
+    win_size: int = 15,
+    n_iters: int = 3,
+    poly_n: int = 5,
+    poly_sigma: float = 1.1,
+) -> jnp.ndarray:
+    """Dense flow (B,H,W,2) mapping prev -> curr, cv2-convention.
+
+    All shapes/levels are static — the pyramid unrolls at trace time.
+    """
+    b, h, w, _ = prev_gray.shape
+    win_kern = gaussian_kernel_1d(win_size, win_size / 6.0)
+
+    shapes = []
+    for lvl in range(levels):
+        scale = pyr_scale ** lvl
+        shapes.append((max(8, int(round(h * scale))), max(8, int(round(w * scale)))))
+
+    flow = None
+    for lvl in range(levels - 1, -1, -1):
+        lh, lw = shapes[lvl]
+        p = jax.image.resize(prev_gray, (b, lh, lw, 1), method="linear")
+        c = jax.image.resize(curr_gray, (b, lh, lw, 1), method="linear")
+        poly1 = jnp.concatenate(poly_expansion(p, poly_n, poly_sigma), axis=-1)
+        poly2 = jnp.concatenate(poly_expansion(c, poly_n, poly_sigma), axis=-1)
+        if flow is None:
+            flow = jnp.zeros((b, lh, lw, 2), dtype=prev_gray.dtype)
+        else:
+            ph, pw = shapes[lvl + 1]
+            flow = jax.image.resize(flow, (b, lh, lw, 2), method="linear")
+            flow = flow * jnp.asarray([lw / pw, lh / ph], dtype=flow.dtype)
+        flow = _flow_level(poly1, poly2, flow, win_kern, n_iters)
+    return flow
+
+
+# ---------------------------------------------------------------------------
+# filters
+# ---------------------------------------------------------------------------
+
+def _temporal_pairs(batch: jnp.ndarray, state_prev: jnp.ndarray):
+    """prev[i] for each batch element: state carries the last frame of the
+    previous batch, so consecutive batches chain seamlessly."""
+    prev = jnp.concatenate([state_prev[None], batch[:-1]], axis=0)
+    return prev
+
+
+@register_filter("flow_warp")
+def flow_warp(
+    levels: int = 3,
+    win_size: int = 15,
+    n_iters: int = 3,
+    flow_scale: int = 2,
+) -> Filter:
+    """Motion-compensate each previous frame onto the current one.
+
+    Output = prev warped by the prev→curr flow — visually "ghost-free onion
+    skin". State = (last frame of previous batch, initialized flag); the
+    2-frame temporal window of BASELINE.json configs[3] lives on-device.
+    ``flow_scale``: flow is estimated at 1/flow_scale resolution and
+    upsampled (cost dominated by poly expansion at full res otherwise).
+    """
+
+    def init_state(batch_shape: Sequence[int], dtype: Any):
+        _, h, w, c = batch_shape
+        return {
+            "prev": jnp.zeros((h, w, c), dtype=dtype),
+            "initialized": jnp.zeros((), dtype=jnp.bool_),
+        }
+
+    def fn(batch: jnp.ndarray, state) -> Tuple[jnp.ndarray, Any]:
+        bsz, h, w, c = batch.shape
+        prev = _temporal_pairs(batch, state["prev"])
+        pg = rgb_to_gray(prev)
+        cg = rgb_to_gray(batch)
+        if flow_scale > 1:
+            sh, sw = h // flow_scale, w // flow_scale
+            pg = jax.image.resize(pg, (bsz, sh, sw, 1), method="linear")
+            cg = jax.image.resize(cg, (bsz, sh, sw, 1), method="linear")
+        flow = farneback_flow(pg, cg, levels=levels, win_size=win_size, n_iters=n_iters)
+        if flow_scale > 1:
+            flow = jax.image.resize(flow, (bsz, h, w, 2), method="linear") * float(flow_scale)
+        warped = warp_by_flow(prev, flow)
+        # Until the first real previous frame exists, pass the input through.
+        out = jnp.where(state["initialized"], warped, batch)
+        new_state = {
+            "prev": batch[-1],
+            "initialized": jnp.ones((), dtype=jnp.bool_),
+        }
+        return out.astype(batch.dtype), new_state
+
+    return Filter(
+        name=f"flow_warp(levels={levels},win={win_size})",
+        fn=fn,
+        init_state=init_state,
+    )
+
+
+@register_filter("flow_vis")
+def flow_vis(levels: int = 3, win_size: int = 15, n_iters: int = 3, max_mag: float = 8.0) -> Filter:
+    """Visualize prev→curr flow as HSV (hue=direction, value=magnitude)."""
+
+    def init_state(batch_shape: Sequence[int], dtype: Any):
+        _, h, w, c = batch_shape
+        return {
+            "prev": jnp.zeros((h, w, c), dtype=dtype),
+            "initialized": jnp.zeros((), dtype=jnp.bool_),
+        }
+
+    def fn(batch: jnp.ndarray, state) -> Tuple[jnp.ndarray, Any]:
+        prev = _temporal_pairs(batch, state["prev"])
+        flow = farneback_flow(rgb_to_gray(prev), rgb_to_gray(batch),
+                              levels=levels, win_size=win_size, n_iters=n_iters)
+        mag = jnp.sqrt(jnp.sum(flow * flow, axis=-1))
+        ang = jnp.arctan2(flow[..., 1], flow[..., 0])  # [-pi, pi]
+        hue = (ang + jnp.pi) / (2.0 * jnp.pi)          # [0, 1]
+        val = jnp.clip(mag / max_mag, 0.0, 1.0)
+        # HSV -> RGB with S=1.
+        i = jnp.floor(hue * 6.0)
+        f = hue * 6.0 - i
+        p = jnp.zeros_like(val)
+        q = val * (1.0 - f)
+        t = val * f
+        i = i.astype(jnp.int32) % 6
+        r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                       [val, q, p, p, t, val])
+        g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                       [t, val, val, q, p, p])
+        b_ = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                        [p, p, t, val, val, q])
+        out = jnp.stack([r, g, b_], axis=-1)
+        new_state = {"prev": batch[-1], "initialized": jnp.ones((), dtype=jnp.bool_)}
+        return out.astype(batch.dtype), new_state
+
+    return Filter(name="flow_vis", fn=fn, init_state=init_state)

@@ -1,0 +1,52 @@
+"""Filter plugin registry — the framework's operator boundary.
+
+In the reference, the plugin mechanism is *subclassing*: filters subclass
+``Worker`` and implement ``__call__(frame_bytes) -> bytes``
+(worker.py:78-80, inverter.py:9-46), and each plugin runs as its own OS
+process. Here the plugin boundary is a **pure batch→batch jnp function**
+registered by name; the runtime traces it once under ``jit`` over a device
+mesh and reuses the compiled program for every batch — parallelism comes from
+mesh axes, not processes.
+
+A registered factory is ``factory(**config) -> Filter`` (see
+:class:`dvf_tpu.api.filter.Filter`). Factories let one op name cover a config
+family (e.g. ``gaussian_blur(ksize=9, sigma=2.0)``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from dvf_tpu.api.filter import Filter
+
+_REGISTRY: Dict[str, Callable[..., Filter]] = {}
+
+
+def register_filter(name: str) -> Callable[[Callable[..., Filter]], Callable[..., Filter]]:
+    """Decorator: register a filter factory under ``name``.
+
+    Re-registration overwrites (last wins) so applications can shadow builtin
+    filters, the same way a user of the reference would point the CLI at their
+    own ``Worker`` subclass.
+    """
+
+    def deco(factory: Callable[..., Filter]) -> Callable[..., Filter]:
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_filter(name: str, **config) -> Filter:
+    """Instantiate the filter registered under ``name`` with ``config``."""
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no filter named {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(**config)
+
+
+def list_filters() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,7 @@
+from dvf_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    batch_pspec,
+    batch_sharding,
+    make_mesh,
+    replicated,
+)

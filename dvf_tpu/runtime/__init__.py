@@ -1,0 +1,2 @@
+from dvf_tpu.runtime.engine import Engine  # noqa: F401
+from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig  # noqa: F401

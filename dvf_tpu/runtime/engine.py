@@ -1,0 +1,156 @@
+"""Device engine: traced, batched, mesh-sharded filter execution.
+
+This replaces the distributed hot path of the reference end-to-end
+(SURVEY.md §3.3): everything between "ROUTER.send frame to worker" and
+"PULL.recv result" (distributor.py:236-238 → worker.py:35-67 →
+distributor.py:258-264) becomes
+
+    device_put(batch)  →  one jitted sharded program  →  async fetch
+
+Key TPU-first choices:
+- **uint8 on the wire, both directions.** Frames cross host↔device as
+  uint8 NHWC (¼ the bytes of float32 — PCIe/ICI bandwidth is the scarce
+  resource, SURVEY.md §7 hard part 1). The cast to the filter's compute
+  dtype happens on device, fused into the filter program.
+- **Donation.** The input batch and filter state are donated, so steady
+  state allocates nothing.
+- **Async dispatch.** `submit` returns un-materialized `jax.Array`s; JAX's
+  async dispatch pipelines host staging of batch k+1 under device compute
+  of batch k — the double-buffering the reference approximates with
+  threads+queues falls out of the runtime.
+- **Static shapes.** One (batch, H, W, C) signature = one compilation;
+  the assembler pads short batches (`valid` mask) rather than re-tracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+from dvf_tpu.utils.image import to_float, to_uint8
+
+
+@dataclasses.dataclass
+class EngineStats:
+    batches: int = 0
+    frames: int = 0
+    compile_count: int = 0
+
+
+class Engine:
+    """Compiles and runs one filter over one mesh at one batch signature."""
+
+    def __init__(
+        self,
+        filt: Filter,
+        mesh: Optional[Mesh] = None,
+        out_uint8: bool = True,
+    ):
+        self.filter = filt
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.out_uint8 = out_uint8
+        self.stats = EngineStats()
+        self._step = None
+        self._signature: Optional[Tuple] = None
+        self._state: Any = None
+        self._sharding = None  # chosen per batch signature in compile()
+        self._replicated = replicated(self.mesh)
+
+    # ------------------------------------------------------------------
+
+    def _build_step(self, batch_shape, in_dtype):
+        filt = self.filter
+        out_uint8 = self.out_uint8
+
+        def step(batch, state):
+            if batch.dtype == jnp.uint8 and not filt.uint8_ok:
+                x = to_float(batch, filt.compute_dtype)
+            else:
+                x = batch
+            y, new_state = filt.fn(x, state)
+            if out_uint8 and y.dtype != jnp.uint8:
+                y = to_uint8(y)
+            return y, new_state
+
+        # State sharding: replicate (it's small — e.g. one previous frame).
+        state_shardings = jax.tree.map(lambda _: self._replicated, self._state)
+        return jax.jit(
+            step,
+            in_shardings=(self._sharding, state_shardings),
+            out_shardings=(self._sharding, state_shardings),
+            donate_argnums=(0, 1),
+        )
+
+    def compile(self, batch_shape: Tuple[int, ...], dtype=np.uint8) -> None:
+        """Trace + compile for a fixed (B,H,W,C) signature; builds state."""
+        sig = (tuple(batch_shape), np.dtype(dtype))
+        if sig == self._signature:
+            return
+        self._sharding = batch_sharding(self.mesh, batch_shape)
+        def fresh_state():
+            if not self.filter.stateful:
+                return None
+            state_dtype = (
+                self.filter.compute_dtype
+                if np.dtype(dtype) == np.uint8 and not self.filter.uint8_ok
+                else dtype
+            )
+            return jax.device_put(
+                self.filter.init_state(batch_shape, state_dtype), self._replicated
+            )
+
+        self._state = fresh_state()
+        self._step = self._build_step(batch_shape, dtype)
+        self._signature = sig
+        self.stats.compile_count += 1
+        # Warm the compile cache so the first real batch doesn't eat compile
+        # time; the warmup consumes (donates) the state, so rebuild it —
+        # stateful filters must still see a pristine first batch.
+        dummy = jax.device_put(np.zeros(batch_shape, dtype=dtype), self._sharding)
+        out, _ = self._step(dummy, self._state)
+        out.block_until_ready()
+        self._state = fresh_state()
+
+    # ------------------------------------------------------------------
+
+    def submit(self, batch: np.ndarray) -> jax.Array:
+        """Dispatch one batch; returns the (async) on-device result.
+
+        The filter state (if any) is threaded internally across calls —
+        device-resident, never copied to host (SURVEY.md §7 hard part 4).
+        """
+        if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
+            self.compile(batch.shape, batch.dtype)
+        x = jax.device_put(batch, self._sharding)
+        y, self._state = self._step(x, self._state)
+        self.stats.batches += 1
+        self.stats.frames += batch.shape[0]
+        return y
+
+    def run_device_resident(self, batch: jax.Array) -> jax.Array:
+        """Like submit, but input already on device (benchmark inner loop)."""
+        if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
+            self.compile(batch.shape, np.dtype(batch.dtype))
+        y, self._state = self._step(batch, self._state)
+        self.stats.batches += 1
+        self.stats.frames += batch.shape[0]
+        return y
+
+    def reset_state(self) -> None:
+        if self.filter.stateful and self._signature is not None:
+            shape, dtype = self._signature
+            state_dtype = (
+                self.filter.compute_dtype
+                if dtype == np.uint8 and not self.filter.uint8_ok
+                else dtype
+            )
+            self._state = jax.device_put(
+                self.filter.init_state(shape, state_dtype), self._replicated
+            )

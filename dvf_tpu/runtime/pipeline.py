@@ -1,0 +1,229 @@
+"""The end-to-end pipeline: source → batch assembler → device → ordered sink.
+
+Process-topology translation of SURVEY.md §3: the reference's 4 app threads
++ N worker processes collapse into one process with 3 threads around an
+async device queue:
+
+  ingest    — the capture thread (webcam_app.py:67-116): pulls frames from
+              the source, indexes them (distributor.py:179-180), enqueues
+              with drop-oldest backpressure (distributor.py:188-203);
+  dispatch  — replaces the distribute thread + worker pool
+              (distributor.py:205-251 / worker.py:30-76): drains the queue
+              into a fixed-size batch (the batch generalizes the
+              latest-frame slot, distributor.py:214-217), pads it, submits
+              to the Engine; in-flight depth is bounded to cap latency;
+  collect   — replaces the collect thread (distributor.py:253-289): waits
+              for device results in submission order, feeds the reorder
+              buffer, advances the display cursor, emits to the sink.
+
+Ordering inside a batch is free (arrays are ordered); across batches it is
+submission order on one mesh — the reorder buffer only really works when
+results arrive from elastic out-of-order executors (ZMQ ingress mode), but
+it is kept in-path so drop/delay semantics match the reference everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.metrics import LatencyStats
+from dvf_tpu.obs.trace import Tracer
+from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.sched.queues import DropOldestQueue
+from dvf_tpu.sched.reorder import ReorderBuffer
+
+# Trace track ids (the reference maps worker pids to tracks,
+# distributor.py:129; our executors are stages, not processes).
+TRACK_INGEST, TRACK_DEVICE, TRACK_SINK = 0, 1, 2
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    batch_size: int = 8
+    frame_delay: int = 5          # display-cursor lag, reference default (webcam_app.py:17)
+    queue_size: int = 10          # ingest queue bound (distributor.py:11)
+    reorder_capacity: int = 50    # reorder cap (distributor.py:23)
+    max_inflight: int = 4         # batches in flight; bounds latency
+    assemble_timeout_s: float = 0.01   # like the 10ms polls (distributor.py:224)
+    trace: bool = False           # enable_trace_export (distributor.py:9)
+
+
+class Pipeline:
+    def __init__(
+        self,
+        source: Any,
+        filt: Filter,
+        sink: Any,
+        config: Optional[PipelineConfig] = None,
+        engine: Optional[Engine] = None,
+    ):
+        self.source = source
+        self.sink = sink
+        self.config = config or PipelineConfig()
+        self.engine = engine or Engine(filt)
+        self.tracer = Tracer(enabled=self.config.trace)
+        self.queue = DropOldestQueue(maxsize=self.config.queue_size)
+        self.reorder = ReorderBuffer(
+            frame_delay=self.config.frame_delay,
+            capacity=self.config.reorder_capacity,
+        )
+        self.latency = LatencyStats()
+        self.frame_counter = 0
+        self._inflight: "DropOldestQueue" = DropOldestQueue(maxsize=1_000_000)
+        self._inflight_sem = threading.Semaphore(self.config.max_inflight)
+        self._eof = threading.Event()
+        self._dispatch_done = threading.Event()
+        self._abort = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------
+
+    def _ingest(self) -> None:
+        try:
+            for frame, ts in self.source:
+                if frame is None:
+                    break
+                idx = self.frame_counter
+                self.frame_counter += 1
+                self.queue.put((idx, frame, ts))
+                self.tracer.instant("frame_captured", ts, TRACK_INGEST, frame=idx)
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+        finally:
+            self._eof.set()
+
+    def _fail(self, e: BaseException) -> None:
+        if self._error is None:
+            self._error = e
+        self._abort.set()
+
+    def _assemble(self) -> Optional[list]:
+        """Collect up to batch_size fresh frames; None = stream finished.
+
+        FIFO consumption; drop-oldest freshness is enforced at the queue
+        bound (put side), matching the reference (distributor.py:193-203).
+        """
+        b = self.config.batch_size
+        items: list = self.queue.pop_up_to(b)
+        deadline = None  # started at first frame, not at call time —
+        # otherwise any source slower than the timeout per frame would
+        # degenerate every batch to size 1.
+        while len(items) < b and not self._abort.is_set():
+            if items:
+                if deadline is None:
+                    deadline = time.perf_counter() + self.config.assemble_timeout_s
+                elif time.perf_counter() > deadline:
+                    break
+            if self._eof.is_set() and len(self.queue) == 0:
+                break
+            got = self.queue.pop_up_to(b - len(items))
+            if got:
+                items.extend(got)
+            else:
+                time.sleep(0.0005)
+        if not items and (self._eof.is_set() or self._abort.is_set()):
+            return None
+        return items
+
+    def _dispatch(self) -> None:
+        try:
+            while not self._abort.is_set():
+                items = self._assemble()
+                if items is None:
+                    break
+                if not items:
+                    continue
+                b = self.config.batch_size
+                valid = len(items)
+                frames = [f for _, f, _ in items]
+                # Pad short batches by repeating the last frame — static
+                # shapes mean one compilation; padded outputs are dropped.
+                while len(frames) < b:
+                    frames.append(frames[-1])
+                batch = np.stack(frames)
+                # Bounded in-flight depth; poll so a dead collect thread
+                # (which stops releasing permits) can't wedge dispatch.
+                while not self._inflight_sem.acquire(timeout=0.1):
+                    if self._abort.is_set():
+                        return
+                t0 = time.time()
+                result = self.engine.submit(batch)
+                meta = [(idx, ts) for idx, _, ts in items]
+                self._inflight.put((meta, valid, result, t0))
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+        finally:
+            self._dispatch_done.set()
+
+    def _collect(self) -> None:
+        try:
+            while not self._abort.is_set():
+                try:
+                    meta, valid, result, t0 = self._inflight.get(timeout=0.05)
+                except TimeoutError:
+                    if self._dispatch_done.is_set() and len(self._inflight) == 0:
+                        break
+                    continue
+                try:
+                    out = np.asarray(result)  # blocks until the device is done
+                finally:
+                    self._inflight_sem.release()
+                t1 = time.time()
+                self.tracer.complete(
+                    "batch_complete", t0, t1, TRACK_DEVICE,
+                    frames=[i for i, _ in meta],
+                )
+                for row, (idx, ts) in enumerate(meta[:valid]):
+                    self.reorder.complete(idx, (out[row], ts))
+                self._deliver()
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+
+    def _deliver(self, flush: bool = False) -> None:
+        if flush:
+            # End of stream: let the cursor catch up to the newest frame so
+            # the tail (< frame_delay deep) still gets delivered.
+            self.reorder.flush()
+        self.reorder.advance()
+        for idx, (frame, ts) in self.reorder.pop_ready():
+            self.latency.record(time.time() - ts)
+            self.tracer.instant("frame_delivered", track=TRACK_SINK, frame=idx)
+            self.sink.emit(idx, frame, ts)
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> dict:
+        """Run to stream end (or Ctrl-C); returns a stats summary."""
+        threads = [
+            threading.Thread(target=self._ingest, name="dvf-ingest", daemon=True),
+            threading.Thread(target=self._dispatch, name="dvf-dispatch", daemon=True),
+            threading.Thread(target=self._collect, name="dvf-collect", daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self._error is not None:
+            raise self._error
+        self._deliver(flush=True)  # drain the trailing frame_delay window
+        self.sink.close()
+        if self.tracer.enabled:
+            self.tracer.export()
+        return self.stats()
+
+    def stats(self) -> dict:
+        """Superset of the reference's get_frame_stats (distributor.py:346-354)."""
+        return {
+            **self.reorder.stats(),
+            "total_frames_produced": self.frame_counter,
+            "dropped_at_ingest": self.queue.dropped,
+            "delivered": self.latency.count,
+            "engine_batches": self.engine.stats.batches,
+            **self.latency.summary(),
+        }

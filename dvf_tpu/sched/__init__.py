@@ -1,0 +1,2 @@
+from dvf_tpu.sched.reorder import ReorderBuffer  # noqa: F401
+from dvf_tpu.sched.queues import DropOldestQueue  # noqa: F401

@@ -1,0 +1,6 @@
+from dvf_tpu.utils.image import (  # noqa: F401
+    center_crop,
+    to_float,
+    to_uint8,
+    rgb_to_gray,
+)

@@ -1,0 +1,48 @@
+"""Image dtype / geometry helpers shared by the filter library.
+
+The canonical on-device frame format is ``float32`` (or ``bfloat16``) NHWC in
+``[0, 1]``; the canonical wire/host format is ``uint8`` HWC — the same dense
+uint8 arrays the reference moves as JPEG-decoded buffers
+(inverter.py:32-34, webcam_app.py:97-110).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Rec.601 luma weights — what cv2.cvtColor(..., COLOR_RGB2GRAY) uses.
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def to_float(frame: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """uint8 [0,255] -> float [0,1]; float inputs pass through as ``dtype``."""
+    if frame.dtype == jnp.uint8:
+        return frame.astype(dtype) * (1.0 / 255.0)
+    return frame.astype(dtype)
+
+
+def to_uint8(frame: jnp.ndarray) -> jnp.ndarray:
+    """float [0,1] -> uint8 [0,255] with round-half-away like cv2 saturate_cast."""
+    if frame.dtype == jnp.uint8:
+        return frame
+    scaled = jnp.clip(frame, 0.0, 1.0) * 255.0
+    return jnp.round(scaled).astype(jnp.uint8)
+
+
+def rgb_to_gray(frame: jnp.ndarray, keepdims: bool = True) -> jnp.ndarray:
+    """Rec.601 grayscale. Accepts (..., H, W, 3) float frames."""
+    r, g, b = frame[..., 0], frame[..., 1], frame[..., 2]
+    gray = _LUMA[0] * r + _LUMA[1] * g + _LUMA[2] * b
+    return gray[..., None] if keepdims else gray
+
+
+def center_crop(frame: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Center-crop (..., H, W, C) to (..., size, size, C).
+
+    Mirrors the reference app's crop of the 1280x720 capture to
+    ``target_size``² (webcam_app.py:97-101).
+    """
+    h, w = frame.shape[-3], frame.shape[-2]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return frame[..., top : top + size, left : left + size, :]

@@ -1,0 +1,52 @@
+"""Test env: run everything on CPU with 8 virtual devices.
+
+Mesh/sharding logic is testable without a TPU by forcing the host platform
+to expose 8 devices (SURVEY.md §4). Must run before jax initializes, hence
+module level in conftest.
+"""
+
+import os
+
+# Force CPU with 8 virtual devices, even when the session env / a PJRT
+# sitecustomize pins jax to a TPU platform — the suite exercises mesh
+# logic without hardware; only bench.py runs on the real chip. The env
+# vars alone are not enough (a sitecustomize may register a platform at
+# interpreter start), so also flip jax.config before any backend client
+# is created. Override with DVF_TEST_PLATFORM to run on an accelerator.
+_platform = os.environ.get("DVF_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+if _platform == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def frame_u8(rng):
+    """A smooth-ish random 64x48 RGB uint8 frame."""
+    base = rng.integers(0, 255, size=(48, 64, 3), dtype=np.uint8)
+    try:
+        import cv2
+
+        return cv2.GaussianBlur(base, (5, 5), 1.5)
+    except ImportError:
+        return base
+
+
+@pytest.fixture
+def batch_f32(rng):
+    """(4, 48, 64, 3) float batch in [0,1]."""
+    return rng.random((4, 48, 64, 3), dtype=np.float32)

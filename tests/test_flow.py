@@ -1,0 +1,96 @@
+"""Tests for Farneback-style optical flow and the flow_warp filter."""
+
+import cv2
+import numpy as np
+import jax.numpy as jnp
+
+from dvf_tpu.ops import get_filter
+from dvf_tpu.ops.flow import bilinear_sample, farneback_flow, warp_by_flow
+
+
+def _textured(rng, h, w):
+    img = rng.random((h, w), dtype=np.float32)
+    return cv2.GaussianBlur(img, (7, 7), 2.0)
+
+
+class TestWarp:
+    def test_identity_flow(self, rng):
+        img = rng.random((2, 16, 24, 3), dtype=np.float32)
+        flow = np.zeros((2, 16, 24, 2), dtype=np.float32)
+        out = warp_by_flow(jnp.asarray(img), jnp.asarray(flow))
+        np.testing.assert_allclose(np.asarray(out), img, atol=1e-6)
+
+    def test_integer_shift(self, rng):
+        img = rng.random((1, 16, 24, 1), dtype=np.float32)
+        flow = np.zeros((1, 16, 24, 2), dtype=np.float32)
+        flow[..., 0] = 3.0  # sample from x+3
+        out = np.asarray(warp_by_flow(jnp.asarray(img), jnp.asarray(flow)))
+        np.testing.assert_allclose(out[0, :, :-3, 0], img[0, :, 3:, 0], atol=1e-6)
+
+    def test_bilinear_midpoint(self):
+        img = np.zeros((1, 4, 4, 1), dtype=np.float32)
+        img[0, 1, 1, 0] = 1.0
+        ys = jnp.full((1, 1, 1), 1.0)
+        xs = jnp.full((1, 1, 1), 1.5)
+        val = bilinear_sample(jnp.asarray(img), ys, xs)
+        assert abs(float(val[0, 0, 0, 0]) - 0.5) < 1e-6
+
+
+class TestFarneback:
+    def test_recovers_translation(self, rng):
+        """curr = roll(prev, -2, x): features move −2 px in x (cv2 convention),
+        so flow ≈ (−2, 0)."""
+        base = _textured(rng, 64, 96)
+        shift = np.roll(base, -2, axis=1)
+        prev = jnp.asarray(base)[None, ..., None]
+        curr = jnp.asarray(shift)[None, ..., None]
+        flow = np.asarray(farneback_flow(prev, curr, levels=3, win_size=15, n_iters=3))
+        inner = flow[0, 16:-16, 16:-16]
+        assert abs(inner[..., 0].mean() - (-2.0)) < 0.5, inner[..., 0].mean()
+        assert abs(inner[..., 1].mean()) < 0.5
+
+    def test_comparable_to_cv2(self, rng):
+        base = _textured(rng, 64, 96)
+        shift = np.roll(np.roll(base, -1, axis=1), -2, axis=0)
+        prev_u8 = (base * 255).astype(np.uint8)
+        curr_u8 = (shift * 255).astype(np.uint8)
+        ref = cv2.calcOpticalFlowFarneback(
+            prev_u8, curr_u8, None, 0.5, 3, 15, 3, 5, 1.1, 0)
+        ours = np.asarray(farneback_flow(
+            jnp.asarray(base)[None, ..., None], jnp.asarray(shift)[None, ..., None],
+            levels=3, win_size=15, n_iters=3))[0]
+        inner = np.s_[16:-16, 16:-16]
+        err = np.linalg.norm(ours[inner] - ref[inner], axis=-1).mean()
+        assert err < 1.0, f"mean EPE vs cv2 = {err}"
+
+    def test_zero_motion(self, rng):
+        base = _textured(rng, 48, 48)
+        g = jnp.asarray(base)[None, ..., None]
+        flow = np.asarray(farneback_flow(g, g, levels=2, win_size=11, n_iters=2))
+        assert np.abs(flow).max() < 0.1
+
+
+class TestFlowWarpFilter:
+    def test_first_batch_passthrough(self, rng):
+        batch = rng.random((3, 32, 32, 3), dtype=np.float32)
+        filt = get_filter("flow_warp", levels=2, win_size=11, n_iters=2, flow_scale=1)
+        state = filt.init_state(batch.shape, jnp.float32)
+        out, state = filt(jnp.asarray(batch), state)
+        np.testing.assert_allclose(np.asarray(out), batch, atol=1e-6)
+        assert bool(state["initialized"])
+        np.testing.assert_allclose(np.asarray(state["prev"]), batch[-1], atol=1e-6)
+
+    def test_static_scene_reproduces_prev(self, rng):
+        """With zero motion, warp(prev) == prev, and prev chains across batches."""
+        frame = cv2.GaussianBlur(rng.random((32, 32, 3), dtype=np.float32), (5, 5), 1.5)
+        batch = np.broadcast_to(frame, (3, 32, 32, 3)).copy()
+        filt = get_filter("flow_warp", levels=2, win_size=11, n_iters=2, flow_scale=1)
+        state = filt.init_state(batch.shape, jnp.float32)
+        _, state = filt(jnp.asarray(batch), state)
+        out2, _ = filt(jnp.asarray(batch), state)
+        np.testing.assert_allclose(np.asarray(out2), batch, atol=0.05)
+
+    def test_stateful_flag(self):
+        filt = get_filter("flow_warp")
+        assert filt.stateful
+        assert not get_filter("invert").stateful
