@@ -1,0 +1,22 @@
+"""Neural filter models.
+
+The reference has no neural models — its one op is ``cv2.bitwise_not``
+(inverter.py:41). The model family here exists for BASELINE.json configs[4]
+("fast neural style-transfer (small VGG encoder), 720p, batch=8"): a
+Johnson-style feed-forward transformer net as the flagship filter, and a
+small VGG encoder providing perceptual (content + style/Gram) features for
+training.
+
+Models are plain functional JAX: ``init(rng, ...) -> params`` pytrees and
+``apply(params, batch) -> batch`` functions, with explicit
+``PartitionSpec`` trees for tensor parallelism over the mesh ``model`` axis
+(:func:`dvf_tpu.models.style_transfer.param_pspecs`).
+"""
+
+from dvf_tpu.models.style_transfer import (  # noqa: F401
+    StyleNetConfig,
+    init_style_net,
+    apply_style_net,
+    param_pspecs,
+)
+from dvf_tpu.models.vgg import VGGConfig, init_vgg, vgg_features  # noqa: F401

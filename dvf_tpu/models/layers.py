@@ -1,0 +1,88 @@
+"""Building-block layers for the neural filter models (plain functional JAX).
+
+Design notes (TPU-first):
+- NHWC layout throughout — XLA's preferred conv layout on TPU; channels last
+  keeps the C dimension on the lane axis for the MXU.
+- Convs compute in bfloat16 by default (MXU-native) with float32 params;
+  instance-norm statistics accumulate in float32 for stability.
+- Params are flat dicts of arrays so tensor-parallel PartitionSpecs can be
+  written per-leaf (see style_transfer.param_pspecs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+Params = Dict[str, Any]
+
+
+def conv_init(rng, ksize: int, cin: int, cout: int, dtype=jnp.float32) -> Params:
+    """He-normal conv weight + zero bias."""
+    wkey, _ = jax.random.split(rng)
+    fan_in = ksize * ksize * cin
+    w = jax.random.normal(wkey, (ksize, ksize, cin, cout), dtype) * jnp.sqrt(2.0 / fan_in)
+    return {"w": w, "b": jnp.zeros((cout,), dtype)}
+
+
+def conv2d_nb(
+    p: Params,
+    x: jnp.ndarray,
+    stride: int = 1,
+    padding: str = "SAME",
+    compute_dtype=jnp.bfloat16,
+    reflect: bool = False,
+) -> jnp.ndarray:
+    """2-D conv WITHOUT the bias add, in ``compute_dtype`` for the MXU.
+
+    The bias is applied by the caller so tensor-parallel forwards can
+    insert a psum between the conv and the bias (row-parallel convs must
+    reduce partial sums first, else the bias is counted once per shard).
+    ``reflect``: reflect-pad to SAME size (style nets; avoids border halos).
+    """
+    if reflect:
+        r = p["w"].shape[0] // 2
+        if r:
+            x = jnp.pad(x, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
+        padding = "VALID"
+    return lax.conv_general_dilated(
+        x.astype(compute_dtype),
+        p["w"].astype(compute_dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=_DN,
+    )
+
+
+def instance_norm_init(c: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def instance_norm(p: Params, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Per-(sample, channel) normalization over H,W; stats in float32."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(1, 2), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2), keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * p["scale"] + p["bias"]
+    return y.astype(x.dtype)
+
+
+def upsample_nearest(x: jnp.ndarray, factor: int = 2) -> jnp.ndarray:
+    """Nearest-neighbor upsample ×factor (resize-conv beats transposed conv
+    for checkerboard artifacts, and maps to cheap reshapes on TPU)."""
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, factor, w, factor, c))
+    return x.reshape(b, h * factor, w * factor, c)
+
+
+def gram_matrix(feats: jnp.ndarray) -> jnp.ndarray:
+    """Batched Gram matrix of NHWC features: (B, C, C) / (H*W*C)."""
+    b, h, w, c = feats.shape
+    f = feats.reshape(b, h * w, c).astype(jnp.float32)
+    return jnp.einsum("bnc,bnd->bcd", f, f) / (h * w * c)

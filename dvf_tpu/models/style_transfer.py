@@ -1,0 +1,188 @@
+"""Fast neural style transfer — the framework's flagship neural filter.
+
+Covers BASELINE.json configs[4] ("fast neural style-transfer (small VGG
+encoder), 720p, batch=8"). Architecture follows the Johnson et al. (2016)
+feed-forward transformer net: 9×9 stem conv → two stride-2 downsampling
+convs → N residual blocks at ¼ resolution → two ×2 resize-convs → 9×9 output
+conv, instance norm + ReLU throughout, scaled-tanh output.
+
+TPU-first choices:
+- all heavy convs run at ¼ spatial resolution in bfloat16 (MXU-native);
+- tensor parallelism is **explicit** (Megatron column/row alternation with
+  hand-placed psums, :func:`param_pspecs` + :func:`tp_inner_apply`), run
+  inside an all-manual shard_map — GSPMD-auto conv partitioning is
+  deliberately avoided (it miscompiles spatial×feature sharded convs on
+  this toolchain; see train.style.make_train_step);
+- resize-conv (nearest upsample + conv) instead of transposed conv: fewer
+  artifacts, and the upsample is a free reshape/broadcast on TPU.
+
+The net is exposed as a registered filter (``style_transfer``) whose params
+ride in the filter *state* pytree, so weights live on device across batches
+instead of being baked into the jitted program as constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dvf_tpu.models.layers import (
+    Params,
+    conv2d_nb,
+    conv_init,
+    instance_norm,
+    instance_norm_init,
+    upsample_nearest,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class StyleNetConfig:
+    base_channels: int = 32          # stem width; doubles at each downsample
+    n_residual: int = 5
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def widths(self):
+        c = self.base_channels
+        return (c, c * 2, c * 4)     # stem, down1, down2/residual trunk
+
+
+def init_style_net(rng: jax.Array, config: StyleNetConfig = StyleNetConfig()) -> Params:
+    c1, c2, c3 = config.widths
+    keys = iter(jax.random.split(rng, 8 + 2 * config.n_residual))
+    p: Dict[str, Params] = {
+        "stem": conv_init(next(keys), 9, 3, c1),
+        "stem_norm": instance_norm_init(c1),
+        "down1": conv_init(next(keys), 3, c1, c2),
+        "down1_norm": instance_norm_init(c2),
+        "down2": conv_init(next(keys), 3, c2, c3),
+        "down2_norm": instance_norm_init(c3),
+    }
+    for i in range(config.n_residual):
+        p[f"res{i}_a"] = conv_init(next(keys), 3, c3, c3)
+        p[f"res{i}_an"] = instance_norm_init(c3)
+        p[f"res{i}_b"] = conv_init(next(keys), 3, c3, c3)
+        p[f"res{i}_bn"] = instance_norm_init(c3)
+    p["up1"] = conv_init(next(keys), 3, c3, c2)
+    p["up1_norm"] = instance_norm_init(c2)
+    p["up2"] = conv_init(next(keys), 3, c2, c1)
+    p["up2_norm"] = instance_norm_init(c1)
+    p["out"] = conv_init(next(keys), 9, c1, 3)
+    return p
+
+
+def apply_style_net(
+    params: Params,
+    batch: jnp.ndarray,
+    config: StyleNetConfig = StyleNetConfig(),
+) -> jnp.ndarray:
+    """Apply the transformer net to a float NHWC batch in [0, 1]
+    (single-shard version; for tensor parallelism use :func:`tp_inner_apply`
+    inside an all-manual shard_map, as train.style.make_train_step does)."""
+    return _forward(params, batch, config, lambda y: y)
+
+
+def _conv_modes(config: StyleNetConfig) -> Dict[str, str]:
+    """Which convs are column- vs row-parallel (see param_pspecs)."""
+    modes = {
+        "stem": "col", "down1": "row", "down2": "col",
+        "up1": "row", "up2": "col", "out": "row",
+    }
+    for i in range(config.n_residual):
+        modes[f"res{i}_a"] = "row"
+        modes[f"res{i}_b"] = "col"
+    return modes
+
+
+def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig, row_reduce) -> jnp.ndarray:
+    """Shared forward body; ``row_reduce`` runs on each row-parallel conv's
+    pre-bias output (identity when unsharded, psum('model') under TP)."""
+    cd = config.compute_dtype
+    modes = _conv_modes(config)
+
+    def cv(name, x, stride=1):
+        p = params[name]
+        y = conv2d_nb(p, x, stride=stride, compute_dtype=cd, reflect=True)
+        if modes[name] == "row":
+            y = row_reduce(y)
+        return y + p["b"].astype(cd)
+
+    def norm_relu(name, y):
+        return jax.nn.relu(instance_norm(params[name], y))
+
+    x = batch.astype(cd)
+    x = norm_relu("stem_norm", cv("stem", x))
+    x = norm_relu("down1_norm", cv("down1", x, stride=2))
+    x = norm_relu("down2_norm", cv("down2", x, stride=2))
+    for i in range(config.n_residual):
+        h = norm_relu(f"res{i}_an", cv(f"res{i}_a", x))
+        h = instance_norm(params[f"res{i}_bn"], cv(f"res{i}_b", h))
+        x = x + h
+    x = upsample_nearest(x, 2)
+    x = norm_relu("up1_norm", cv("up1", x))
+    x = upsample_nearest(x, 2)
+    x = norm_relu("up2_norm", cv("up2", x))
+    x = cv("out", x)
+    y = 0.5 * (jnp.tanh(x.astype(jnp.float32)) + 1.0)
+    return y.astype(batch.dtype)
+
+
+def tp_inner_apply(config: StyleNetConfig) -> Any:
+    """Per-shard apply for use INSIDE an all-manual shard_map region:
+    row-parallel convs reduce with an explicit psum over 'model'. With a
+    size-1 model axis the psum is an identity collective."""
+    return lambda params, batch: _forward(
+        params, batch, config, lambda y: lax.psum(y, "model")
+    )
+
+
+def param_pspecs(config: StyleNetConfig = StyleNetConfig()) -> Dict[str, Any]:
+    """PartitionSpec tree for tensor parallelism over the ``model`` axis.
+
+    Megatron-style alternation: **column-parallel** convs shard output
+    channels (activations leave C-sharded), the following **row-parallel**
+    conv shards input channels (each shard consumes the channels it owns;
+    GSPMD inserts one reduce for the output sum). Collectives therefore
+    appear once per col→row pair instead of per layer. Instance norms
+    normalize over (H, W) per channel, so a norm after a column conv simply
+    shards its scale/bias with the channels; after a row conv it replicates.
+
+    Alternation map (activations C-sharded after stem, down2, res*_b, up2):
+    stem=col → down1=row → down2=col → [res_a=row, res_b=col]* →
+    up1=row → up2=col → out=row.
+    """
+    def col():
+        return {"w": P(None, None, None, "model"), "b": P("model")}
+
+    def row():
+        return {"w": P(None, None, "model", None), "b": P()}
+
+    def norm_spec(sharded: bool):
+        s = P("model") if sharded else P()
+        return {"scale": s, "bias": s}
+
+    specs: Dict[str, Any] = {
+        "stem": col(),
+        "stem_norm": norm_spec(True),
+        "down1": row(),
+        "down1_norm": norm_spec(False),
+        "down2": col(),
+        "down2_norm": norm_spec(True),
+        "up1": row(),
+        "up1_norm": norm_spec(False),
+        "up2": col(),
+        "up2_norm": norm_spec(True),
+        "out": row(),
+    }
+    for i in range(config.n_residual):
+        specs[f"res{i}_a"] = row()
+        specs[f"res{i}_an"] = norm_spec(False)
+        specs[f"res{i}_b"] = col()
+        specs[f"res{i}_bn"] = norm_spec(True)
+    return specs

@@ -1,0 +1,126 @@
+"""Small VGG encoder — perceptual-feature extractor for style training.
+
+BASELINE.json configs[4] names a "small VGG encoder". This is a compact
+VGG-11-style stack (3 blocks, each conv(s)+ReLU then 2×2 avg-pool) exposing
+the per-block feature maps used for content loss and Gram-matrix style loss.
+
+Weights are randomly initialized by default — this environment has zero
+egress, so no pretrained download; random VGG features are a known-adequate
+perceptual metric for training smoke tests, and `init_vgg` accepts an
+existing pytree for users who bring pretrained weights.
+
+Avg-pool (not max) keeps gradients dense, and every conv runs in bfloat16
+on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dvf_tpu.models.layers import Params, conv2d_nb, conv_init
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    # (convs_per_block, channels) per block — a VGG-11 prefix.
+    blocks: Tuple[Tuple[int, int], ...] = ((1, 32), (1, 64), (2, 128))
+    compute_dtype: Any = jnp.bfloat16
+
+
+def init_vgg(rng: jax.Array, config: VGGConfig = VGGConfig()) -> Params:
+    p: Dict[str, Params] = {}
+    cin = 3
+    n_convs = sum(n for n, _ in config.blocks)
+    keys = iter(jax.random.split(rng, n_convs))
+    for bi, (n, c) in enumerate(config.blocks):
+        for ci in range(n):
+            p[f"b{bi}c{ci}"] = conv_init(next(keys), 3, cin, c)
+            cin = c
+    return p
+
+
+def _conv_modes(config: VGGConfig) -> dict:
+    """Alternating column/row parallelism, matching vgg_param_pspecs."""
+    modes = {}
+    col = True
+    for bi, (n, _) in enumerate(config.blocks):
+        for ci in range(n):
+            modes[f"b{bi}c{ci}"] = "col" if col else "row"
+            col = not col
+    return modes
+
+
+def _features(params: Params, batch: jnp.ndarray, config: VGGConfig, row_reduce) -> List[jnp.ndarray]:
+    cd = config.compute_dtype
+    modes = _conv_modes(config)
+    x = batch.astype(cd)
+    feats: List[jnp.ndarray] = []
+    for bi, (n, _) in enumerate(config.blocks):
+        for ci in range(n):
+            p = params[f"b{bi}c{ci}"]
+            y = conv2d_nb(p, x, compute_dtype=cd)
+            if modes[f"b{bi}c{ci}"] == "row":
+                y = row_reduce(y)
+            x = jax.nn.relu(y + p["b"].astype(cd))
+        feats.append(x)
+        x = lax.reduce_window(
+            x, 0.0, lax.add, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        ) * 0.25
+    return feats
+
+
+def vgg_features(
+    params: Params,
+    batch: jnp.ndarray,
+    config: VGGConfig = VGGConfig(),
+) -> List[jnp.ndarray]:
+    """Per-block feature maps (after the block's last ReLU, before pool);
+    single-shard version; for tensor parallelism use :func:`tp_inner_features`
+    inside an all-manual shard_map, as train.style.make_train_step does."""
+    return _features(params, batch, config, lambda y: y)
+
+
+def tp_inner_features(config: VGGConfig):
+    """Per-shard features for use INSIDE an all-manual shard_map region.
+
+    Row-conv outputs reduce with an explicit psum over 'model'. Returned
+    block features that end on a *column* conv are local channel slices —
+    Gram matrices and content MSE need cross-channel products, so those are
+    all-gathered over 'model' (tiled on C) before returning; the trunk keeps
+    computing on local slices. Identity collectives when model is size 1.
+    """
+    modes = _conv_modes(config)
+
+    def fn(params, batch):
+        feats = _features(params, batch, config, lambda y: lax.psum(y, "model"))
+        out = []
+        for bi, (n, _) in enumerate(config.blocks):
+            f = feats[bi]
+            if modes[f"b{bi}c{n - 1}"] == "col":
+                f = lax.all_gather(f, "model", axis=3, tiled=True)
+            out.append(f)
+        return out
+
+    return fn
+
+
+
+
+def vgg_param_pspecs(config: VGGConfig = VGGConfig()):
+    """TP specs for the encoder, derived from the same ``_conv_modes``
+    alternation the forward's psum/all_gather placement uses — a single
+    source of truth so specs can never desync from the collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict[str, Any] = {}
+    for name, mode in _conv_modes(config).items():
+        if mode == "col":
+            specs[name] = {"w": P(None, None, None, "model"), "b": P("model")}
+        else:
+            specs[name] = {"w": P(None, None, "model", None), "b": P()}
+    return specs

@@ -1,0 +1,49 @@
+"""Style-transfer filter op — the neural entry in the filter registry.
+
+Wraps :mod:`dvf_tpu.models.style_transfer` as a registered, *stateful*
+filter: the network params ARE the filter state, so they live on device and
+thread through the engine's jitted step (never baked into the program as
+constants, never copied back to host). The state is returned unchanged each
+batch — inference only; training lives in :mod:`dvf_tpu.train`.
+
+Reference counterpart: none — the reference's only op is invert
+(inverter.py:41); this covers BASELINE.json configs[4].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.models.style_transfer import StyleNetConfig, apply_style_net, init_style_net
+from dvf_tpu.ops.registry import register_filter
+
+
+@register_filter("style_transfer")
+def style_transfer(
+    params: Optional[Any] = None,
+    base_channels: int = 32,
+    n_residual: int = 5,
+    seed: int = 0,
+) -> Filter:
+    """``params=None`` → seeded random init (demo/benchmark weights);
+    pass a trained param pytree for real stylization."""
+    config = StyleNetConfig(base_channels=base_channels, n_residual=n_residual)
+
+    def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+        return apply_style_net(state, batch, config), state
+
+    def init_state(batch_shape, dtype):
+        if params is not None:
+            return params
+        return init_style_net(jax.random.PRNGKey(seed), config)
+
+    return Filter(
+        name=f"style_transfer(c={base_channels},r={n_residual})",
+        fn=fn,
+        init_state=init_state,
+        compute_dtype=jnp.float32,
+    )

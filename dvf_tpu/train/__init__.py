@@ -1,0 +1,16 @@
+"""Training subsystem (style-transfer perceptual training).
+
+The reference is inference-only; training exists here because the flagship
+neural filter (style transfer, BASELINE.json configs[4]) needs trained
+weights. The train step is a single pjit-compiled program over the framework
+mesh: batch data-parallel over ``data``, params tensor-parallel over
+``model``, activations optionally spatially sharded over ``space``.
+"""
+
+from dvf_tpu.train.style import (  # noqa: F401
+    StyleTrainConfig,
+    TrainState,
+    init_train_state,
+    make_train_step,
+    style_loss_fn,
+)
