@@ -8,17 +8,11 @@ Prints ONE JSON line:
 (≥2000 fps, p50 < 10 ms, 1080p invert on a v5e-4). The reference publishes
 no numbers (BASELINE.md); its implied design point is a 30 fps webcam.
 
-Measurement design: the headline number is **device-resident filter
-throughput** through the framework Engine (uint8 NHWC batches, donated
-buffers, state threading) — the path this framework moves onto the TPU.
-A dependent-chain of K batches ends in an on-device checksum whose host
-fetch forces completion, so the timing cannot be fooled by async dispatch
-(block_until_ready is unreliable through tunneled-device transports).
-Host↔device bandwidth is measured separately and reported as diagnostic
-fields; ``--e2e`` instead runs the full streaming pipeline (synthetic
-source → batches → device → ordered sink), which on local hardware is
-transfer-bound and on a tunneled chip measures the tunnel, not the
-framework.
+The headline number is **device-resident filter throughput** through the
+framework Engine — see dvf_tpu/benchmarks.py for the measurement design
+(forced-completion checksums; host transfer reported separately, since a
+tunneled single-chip session would otherwise measure the tunnel, not the
+framework). ``--e2e`` runs the full streaming pipeline instead.
 
 Usage: python bench.py [--iters K] [--batch B] [--e2e] [--frames N]
 """
@@ -28,95 +22,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
-
-
-def bench_device_resident(
-    iters: int, batch_size: int, height: int = 1080, width: int = 1920
-) -> dict:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from dvf_tpu.ops import get_filter
-    from dvf_tpu.runtime.engine import Engine
-
-    shape = (batch_size, height, width, 3)
-    engine = Engine(get_filter("invert"))
-    engine.compile(shape, np.uint8)
-
-    checksum = jax.jit(lambda a: jnp.sum(a, dtype=jnp.int32))
-    rng = np.random.default_rng(0)
-    host_batch = rng.integers(0, 255, size=shape, dtype=np.uint8)
-
-    # Host→device staging bandwidth (diagnostic).
-    t0 = time.perf_counter()
-    batch = jax.device_put(host_batch)
-    batch.block_until_ready()
-    h2d_s = time.perf_counter() - t0
-    h2d_mbps = host_batch.nbytes / 1e6 / h2d_s if h2d_s > 0 else float("inf")
-
-    # Warm the full path incl. the checksum fetch.
-    batch = engine.run_device_resident(batch)
-    _ = np.asarray(checksum(batch))
-
-    # Timed dependent chain; the final checksum fetch forces completion.
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        batch = engine.run_device_resident(batch)
-    _ = np.asarray(checksum(batch))
-    wall = time.perf_counter() - t0
-
-    frames = iters * batch_size
-    fps = frames / wall if wall > 0 else 0.0
-    return {
-        "fps": fps,
-        "frames": frames,
-        "wall_s": wall,
-        "ms_per_batch": wall / iters * 1e3,
-        "ms_per_frame": wall / frames * 1e3,
-        "h2d_mbps": h2d_mbps,
-    }
-
-
-def bench_e2e_streaming(n_frames: int, batch_size: int, height: int, width: int) -> dict:
-    """Full pipeline: synthetic source → assembler → device → ordered sink."""
-    import numpy as np
-
-    from dvf_tpu.io.sinks import NullSink
-    from dvf_tpu.io.sources import SyntheticSource
-    from dvf_tpu.ops import get_filter
-    from dvf_tpu.runtime.engine import Engine
-    from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
-
-    filt = get_filter("invert")
-    engine = Engine(filt)
-    engine.compile((batch_size, height, width, 3), np.uint8)
-    sink = NullSink()
-    pipe = Pipeline(
-        SyntheticSource(height=height, width=width, n_frames=n_frames, rate=0.0),
-        filt,
-        sink,
-        config=PipelineConfig(
-            batch_size=batch_size,
-            queue_size=max(64, 4 * batch_size),
-            frame_delay=0,
-            max_inflight=4,
-        ),
-        engine=engine,
-    )
-    t0 = time.perf_counter()
-    stats = pipe.run()
-    wall = time.perf_counter() - t0
-    pct = sink.latency_percentiles()
-    return {
-        "fps": sink.count / wall if wall > 0 else 0.0,
-        "frames": sink.count,
-        "wall_s": wall,
-        "p50_ms": pct.get("p50", float("nan")),
-        "p99_ms": pct.get("p99", float("nan")),
-        "dropped": stats.get("dropped_at_ingest", 0),
-    }
 
 
 def main(argv=None) -> int:
@@ -129,8 +34,12 @@ def main(argv=None) -> int:
     ap.add_argument("--frames", type=int, default=512, help="frames for --e2e mode")
     args = ap.parse_args(argv)
 
+    from dvf_tpu.benchmarks import bench_device_resident, bench_e2e_streaming
+    from dvf_tpu.ops import get_filter
+
+    filt = get_filter("invert")
     if args.e2e:
-        r = bench_e2e_streaming(args.frames, args.batch, args.height, args.width)
+        r = bench_e2e_streaming(filt, args.frames, args.batch, args.height, args.width)
         result = {
             "metric": "1080p_invert_e2e_fps",
             "value": round(r["fps"], 1),
@@ -142,7 +51,7 @@ def main(argv=None) -> int:
             "wall_s": round(r["wall_s"], 2),
         }
     else:
-        r = bench_device_resident(args.iters, args.batch, args.height, args.width)
+        r = bench_device_resident(filt, args.iters, args.batch, args.height, args.width)
         result = {
             "metric": "1080p_invert_device_fps",
             "value": round(r["fps"], 1),

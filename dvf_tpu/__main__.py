@@ -1,0 +1,3 @@
+from dvf_tpu.cli import main
+
+raise SystemExit(main())

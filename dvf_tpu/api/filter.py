@@ -43,6 +43,10 @@ class Filter:
         natively; pointwise filters may prefer uint8 passthrough.
       uint8_ok: if True, ``fn`` can consume uint8 NHWC batches directly
         (e.g. invert = 255 - x) and the runtime skips the float round trip.
+      halo: stencil radius in pixels — how many neighbor rows/cols one
+        output pixel depends on (0 = pointwise, k//2 for a k-tap conv,
+        None = unknown/unbounded). Spatial sharding (parallel.halo) uses
+        this to size the ring halo exchange.
     """
 
     name: str
@@ -50,6 +54,7 @@ class Filter:
     init_state: Optional[Callable[[Sequence[int], Any], Any]] = None
     compute_dtype: Any = jnp.float32
     uint8_ok: bool = False
+    halo: Optional[int] = None
 
     @property
     def stateful(self) -> bool:
@@ -78,6 +83,9 @@ def FilterChain(*filters: Filter, name: Optional[str] = None) -> Filter:
     """
     chain_name = name or "|".join(f.name for f in filters)
     stateful_members = [f.stateful for f in filters]
+    # Stencil radii compose additively along a chain; unknown taints all.
+    halos = [f.halo for f in filters]
+    chain_halo = sum(halos) if all(h is not None for h in halos) else None
 
     def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
         state = state if state is not None else tuple(None for _ in filters)
@@ -101,4 +109,5 @@ def FilterChain(*filters: Filter, name: Optional[str] = None) -> Filter:
         init_state=init_state,
         compute_dtype=filters[0].compute_dtype if filters else jnp.float32,
         uint8_ok=all(f.uint8_ok for f in filters) if filters else False,
+        halo=chain_halo,
     )

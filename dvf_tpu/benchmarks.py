@@ -1,0 +1,115 @@
+"""Benchmark harnesses shared by the repo-root ``bench.py`` and the CLI.
+
+Two measurement modes:
+
+- **device-resident** — a dependent chain of batches through the Engine
+  (uint8 in/out, donated buffers, state threading) ending in an on-device
+  checksum whose host fetch forces completion. This is the framework's
+  sustained filter throughput, immune to async-dispatch timing lies and to
+  tunneled-transport transfer costs.
+- **e2e streaming** — the full pipeline (synthetic source → batch
+  assembler → device → ordered sink) measuring delivered fps and
+  end-to-end latency percentiles, the metric the reference prints ad hoc
+  (webcam_app.py:88-95,152-163).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dvf_tpu.api.filter import Filter
+
+
+def bench_device_resident(
+    filt: Filter,
+    iters: int,
+    batch_size: int,
+    height: int,
+    width: int,
+    dtype=None,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dvf_tpu.runtime.engine import Engine
+
+    dtype = dtype or np.uint8
+    shape = (batch_size, height, width, 3)
+    engine = Engine(filt)
+    engine.compile(shape, dtype)
+
+    checksum = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+    rng = np.random.default_rng(0)
+    host_batch = rng.integers(0, 255, size=shape, dtype=np.uint8).astype(dtype)
+
+    t0 = time.perf_counter()
+    batch = jax.device_put(host_batch)
+    batch.block_until_ready()
+    h2d_s = time.perf_counter() - t0
+    h2d_mbps = host_batch.nbytes / 1e6 / h2d_s if h2d_s > 0 else float("inf")
+
+    batch = engine.run_device_resident(batch)
+    _ = np.asarray(checksum(batch))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        batch = engine.run_device_resident(batch)
+    _ = np.asarray(checksum(batch))
+    wall = time.perf_counter() - t0
+
+    frames = iters * batch_size
+    return {
+        "fps": frames / wall if wall > 0 else 0.0,
+        "frames": frames,
+        "wall_s": wall,
+        "ms_per_batch": wall / iters * 1e3,
+        "ms_per_frame": wall / frames * 1e3,
+        "h2d_mbps": h2d_mbps,
+    }
+
+
+def bench_e2e_streaming(
+    filt: Filter,
+    n_frames: int,
+    batch_size: int,
+    height: int,
+    width: int,
+    max_inflight: int = 4,
+    queue_size: Optional[int] = None,
+) -> dict:
+    import numpy as np
+
+    from dvf_tpu.io.sinks import NullSink
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.runtime.engine import Engine
+    from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+    engine = Engine(filt)
+    engine.compile((batch_size, height, width, 3), np.uint8)
+    sink = NullSink()
+    pipe = Pipeline(
+        SyntheticSource(height=height, width=width, n_frames=n_frames, rate=0.0),
+        filt,
+        sink,
+        config=PipelineConfig(
+            batch_size=batch_size,
+            queue_size=queue_size if queue_size is not None else max(64, 4 * batch_size),
+            frame_delay=0,
+            max_inflight=max_inflight,
+        ),
+        engine=engine,
+    )
+    t0 = time.perf_counter()
+    stats = pipe.run()
+    wall = time.perf_counter() - t0
+    pct = sink.latency_percentiles()
+    return {
+        "fps": sink.count / wall if wall > 0 else 0.0,
+        "frames": sink.count,
+        "wall_s": wall,
+        "p50_ms": pct.get("p50", float("nan")),
+        "p99_ms": pct.get("p99", float("nan")),
+        "dropped": stats.get("dropped_at_ingest", 0),
+    }

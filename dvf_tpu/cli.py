@@ -1,0 +1,200 @@
+"""Command-line interface.
+
+Reference counterparts: the app CLI (webcam_app.py:187-204: ports,
+frame-delay, target-size, use-jpeg) and the worker CLI (inverter.py:48-61:
+ports, delay). This CLI unifies them and adds what the reference lacks —
+filter selection, benchmark configs, synthetic sources:
+
+  python -m dvf_tpu filters                 # list registered filters
+  python -m dvf_tpu serve  --filter invert  # pipeline: source→TPU→sink
+  python -m dvf_tpu worker --filter invert  # ZMQ worker for the ref app
+  python -m dvf_tpu bench  --config invert_1080p [--e2e]
+
+The ``worker`` subcommand keeps the reference's flag names
+(--distribute-port, --collect-port, --delay) so launch scripts written for
+``python inverter.py`` port over by changing only the module name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+
+# Benchmark configs from BASELINE.json `configs` (+ the headline).
+BENCH_CONFIGS = {
+    "invert_1080p": dict(filter=("invert", {}), h=1080, w=1920, batch=64),
+    "invert_640x480": dict(filter=("invert", {}), h=480, w=640, batch=64),
+    "gauss3_1080p": dict(filter=("gaussian_blur", {"ksize": 3}), h=1080, w=1920, batch=16),
+    "gauss9_1080p": dict(filter=("gaussian_blur", {"ksize": 9}), h=1080, w=1920, batch=16),
+    "sobel_bilateral_1080p": dict(filter=("sobel_bilateral", {}), h=1080, w=1920, batch=16),
+    "flow_720p": dict(filter=("flow_warp", {}), h=720, w=1280, batch=8),
+    "style_720p": dict(
+        filter=("style_transfer", {"base_channels": 32, "n_residual": 5}),
+        h=720, w=1280, batch=8,
+    ),
+}
+
+
+def _parse_filter_arg(name: str, config_json: Optional[str]):
+    from dvf_tpu.ops import get_filter
+
+    cfg = json.loads(config_json) if config_json else {}
+    return get_filter(name, **cfg)
+
+
+def cmd_filters(_args) -> int:
+    from dvf_tpu.ops import list_filters
+
+    for name in list_filters():
+        print(name)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from dvf_tpu.io.sinks import CallbackSink, NullSink
+    from dvf_tpu.io.sources import SyntheticSource, VideoFileSource, WebcamSource
+    from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+    filt = _parse_filter_arg(args.filter, args.filter_config)
+    if args.source == "synthetic":
+        source = SyntheticSource(
+            height=args.height, width=args.width, n_frames=args.frames, rate=args.rate
+        )
+    elif args.source == "webcam":
+        source = WebcamSource(target_size=args.target_size)
+    else:
+        source = VideoFileSource(args.source, rate=args.rate)
+
+    if args.display:
+        import cv2
+
+        def show(idx, frame, ts):
+            cv2.imshow("dvf_tpu", cv2.cvtColor(frame, cv2.COLOR_RGB2BGR))
+            cv2.waitKey(1)
+
+        sink = CallbackSink(show)
+    else:
+        sink = NullSink()
+
+    pipe = Pipeline(
+        source, filt, sink,
+        PipelineConfig(
+            batch_size=args.batch,
+            frame_delay=args.frame_delay,
+            queue_size=args.queue_size,
+            trace=args.trace,
+        ),
+    )
+    stats = pipe.run()
+    print(json.dumps({k: v for k, v in stats.items() if not isinstance(v, dict)}, default=float))
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+    filt = _parse_filter_arg(args.filter, args.filter_config)
+    worker = TpuZmqWorker(
+        filt,
+        host=args.host,
+        distribute_port=args.distribute_port,
+        collect_port=args.collect_port,
+        batch_size=args.batch,
+        use_jpeg=not args.no_jpeg,
+        raw_size=args.target_size,
+    )
+    print(
+        f"TPU worker serving {filt.name} on "
+        f"tcp://{args.host}:{args.distribute_port} → :{args.collect_port}",
+        file=sys.stderr,
+    )
+    try:
+        worker.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        worker.close()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from dvf_tpu.benchmarks import bench_device_resident, bench_e2e_streaming
+    from dvf_tpu.ops import get_filter
+
+    spec = BENCH_CONFIGS[args.config]
+    fname, fcfg = spec["filter"]
+    filt = get_filter(fname, **fcfg)
+    batch = args.batch or spec["batch"]
+    h, w = spec["h"], spec["w"]
+
+    if args.e2e:
+        r = bench_e2e_streaming(filt, args.frames, batch, h, w)
+        out = {
+            "metric": f"{args.config}_e2e_fps",
+            "value": round(r["fps"], 1),
+            "unit": "fps",
+            "p50_ms": round(r["p50_ms"], 3),
+            "p99_ms": round(r["p99_ms"], 3),
+            "frames": r["frames"],
+        }
+    else:
+        r = bench_device_resident(filt, args.iters, batch, h, w)
+        out = {
+            "metric": f"{args.config}_device_fps",
+            "value": round(r["fps"], 1),
+            "unit": "fps",
+            "ms_per_frame": round(r["ms_per_frame"], 4),
+            "batch": batch,
+        }
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dvf_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("filters", help="list registered filters")
+
+    sp = sub.add_parser("serve", help="run the pipeline")
+    sp.add_argument("--filter", default="invert")
+    sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
+    sp.add_argument("--source", default="synthetic", help="synthetic|webcam|<video path>")
+    sp.add_argument("--height", type=int, default=720)
+    sp.add_argument("--width", type=int, default=1280)
+    sp.add_argument("--frames", type=int, default=300)
+    sp.add_argument("--rate", type=float, default=0.0, help="source fps; 0 = unthrottled")
+    sp.add_argument("--batch", type=int, default=8)
+    sp.add_argument("--frame-delay", type=int, default=5)
+    sp.add_argument("--queue-size", type=int, default=10)
+    sp.add_argument("--target-size", type=int, default=512)
+    sp.add_argument("--display", action="store_true")
+    sp.add_argument("--trace", action="store_true", help="export Perfetto trace")
+
+    wp = sub.add_parser("worker", help="ZMQ worker for the reference app")
+    wp.add_argument("--filter", default="invert")
+    wp.add_argument("--filter-config", default=None)
+    wp.add_argument("--host", default="localhost")
+    wp.add_argument("--distribute-port", type=int, default=5555)
+    wp.add_argument("--collect-port", type=int, default=5556)
+    wp.add_argument("--batch", type=int, default=8)
+    wp.add_argument("--no-jpeg", action="store_true")
+    wp.add_argument("--target-size", type=int, default=512)
+
+    bp = sub.add_parser("bench", help="run a benchmark config")
+    bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")
+    bp.add_argument("--iters", type=int, default=200)
+    bp.add_argument("--frames", type=int, default=512, help="--e2e mode")
+    bp.add_argument("--batch", type=int, default=None)
+    bp.add_argument("--e2e", action="store_true")
+
+    args = ap.parse_args(argv)
+    return {"filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker, "bench": cmd_bench}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

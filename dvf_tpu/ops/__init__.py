@@ -14,3 +14,4 @@ from dvf_tpu.ops import bilateral  # noqa: F401,E402
 from dvf_tpu.ops import flow  # noqa: F401,E402
 from dvf_tpu.ops import chains  # noqa: F401,E402
 from dvf_tpu.ops import style  # noqa: F401,E402
+from dvf_tpu.ops import pallas_kernels  # noqa: F401,E402

@@ -59,4 +59,4 @@ def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0) ->
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return bilateral_nhwc(batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space)
 
-    return stateless(f"bilateral(d={d},sc={sigma_color},ss={sigma_space})", fn)
+    return stateless(f"bilateral(d={d},sc={sigma_color},ss={sigma_space})", fn, halo=d // 2)

@@ -81,7 +81,7 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0) -> Filter:
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return _depthwise_sep_conv(batch, kern, kern)
 
-    return stateless(f"gaussian_blur(k={ksize},s={sigma})", fn)
+    return stateless(f"gaussian_blur(k={ksize},s={sigma})", fn, halo=ksize // 2)
 
 
 @register_filter("box_blur")
@@ -91,7 +91,7 @@ def box_blur(ksize: int = 3) -> Filter:
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return _depthwise_sep_conv(batch, kern, kern)
 
-    return stateless(f"box_blur(k={ksize})", fn)
+    return stateless(f"box_blur(k={ksize})", fn, halo=ksize // 2)
 
 
 # Sobel ksize=3 taps, separable: d = [-1, 0, 1], s = [1, 2, 1].
@@ -119,7 +119,7 @@ def sobel(magnitude_scale: float = 1.0, on_gray: bool = True) -> Filter:
             mag = jnp.broadcast_to(mag, batch.shape)
         return mag.astype(batch.dtype)
 
-    return stateless(f"sobel(scale={magnitude_scale})", fn)
+    return stateless(f"sobel(scale={magnitude_scale})", fn, halo=1)
 
 
 @register_filter("sharpen")
@@ -131,4 +131,4 @@ def sharpen(amount: float = 1.0, ksize: int = 5, sigma: float = 1.0) -> Filter:
         blurred = _depthwise_sep_conv(batch, kern, kern)
         return jnp.clip(batch + amount * (batch - blurred), 0.0, 1.0)
 
-    return stateless(f"sharpen(a={amount})", fn)
+    return stateless(f"sharpen(a={amount})", fn, halo=ksize // 2)

@@ -1,0 +1,143 @@
+"""Pallas TPU kernels for the hot stencil ops.
+
+XLA already fuses the unrolled shifted-window bilateral
+(:mod:`dvf_tpu.ops.bilateral`) well; this kernel exists for the cases where
+hand control wins: one HBM pass per tile with all (2r+1)² taps, the
+numerator/denominator accumulators, and the exp() range weights held in
+VMEM/registers — no intermediate HBM traffic at 1080p, where the jnp
+version's 25 shifted views can spill.
+
+Layout choices (see /opt/skills/guides/pallas_guide.md):
+- frames are transposed NHWC→NCHW before the kernel so W (1920 at 1080p)
+  rides the lane axis; C=3 would waste 125/128 lanes;
+- grid = (batch, H tiles); each step DMAs a (C, tile_h + 2r, W + 2r) slab
+  from HBM (kept in ANY space) into a VMEM scratch, computes the tile's
+  core rows, and writes a (C, tile_h, W) output block;
+- all window shifts are static python-int slices — fully unrolled at trace
+  time, no data-dependent control flow;
+- accumulation in float32 regardless of I/O dtype.
+
+The jnp implementation is the numerics golden; tests compare the two in
+interpret mode (CPU) and the benchmark CLI compares wall time on device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dvf_tpu.api.filter import Filter, stateless
+from dvf_tpu.ops.registry import register_filter
+
+
+def _pick_tile_h(h: int, target: int = 16) -> int:
+    """Largest divisor of h that is <= target (grid must tile H exactly)."""
+    for th in range(min(target, h), 0, -1):
+        if h % th == 0:
+            return th
+    return 1
+
+
+def _bilateral_kernel(tile_h: int, r: int, w: int, c: int, sigma_color: float, sigma_space: float):
+    d = 2 * r + 1
+    inv2sc = 1.0 / (2.0 * sigma_color * sigma_color)
+    spatial = [
+        [math.exp(-(dy * dy + dx * dx) / (2.0 * sigma_space * sigma_space))
+         for dx in range(-r, r + 1)]
+        for dy in range(-r, r + 1)
+    ]
+
+    def kernel(in_ref, out_ref, scratch, sem):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        copy = pltpu.make_async_copy(
+            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * r), :],
+            scratch,
+            sem,
+        )
+        copy.start()
+        copy.wait()
+        tile = scratch[...].astype(jnp.float32)
+        center = tile[:, r : r + tile_h, r : r + w]
+        num = jnp.zeros((c, tile_h, w), jnp.float32)
+        den = jnp.zeros((1, tile_h, w), jnp.float32)
+        for dy in range(d):
+            for dx in range(d):
+                sh = tile[:, dy : dy + tile_h, dx : dx + w]
+                diff = sh - center
+                dist2 = jnp.sum(diff * diff, axis=0, keepdims=True)
+                wgt = spatial[dy][dx] * jnp.exp(-dist2 * inv2sc)
+                num = num + wgt * sh
+                den = den + wgt
+        out_ref[...] = (num / den)[None].astype(out_ref.dtype)
+
+    return kernel
+
+
+def bilateral_nhwc_pallas(
+    batch: jnp.ndarray,
+    d: int = 5,
+    sigma_color: float = 0.1,
+    sigma_space: float = 2.0,
+    tile_h: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas bilateral over float NHWC in [0,1]; numerics match
+    ops.bilateral.bilateral_nhwc (same reflect borders and weights)."""
+    if d % 2 != 1:
+        raise ValueError(f"window d must be odd, got {d}")
+    r = d // 2
+    b, h, w, c = batch.shape
+    th = tile_h if tile_h is not None else _pick_tile_h(h)
+    if h % th != 0:
+        raise ValueError(f"tile_h {th} must divide H {h}")
+
+    x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
+    x = jnp.pad(x, ((0, 0), (0, 0), (r, r), (r, r)), mode="reflect")
+
+    kernel = _bilateral_kernel(th, r, w, c, sigma_color, sigma_space)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h // th),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((c, th + 2 * r, w + 2 * r), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(x)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+@register_filter("bilateral_pallas")
+def bilateral_pallas(
+    d: int = 5,
+    sigma_color: float = 0.1,
+    sigma_space: float = 2.0,
+    tile_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Filter:
+    """Pallas-backed bilateral. ``interpret=None`` → auto: compiled on TPU,
+    interpret mode elsewhere (CPU tests)."""
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        interp = interpret
+        if interp is None:
+            interp = jax.default_backend() not in ("tpu",)
+        return bilateral_nhwc_pallas(
+            batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space,
+            tile_h=tile_h, interpret=interp,
+        )
+
+    return stateless(
+        f"bilateral_pallas(d={d},sc={sigma_color},ss={sigma_space})",
+        fn,
+        halo=d // 2,
+    )

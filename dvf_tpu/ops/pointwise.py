@@ -25,14 +25,14 @@ def invert() -> Filter:
             return jnp.asarray(255, dtype=jnp.uint8) - batch
         return 1.0 - batch
 
-    return stateless("invert", fn, uint8_ok=True)
+    return stateless("invert", fn, uint8_ok=True, halo=0)
 
 
 @register_filter("identity")
 def identity() -> Filter:
     """Pass-through — the null filter, useful to measure pipeline overhead
     (the reference measures this implicitly with ``--delay 0``)."""
-    return stateless("identity", lambda batch: batch, uint8_ok=True)
+    return stateless("identity", lambda batch: batch, uint8_ok=True, halo=0)
 
 
 @register_filter("grayscale")
@@ -41,7 +41,7 @@ def grayscale() -> Filter:
         gray = rgb_to_gray(batch, keepdims=True)
         return jnp.broadcast_to(gray, batch.shape)
 
-    return stateless("grayscale", fn)
+    return stateless("grayscale", fn, halo=0)
 
 
 @register_filter("brightness_contrast")
@@ -51,7 +51,7 @@ def brightness_contrast(alpha: float = 1.0, beta: float = 0.0) -> Filter:
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return jnp.clip(alpha * batch + beta, 0.0, 1.0)
 
-    return stateless(f"brightness_contrast(a={alpha},b={beta})", fn)
+    return stateless(f"brightness_contrast(a={alpha},b={beta})", fn, halo=0)
 
 
 @register_filter("gamma")
@@ -59,7 +59,7 @@ def gamma(g: float = 2.2) -> Filter:
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return jnp.power(jnp.clip(batch, 0.0, 1.0), g)
 
-    return stateless(f"gamma({g})", fn)
+    return stateless(f"gamma({g})", fn, halo=0)
 
 
 @register_filter("threshold")
@@ -67,7 +67,7 @@ def threshold(t: float = 0.5) -> Filter:
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return jnp.where(batch > t, 1.0, 0.0).astype(batch.dtype)
 
-    return stateless(f"threshold({t})", fn)
+    return stateless(f"threshold({t})", fn, halo=0)
 
 
 @register_filter("sepia")
@@ -84,4 +84,4 @@ def sepia() -> Filter:
         out = jnp.einsum("...c,oc->...o", batch, m.astype(batch.dtype))
         return jnp.clip(out, 0.0, 1.0)
 
-    return stateless("sepia", fn)
+    return stateless("sepia", fn, halo=0)

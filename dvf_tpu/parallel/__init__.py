@@ -5,3 +5,4 @@ from dvf_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from dvf_tpu.parallel.halo import halo_exchange_rows, spatial_filter  # noqa: F401

@@ -1,0 +1,68 @@
+"""Multi-host initialization and mesh construction.
+
+Reference counterpart: SURVEY.md §2d — the reference's "distributed
+backend" is ZMQ/TCP with implicit membership (connect = join). The
+TPU-native equivalent is ``jax.distributed`` (one controller process per
+host, all chips in one global mesh) with XLA collectives doing every
+cross-device move: batch scatter over DCN between hosts, halo exchange and
+TP psums over ICI within a slice.
+
+Fault model: the reference tolerates worker loss by at-most-once delivery
+and cursor skip (distributor.py:334-338). A JAX SPMD program cannot lose a
+participant mid-program, so elasticity moves up a level: the pipeline
+degrades by dropping frames (ring backpressure) when a host stalls, and a
+host loss is a restart of the mesh program from the last filter state —
+see runtime.pipeline drop semantics and obs metrics for detection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from dvf_tpu.parallel.mesh import MeshConfig, Mesh, auto_mesh_config, make_mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed when running multi-host.
+
+    Arguments default from the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID); on single-host (no coordinator
+    configured) this is a no-op returning False, so the same entry point
+    works for laptop tests and pod slices.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "1")
+    )
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "0")
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(config: Optional[MeshConfig] = None, prefer: str = "data") -> Mesh:
+    """Mesh over ALL devices (local + remote after init_distributed).
+
+    Axis order puts ``data`` outermost (mesh.py): on multi-host meshes the
+    outermost axis spans hosts, so the lowest-bandwidth link (DCN) carries
+    only batch scatter/gather while ``space``/``model`` collectives stay
+    slice-local on ICI — the scaling-book layout rule.
+    """
+    devices = jax.devices()
+    if config is None:
+        config = auto_mesh_config(len(devices), prefer=prefer)
+    return make_mesh(config, devices=devices)

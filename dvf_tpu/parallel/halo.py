@@ -1,0 +1,122 @@
+"""Spatial parallelism with explicit halo exchange — the framework's
+"ring attention" analog (SURVEY.md §2c, §5.7).
+
+The reference never scales *within* a frame — its unit of parallelism is a
+whole frame shipped to one worker (worker.py:50-57). For the 1080p stencil
+configs (BASELINE.json configs[1-2]) one frame is sharded across devices on
+the H axis instead, and each stencil op needs its neighbors' boundary rows:
+the halo. That exchange is written EXPLICITLY here as a `shard_map` ring —
+`lax.ppermute` shifts of the boundary rows over the mesh 'space' axis,
+riding ICI — rather than relying on GSPMD's automatic spatial partitioner
+(which miscompiles convs when spatial and feature dims are both sharded on
+this toolchain; see train.style.make_train_step).
+
+Overlap-and-discard scheme: each shard receives ``r`` rows from each
+neighbor, runs the unmodified filter body on the extended slab, and
+discards the outer ``r`` output rows. The filter's own internal
+reflect-padding only ever touches rows that get discarded, so any
+stencil filter of radius ≤ r composes with this wrapper unchanged. The
+global top/bottom shards substitute reflect-101 rows (cv2's default
+border, matching the unsharded ops) for the missing neighbor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dvf_tpu.api.filter import Filter
+
+
+def halo_exchange_rows(x: jnp.ndarray, r: int, axis_name: str = "space") -> jnp.ndarray:
+    """Extend a (B, H_local, W, C) slab by r rows from each ring neighbor.
+
+    Must run inside a shard_map manual over ``axis_name``. The first/last
+    shards use reflect-101 of their own edge instead of the ring wrap, so
+    the assembled result matches reflect-padded single-device semantics.
+    """
+    n = lax.axis_size(axis_name)
+    if x.shape[1] <= r:
+        raise ValueError(
+            f"local slab has {x.shape[1]} rows but the stencil radius is {r}; "
+            f"use fewer 'space' shards (or taller frames) so each shard owns "
+            f"more than r rows"
+        )
+    if n == 1:
+        return jnp.pad(x, ((0, 0), (r, r), (0, 0), (0, 0)), mode="reflect")
+    idx = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    # My bottom rows become my successor's top halo, and vice versa.
+    top_halo = lax.ppermute(x[:, -r:], axis_name, fwd)
+    bot_halo = lax.ppermute(x[:, :r], axis_name, bwd)
+    # reflect-101: rows 1..r mirrored (edge row not repeated).
+    top_reflect = x[:, 1 : r + 1][:, ::-1]
+    bot_reflect = x[:, -r - 1 : -1][:, ::-1]
+    top = jnp.where(idx == 0, top_reflect, top_halo)
+    bot = jnp.where(idx == n - 1, bot_reflect, bot_halo)
+    return jnp.concatenate([top, x, bot], axis=1)
+
+
+def spatial_filter(filt: Filter, mesh: Mesh, halo: Optional[int] = None) -> Filter:
+    """Wrap a stateless stencil filter for H-sharded execution.
+
+    The returned Filter's fn is a shard_map over ('data', 'space'): B is
+    sharded over 'data', H over 'space'; each shard halo-exchanges ``r``
+    rows, applies the original filter body to the extended slab, and drops
+    the halo rows of the output. Requires ``filt.halo`` (stencil radius in
+    rows) or an explicit ``halo=``; stateful filters are not supported
+    (state row-sharding is filter-specific).
+    """
+    if filt.stateful:
+        raise ValueError("spatial_filter supports stateless filters only")
+    r = halo if halo is not None else filt.halo
+    if r is None:
+        raise ValueError(
+            f"filter {filt.name!r} has no halo radius; pass halo= explicitly"
+        )
+
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_space = axes.get("space", 1)
+
+    def local_fn(batch: jnp.ndarray, state):
+        if r > 0:
+            ext = halo_exchange_rows(batch, r, "space")
+            y, _ = filt.fn(ext, None)
+            y = y[:, r:-r]
+        else:
+            y, _ = filt.fn(batch, None)
+        return y, state
+
+    if n_space == 1:
+        return Filter(
+            name=f"spatial({filt.name})",
+            fn=filt.fn,
+            compute_dtype=filt.compute_dtype,
+            uint8_ok=filt.uint8_ok,
+            halo=filt.halo,
+        )
+
+    spec = P("data", "space")
+
+    def fn(batch: jnp.ndarray, state):
+        sharded = jax.shard_map(
+            lambda b: local_fn(b, None)[0],
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+            check_vma=False,
+        )
+        return sharded(batch), state
+
+    return Filter(
+        name=f"spatial({filt.name})",
+        fn=fn,
+        compute_dtype=filt.compute_dtype,
+        uint8_ok=filt.uint8_ok,
+        halo=filt.halo,
+    )

@@ -1,0 +1,71 @@
+"""JPEG codec shim — the TurboJPEG role from the reference.
+
+The reference encodes/decodes on both endpoints via PyTurboJPEG
+(webcam_app.py:24,110,140; inverter.py:32,44) to cut wire bytes. Here the
+codec stays host-side (the TPU only ever sees dense uint8 NHWC arrays) and
+is parallelized with a thread pool: cv2's imencode/imdecode release the
+GIL inside libjpeg, so N worker threads give near-linear speedup —
+SURVEY.md §7 hard part 3 (host JPEG throughput outpacing the device) is a
+thread-count knob, and batch decode lands directly into one preallocated
+NHWC staging array ready for device_put.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except ImportError:  # pragma: no cover
+    _HAS_CV2 = False
+
+
+class JpegCodec:
+    def __init__(self, quality: int = 90, threads: int = 4):
+        if not _HAS_CV2:
+            raise ImportError("JpegCodec needs cv2 (baked into this environment)")
+        self.quality = int(quality)
+        self.pool = ThreadPoolExecutor(max_workers=threads, thread_name_prefix="dvf-jpeg")
+
+    # -- single frame ---------------------------------------------------
+
+    def encode(self, frame_rgb: np.ndarray) -> bytes:
+        ok, buf = cv2.imencode(
+            ".jpg",
+            cv2.cvtColor(frame_rgb, cv2.COLOR_RGB2BGR),
+            [cv2.IMWRITE_JPEG_QUALITY, self.quality],
+        )
+        if not ok:
+            raise ValueError("JPEG encode failed")
+        return buf.tobytes()
+
+    def decode(self, data: bytes) -> np.ndarray:
+        img = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError("JPEG decode failed")
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+
+    # -- batched (thread-parallel) --------------------------------------
+
+    def encode_batch(self, frames: Sequence[np.ndarray]) -> List[bytes]:
+        return list(self.pool.map(self.encode, frames))
+
+    def decode_batch(
+        self, blobs: Sequence[bytes], out: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """Decode into a stacked (N, H, W, 3) uint8 array (``out`` if given —
+        the staging buffer handed to device_put)."""
+        frames = list(self.pool.map(self.decode, blobs))
+        if out is None:
+            return np.stack(frames)
+        for i, f in enumerate(frames):
+            out[i] = f
+        return out
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False)

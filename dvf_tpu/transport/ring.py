@@ -1,0 +1,140 @@
+"""ctypes binding for the native frame ring (ring.cpp).
+
+Compiles the shared library on first use with g++ (no pybind11 in this
+environment; ctypes keeps the binding dependency-free) and caches the .so
+next to the source, rebuilding when ring.cpp is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ring.cpp")
+_LIB = os.path.join(_DIR, "_ring.so")
+_BUILD_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _BUILD_LOCK:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            _build()
+        # PyDLL: keep the GIL across calls. Every ring op is sub-microsecond;
+        # releasing/reacquiring the GIL per call (CDLL) causes a handoff
+        # convoy (~5 ms each, the interpreter switch interval) as producer
+        # and consumer threads ping-pong — measured 1000x slowdown. Holding
+        # the GIL for a memcpy of one frame header/payload is the cheaper
+        # trade by far; cross-process users don't share a GIL at all.
+        lib = ctypes.PyDLL(_LIB)
+        lib.ring_create.restype = ctypes.c_void_p
+        lib.ring_create.argtypes = [ctypes.c_uint64]
+        lib.ring_create_shm.restype = ctypes.c_void_p
+        lib.ring_create_shm.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+        lib.ring_push.restype = ctypes.c_int64
+        lib.ring_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_double,
+        ]
+        lib.ring_pop.restype = ctypes.c_int64
+        lib.ring_pop.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_double),
+        ]
+        for name in ("ring_approx_len", "ring_dropped", "ring_pushed", "ring_capacity"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.ring_destroy.restype = None
+        lib.ring_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class FrameRing:
+    """Bounded frame queue with drop-oldest overflow (the reference's
+    ingest semantics, distributor.py:188-203), backed by the native ring.
+
+    ``shm_name``: attach/create a POSIX shared-memory ring for
+    cross-process use (camera process → framework process); None = private
+    in-process ring for thread-to-thread handoff.
+    """
+
+    def __init__(
+        self,
+        capacity_bytes: int = 64 << 20,
+        shm_name: Optional[str] = None,
+        create: bool = True,
+        max_frame_bytes: int = 32 << 20,
+    ):
+        lib = _load()
+        if shm_name is not None:
+            self._ptr = lib.ring_create_shm(
+                shm_name.encode(), capacity_bytes, 1 if create else 0
+            )
+        else:
+            self._ptr = lib.ring_create(capacity_bytes)
+        if not self._ptr:
+            raise OSError(f"failed to create frame ring (shm={shm_name!r})")
+        self._lib = lib
+        self._buf = ctypes.create_string_buffer(max_frame_bytes)
+
+    def push(self, payload: bytes, frame_index: int, timestamp: float) -> int:
+        """Returns how many old frames were evicted to make room."""
+        n = self._lib.ring_push(self._ptr, payload, len(payload), frame_index, timestamp)
+        if n < 0:
+            raise ValueError(f"frame of {len(payload)} bytes exceeds ring capacity")
+        return int(n)
+
+    def pop(self) -> Optional[Tuple[bytes, int, float]]:
+        """(payload, frame_index, timestamp) or None if empty."""
+        idx = ctypes.c_uint64()
+        ts = ctypes.c_double()
+        n = self._lib.ring_pop(self._ptr, self._buf, len(self._buf), ctypes.byref(idx), ctypes.byref(ts))
+        if n == 0:
+            return None
+        if n < 0:
+            raise ValueError(f"frame needs {-n} bytes; raise max_frame_bytes")
+        # string_at copies exactly n bytes (buf.raw would copy the whole
+        # staging buffer per pop — 32 MB for a 5-byte frame).
+        return ctypes.string_at(self._buf, int(n)), int(idx.value), float(ts.value)
+
+    def __len__(self) -> int:
+        return int(self._lib.ring_approx_len(self._ptr))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.ring_dropped(self._ptr))
+
+    @property
+    def pushed(self) -> int:
+        return int(self._lib.ring_pushed(self._ptr))
+
+    @property
+    def capacity(self) -> int:
+        return int(self._lib.ring_capacity(self._ptr))
+
+    def close(self) -> None:
+        if getattr(self, "_ptr", None):
+            self._lib.ring_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

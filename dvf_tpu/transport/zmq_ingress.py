@@ -1,0 +1,165 @@
+"""ZMQ ingress: one TPU-backed "worker" that speaks the reference's wire
+protocol, so the reference app can drive this framework unmodified.
+
+Wire protocol (SURVEY.md §2 "Wire protocol"; behavior, not code, mirrored):
+- distribute channel: DEALER connects to the app's ROUTER (default :5555)
+  and requests work by sending ``[b"READY"]`` (worker.py:39); the app
+  replies ``[frame_index_ascii, frame_bytes]`` (distributor.py:236-238 /
+  worker.py:50-51), at most one frame per READY.
+- collect channel: PUSH connects to the app's PULL (default :5556) and
+  sends ``[frame_index, pid, start_time, end_time, payload]``, all
+  metadata stringified (worker.py:63-67 / distributor.py:260-264).
+
+Where the reference runs N single-frame Python workers, this ingress is
+ONE process that keeps ``batch_size`` READY credits outstanding
+(pipelining the request/reply channel), assembles arriving frames into a
+batch, runs the jitted filter once on the TPU, and pushes each result
+back individually. To the app it is indistinguishable from a very fast
+worker pool: elastic (connect = join), at-most-once, order restored by
+the app's reorder buffer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.transport.codec import JpegCodec
+
+
+class TpuZmqWorker:
+    """TPU-backed worker endpoint for the reference's socket pair.
+
+    ``use_jpeg=False`` expects raw uint8 RGB frames of ``raw_size``²
+    (the reference's non-JPEG path hardcodes its frame geometry the same
+    way, inverter.py:34).
+    """
+
+    def __init__(
+        self,
+        filt: Filter,
+        host: str = "localhost",
+        distribute_port: int = 5555,
+        collect_port: int = 5556,
+        batch_size: int = 8,
+        assemble_timeout_s: float = 0.01,
+        use_jpeg: bool = True,
+        raw_size: int = 512,
+        jpeg_quality: int = 90,
+        codec_threads: int = 4,
+        engine: Optional[Engine] = None,
+        poll_ms: int = 10,
+    ):
+        import zmq
+
+        self.ctx = zmq.Context()
+        self.dealer = self.ctx.socket(zmq.DEALER)
+        self.dealer.connect(f"tcp://{host}:{distribute_port}")
+        self.push = self.ctx.socket(zmq.PUSH)
+        self.push.connect(f"tcp://{host}:{collect_port}")
+        self.filt = filt
+        self.engine = engine or Engine(filt)
+        self.codec = JpegCodec(quality=jpeg_quality, threads=codec_threads)
+        self.batch_size = batch_size
+        self.assemble_timeout_s = assemble_timeout_s
+        self.use_jpeg = use_jpeg
+        self.raw_size = raw_size
+        self.poll_ms = poll_ms
+        self.frames_processed = 0
+        self.batches = 0
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _decode(self, blobs):
+        if self.use_jpeg:
+            return self.codec.decode_batch(blobs)
+        s = self.raw_size
+        return np.stack([
+            np.frombuffer(b, np.uint8).reshape(s, s, 3) for b in blobs
+        ])
+
+    def _encode(self, batch_u8: np.ndarray):
+        if self.use_jpeg:
+            return self.codec.encode_batch(list(batch_u8))
+        return [row.tobytes() for row in batch_u8]
+
+    def run(self, max_frames: Optional[int] = None) -> None:
+        """Serve until stop() (or until ``max_frames`` processed — tests)."""
+        import zmq
+
+        pid = str(os.getpid()).encode()
+        credits = 0
+        pending = []  # (frame_index:int, frame_bytes)
+        first_recv_t: Optional[float] = None
+
+        while not self._stop.is_set():
+            # Keep batch_size READYs outstanding so the app's ROUTER can
+            # stream us frames back-to-back (the reference worker holds
+            # exactly one, worker.py:39-46; credits generalize that).
+            while credits < self.batch_size:
+                self.dealer.send(b"READY")
+                credits += 1
+
+            if self.dealer.poll(self.poll_ms):
+                parts = self.dealer.recv_multipart()
+                # Any reply consumes a credit — even a malformed or control
+                # message. Decrementing only on well-formed frames would
+                # leak that credit forever and eventually starve the READY
+                # replenishment loop above.
+                credits = max(0, credits - 1)
+                if len(parts) == 2:
+                    idx = int(parts[0].decode())
+                    pending.append((idx, parts[1]))
+                    if first_recv_t is None:
+                        first_recv_t = time.perf_counter()
+
+            flush = len(pending) >= self.batch_size or (
+                pending
+                and first_recv_t is not None
+                and time.perf_counter() - first_recv_t > self.assemble_timeout_s
+            )
+            if not flush:
+                continue
+
+            t0 = time.time()
+            indices = [i for i, _ in pending]
+            frames = self._decode([b for _, b in pending])
+            valid = len(frames)
+            # Pad to the compiled batch signature (static shapes — one
+            # compilation for every batch size).
+            if valid < self.batch_size:
+                frames = np.concatenate(
+                    [frames, np.repeat(frames[-1:], self.batch_size - valid, 0)]
+                )
+            out = np.asarray(self.engine.submit(frames))
+            t1 = time.time()
+            payloads = self._encode(out[:valid])
+            for idx, payload in zip(indices, payloads):
+                self.push.send_multipart([
+                    str(idx).encode(), pid,
+                    str(t0).encode(), str(t1).encode(),
+                    payload,
+                ])
+            self.frames_processed += valid
+            self.batches += 1
+            pending = []
+            first_recv_t = None
+            if max_frames is not None and self.frames_processed >= max_frames:
+                break
+
+    def close(self) -> None:
+        self._stop.set()
+        self.codec.close()
+        self.dealer.close(0)
+        self.push.close(0)
+        self.ctx.term()

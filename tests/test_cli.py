@@ -1,0 +1,56 @@
+"""CLI tests (CPU; small shapes)."""
+
+import json
+
+import pytest
+
+from dvf_tpu.cli import BENCH_CONFIGS, main
+
+
+def test_filters_lists_registry(capsys):
+    assert main(["filters"]) == 0
+    out = capsys.readouterr().out.split()
+    for expected in ("invert", "gaussian_blur", "bilateral", "style_transfer",
+                     "sobel_bilateral", "flow_warp", "bilateral_pallas"):
+        assert expected in out
+
+
+def test_serve_synthetic(capsys):
+    rc = main([
+        "serve", "--filter", "invert", "--source", "synthetic",
+        "--height", "32", "--width", "32", "--frames", "20",
+        "--batch", "4", "--frame-delay", "0", "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 20
+
+
+def test_serve_filter_config(capsys):
+    rc = main([
+        "serve", "--filter", "gaussian_blur", "--filter-config", '{"ksize": 3}',
+        "--source", "synthetic", "--height", "32", "--width", "32",
+        "--frames", "8", "--batch", "4", "--frame-delay", "0",
+        "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 8
+
+
+def test_bench_configs_cover_baseline():
+    # BASELINE.json configs[0..4] + headline all present.
+    assert {"invert_1080p", "invert_640x480", "gauss3_1080p", "gauss9_1080p",
+            "sobel_bilateral_1080p", "flow_720p", "style_720p"} <= set(BENCH_CONFIGS)
+
+
+def test_bench_runs_small(capsys, monkeypatch):
+    # Shrink a config so the device-resident loop runs fast on CPU.
+    monkeypatch.setitem(
+        BENCH_CONFIGS, "invert_1080p",
+        dict(filter=("invert", {}), h=32, w=32, batch=4),
+    )
+    rc = main(["bench", "--config", "invert_1080p", "--iters", "3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["unit"] == "fps" and out["value"] > 0

@@ -1,0 +1,193 @@
+"""Transport tests: native ring (drop-oldest semantics, SPSC threading,
+shared memory), JPEG codec round-trip, and the ZMQ ingress speaking the
+reference wire protocol against a mini app-side harness."""
+
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dvf_tpu.transport.codec import JpegCodec
+from dvf_tpu.transport.ring import FrameRing
+
+
+# ---------------------------------------------------------------- ring
+
+def test_ring_fifo_roundtrip():
+    ring = FrameRing(capacity_bytes=1 << 16)
+    for i in range(5):
+        assert ring.push(bytes([i]) * (i + 1), i, 100.0 + i) == 0
+    assert len(ring) == 5
+    for i in range(5):
+        payload, idx, ts = ring.pop()
+        assert payload == bytes([i]) * (i + 1)
+        assert idx == i
+        assert ts == pytest.approx(100.0 + i)
+    assert ring.pop() is None
+    ring.close()
+
+
+def test_ring_drop_oldest_on_overflow():
+    ring = FrameRing(capacity_bytes=1 << 12)  # 4 KiB
+    payload = b"x" * 1000
+    drops = [ring.push(payload, i, float(i)) for i in range(8)]
+    assert sum(drops) > 0  # overflowed: oldest evicted, newest kept
+    got = []
+    while (item := ring.pop()) is not None:
+        got.append(item[1])
+    # Survivors are the most recent frames, still in order.
+    assert got == sorted(got)
+    assert got[-1] == 7
+    assert ring.dropped == sum(drops)
+    assert ring.pushed == 8
+    ring.close()
+
+
+def test_ring_rejects_oversized_frame():
+    ring = FrameRing(capacity_bytes=1 << 10)
+    with pytest.raises(ValueError):
+        ring.push(b"y" * (1 << 11), 0, 0.0)
+    ring.close()
+
+
+def test_ring_spsc_threaded():
+    ring = FrameRing(capacity_bytes=1 << 20)
+    n = 2000
+    got = []
+
+    def produce():
+        for i in range(n):
+            ring.push(i.to_bytes(4, "little"), i, time.time())
+
+    def consume():
+        deadline = time.time() + 10
+        while len(got) < n and time.time() < deadline:
+            item = ring.pop()
+            if item is None:
+                time.sleep(0.0001)
+                continue
+            got.append(int.from_bytes(item[0], "little"))
+
+    t1 = threading.Thread(target=produce)
+    t2 = threading.Thread(target=consume)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    # Big ring: nothing dropped, strict FIFO.
+    assert got == list(range(n))
+    assert ring.dropped == 0
+    ring.close()
+
+
+def test_ring_shared_memory_cross_process():
+    name = f"/dvf_test_{uuid.uuid4().hex[:8]}"
+    ring = FrameRing(capacity_bytes=1 << 16, shm_name=name, create=True)
+    ring.push(b"hello", 42, 1.5)
+    pid = os.fork()
+    if pid == 0:  # child: attach and read
+        try:
+            child = FrameRing(capacity_bytes=1 << 16, shm_name=name, create=False)
+            item = child.pop()
+            ok = item is not None and item[0] == b"hello" and item[1] == 42
+            os._exit(0 if ok else 1)
+        except BaseException:
+            os._exit(2)
+    _, status = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(status) == 0
+    assert ring.pop() is None  # consumed by the child through shm
+    ring.close()
+
+
+# --------------------------------------------------------------- codec
+
+def test_jpeg_roundtrip_tolerance(frame_u8):
+    codec = JpegCodec(quality=95)
+    blob = codec.encode(frame_u8)
+    out = codec.decode(blob)
+    assert out.shape == frame_u8.shape and out.dtype == np.uint8
+    # Lossy, but close (the reference tolerates the same JPEG loss).
+    assert float(np.mean(np.abs(out.astype(int) - frame_u8.astype(int)))) < 6.0
+    codec.close()
+
+
+def test_jpeg_batch_into_staging(frame_u8):
+    codec = JpegCodec()
+    blobs = codec.encode_batch([frame_u8] * 4)
+    out = np.empty((4,) + frame_u8.shape, np.uint8)
+    got = codec.decode_batch(blobs, out=out)
+    assert got is out
+    assert got.shape == (4,) + frame_u8.shape
+    codec.close()
+
+
+# ---------------------------------------------------- zmq wire protocol
+
+class MiniApp:
+    """App-side harness: ROUTER hands out indexed frames one per READY,
+    PULL collects 5-part results — the reference's socket pair."""
+
+    def __init__(self, frames):
+        import zmq
+
+        self.ctx = zmq.Context()
+        self.router = self.ctx.socket(zmq.ROUTER)
+        self.dist_port = self.router.bind_to_random_port("tcp://127.0.0.1")
+        self.pull = self.ctx.socket(zmq.PULL)
+        self.coll_port = self.pull.bind_to_random_port("tcp://127.0.0.1")
+        self.frames = list(enumerate(frames))
+        self.results = {}
+        self.result_meta = {}
+
+    def serve(self, timeout_s=20.0):
+        deadline = time.time() + timeout_s
+        n_total = len(self.frames)
+        while len(self.results) < n_total and time.time() < deadline:
+            if self.router.poll(5):
+                client, _, = self.router.recv_multipart()[:2]
+                if self.frames:
+                    idx, blob = self.frames.pop(0)
+                    self.router.send_multipart([client, str(idx).encode(), blob])
+            if self.pull.poll(5):
+                idx_b, pid_b, t0_b, t1_b, payload = self.pull.recv_multipart()
+                idx = int(idx_b.decode())
+                self.results[idx] = payload
+                self.result_meta[idx] = (int(pid_b), float(t0_b), float(t1_b))
+
+    def close(self):
+        self.router.close(0)
+        self.pull.close(0)
+        self.ctx.term()
+
+
+def test_zmq_ingress_serves_reference_protocol(rng):
+    pytest.importorskip("zmq")
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+    n = 12
+    frames = [rng.integers(0, 255, (32, 32, 3), np.uint8) for _ in range(n)]
+    raw = [f.tobytes() for f in frames]
+    app = MiniApp(raw)
+    worker = TpuZmqWorker(
+        get_filter("invert"),
+        host="127.0.0.1",
+        distribute_port=app.dist_port,
+        collect_port=app.coll_port,
+        batch_size=4,
+        use_jpeg=False,
+        raw_size=32,
+    )
+    t = threading.Thread(target=worker.run, kwargs={"max_frames": n}, daemon=True)
+    t.start()
+    app.serve()
+    worker.stop()
+    t.join(timeout=10)
+    assert len(app.results) == n
+    for i in range(n):
+        out = np.frombuffer(app.results[i], np.uint8).reshape(32, 32, 3)
+        np.testing.assert_array_equal(out, 255 - frames[i])
+        pid, t0, t1 = app.result_meta[i]
+        assert pid > 0 and t1 >= t0
+    worker.close()
+    app.close()
