@@ -1,70 +1,205 @@
-"""Headline benchmark: 1080p color-invert filter throughput on the TPU.
+"""Headline benchmark: 1080p color-invert through the framework, on the TPU.
 
 Prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "fps", "vs_baseline": N, ...}
+    {"metric": "1080p_invert", "value": <device fps>, "unit": "fps",
+     "vs_baseline": value/2000, "p50_latency_ms": ..., "p99_latency_ms": ...,
+     "e2e_fps": ..., "backend": "tpu"|"cpu", "fallback": bool, "error": ...}
 
 ``vs_baseline`` is value / 2000 — the north-star target from BASELINE.json
-(≥2000 fps, p50 < 10 ms, 1080p invert on a v5e-4). The reference publishes
-no numbers (BASELINE.md); its implied design point is a 30 fps webcam.
+(≥2000 fps AND p50 < 10 ms, 1080p invert on a v5e-4). Both halves of that
+target are in the default output: ``value`` is sustained device-resident
+filter throughput, ``p50_latency_ms``/``p99_latency_ms`` are delivered
+end-to-end latency through the full streaming pipeline (the two numbers the
+reference itself measures, webcam_app.py:88-95,152-163 and
+distributor.py:152-171).
 
-The headline number is **device-resident filter throughput** through the
-framework Engine — see dvf_tpu/benchmarks.py for the measurement design
-(forced-completion checksums; host transfer reported separately, since a
-tunneled single-chip session would otherwise measure the tunnel, not the
-framework). ``--e2e`` runs the full streaming pipeline instead.
+Reliability design (round 1 post-mortem: the driver's run died in TPU
+backend init and a re-run hung >280 s with no output):
 
-Usage: python bench.py [--iters K] [--batch B] [--e2e] [--frames N]
+- This parent process NEVER imports jax. All device work runs in a child
+  (``dvf_tpu/bench_child.py``) bounded by subprocess timeouts.
+- Backend init is probed first with a short timeout and retried once on
+  failure (UNAVAILABLE init errors are often transient tunnel hiccups).
+- If the TPU cannot initialize, the bench degrades LOUDLY: it reruns on
+  CPU with a scaled-down workload and emits the JSON line with
+  ``"fallback": true`` and the real TPU error in ``"error"`` — a smoke
+  number plus diagnostics instead of a hang or a bare traceback.
+- Whatever happens, exactly one JSON line goes to stdout. Exit code is 0
+  whenever a measurement (even the CPU fallback) was obtained.
+
+Usage: python bench.py [--iters K] [--batch B] [--frames N] [--cpu]
+                       [--probe-timeout S] [--bench-timeout S] [--e2e]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import time
+
+PROBE_CODE = (
+    "import jax; d = jax.devices(); "
+    "print(jax.default_backend(), len(d), flush=True)"
+)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def _run(cmd, env, timeout):
+    """Run a child; returns (rc, stdout, stderr). rc=-9 on timeout."""
+    try:
+        p = subprocess.run(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout, text=True,
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        def _s(x):
+            if x is None:
+                return ""
+            return x.decode(errors="replace") if isinstance(x, bytes) else x
+        return -9, _s(e.stdout), _s(e.stderr) + f"\n[killed: timeout after {timeout}s]"
+
+
+def _tail(s: str, n: int = 12) -> str:
+    lines = [ln for ln in s.strip().splitlines() if ln.strip()]
+    return "\n".join(lines[-n:])
+
+
+def probe_backend(timeout: float, attempts: int = 2):
+    """Bounded backend-init probe. Returns (platform_name, error_or_None)."""
+    env = dict(os.environ)
+    last_err = ""
+    for i in range(attempts):
+        _log(f"probing TPU backend (attempt {i + 1}/{attempts}, timeout {timeout:.0f}s)")
+        rc, out, err = _run([sys.executable, "-c", PROBE_CODE], env, timeout)
+        if rc == 0 and out.strip():
+            platform = out.split()[0]
+            _log(f"backend ok: {out.strip()}")
+            return platform, None
+        last_err = _tail(err) or f"probe exited rc={rc} with no output"
+        _log(f"probe failed (rc={rc}): {_tail(err, 3)}")
+    return None, last_err
+
+
+def run_bench_child(child_args, env, timeout):
+    """Run bench_child; returns (result_dict_or_None, error_or_None)."""
+    cmd = [sys.executable, "-m", "dvf_tpu.bench_child", *child_args]
+    rc, out, err = _run(cmd, env, timeout)
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"child rc={rc}; stderr tail:\n{_tail(err)}"
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--iters", type=int, default=400, help="device-resident chain length")
+    ap.add_argument("--iters", type=int, default=300, help="device-resident chain length")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--height", type=int, default=1080)
     ap.add_argument("--width", type=int, default=1920)
-    ap.add_argument("--e2e", action="store_true", help="streaming pipeline mode")
-    ap.add_argument("--frames", type=int, default=512, help="frames for --e2e mode")
+    ap.add_argument("--frames", type=int, default=512, help="e2e streaming frames")
+    ap.add_argument("--e2e-batch", type=int, default=16)
+    ap.add_argument("--e2e", action="store_true",
+                    help="(compat) e2e-only mode; default now reports both")
+    ap.add_argument("--cpu", action="store_true", help="skip probe, run on CPU")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--bench-timeout", type=float, default=420.0)
     args = ap.parse_args(argv)
 
-    from dvf_tpu.benchmarks import bench_device_resident, bench_e2e_streaming
-    from dvf_tpu.ops import get_filter
+    mode = "e2e" if args.e2e else "headline"
+    error = None
+    fallback = False
 
-    filt = get_filter("invert")
-    if args.e2e:
-        r = bench_e2e_streaming(filt, args.frames, args.batch, args.height, args.width)
-        result = {
-            "metric": "1080p_invert_e2e_fps",
-            "value": round(r["fps"], 1),
-            "unit": "fps",
-            "vs_baseline": round(r["fps"] / 2000.0, 3),
-            "p50_latency_ms": round(r["p50_ms"], 2),
-            "p99_latency_ms": round(r["p99_ms"], 2),
-            "frames": r["frames"],
-            "wall_s": round(r["wall_s"], 2),
-        }
+    if args.cpu:
+        platform = None  # force fallback path below
+        error = "cpu requested via --cpu"
     else:
-        r = bench_device_resident(filt, args.iters, args.batch, args.height, args.width)
-        result = {
-            "metric": "1080p_invert_device_fps",
-            "value": round(r["fps"], 1),
-            "unit": "fps",
-            "vs_baseline": round(r["fps"] / 2000.0, 3),
-            "ms_per_batch": round(r["ms_per_batch"], 3),
-            "ms_per_frame": round(r["ms_per_frame"], 4),
-            "batch": args.batch,
-            "frames": r["frames"],
-            "wall_s": round(r["wall_s"], 2),
-            "h2d_mbps": round(r["h2d_mbps"], 1),
-        }
-    print(json.dumps(result))
+        platform, error = probe_backend(args.probe_timeout)
+        if platform == "cpu":
+            # jax initialized but silently landed on CPU (no TPU plugin /
+            # plugin failed to claim the chip). Running the full TPU-scale
+            # workload there would either eat the whole bench timeout or
+            # mislabel a CPU number as the real measurement — take the
+            # loud, scaled-down fallback path instead.
+            error = "backend probe returned 'cpu' — no TPU available"
+            platform = None
+
+    result = None
+    if platform is not None:
+        child_args = [
+            "--mode", mode,
+            "--iters", str(args.iters), "--batch", str(args.batch),
+            "--height", str(args.height), "--width", str(args.width),
+            "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
+        ]
+        _log(f"running bench on {platform} (timeout {args.bench_timeout:.0f}s)")
+        result, bench_err = run_bench_child(child_args, dict(os.environ),
+                                            args.bench_timeout)
+        if result is None:
+            error = f"TPU bench failed after successful probe: {bench_err}"
+            _log(error)
+
+    if result is None:
+        # Loud CPU fallback: scaled-down workload, clearly labeled. The
+        # point is a verifiable smoke number + the real failure reason,
+        # instead of a hang (round-1 failure mode).
+        fallback = True
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        child_args = [
+            "--mode", mode, "--platform", "cpu",
+            "--iters", "20", "--batch", "8",
+            "--height", str(args.height), "--width", str(args.width),
+            "--frames", "64", "--e2e-batch", "8",
+        ]
+        _log("falling back to CPU (timeout 240s)")
+        result, cpu_err = run_bench_child(child_args, env, 240.0)
+        if result is None:
+            # Total failure: still exactly one JSON line, with diagnostics.
+            out = {
+                "metric": ("1080p_invert_device_fps" if mode == "headline"
+                           else "1080p_invert_e2e_fps"),
+                "value": None,
+                "unit": "fps",
+                "vs_baseline": None,
+                "error": f"TPU: {error}; CPU fallback: {cpu_err}",
+            }
+            print(json.dumps(out), flush=True)
+            return 1
+
+    headline = result.get("device_fps", result.get("e2e_fps"))
+    out = {
+        "metric": "1080p_invert_device_fps" if mode == "headline" else "1080p_invert_e2e_fps",
+        "value": headline,
+        "unit": "fps",
+        "vs_baseline": round(headline / 2000.0, 3) if headline else None,
+        "p50_latency_ms": result.get("p50_ms"),
+        "p99_latency_ms": result.get("p99_ms"),
+        "e2e_fps": result.get("e2e_fps"),
+        "ms_per_frame": result.get("ms_per_frame"),
+        "h2d_mbps": result.get("h2d_mbps"),
+        "backend": result.get("backend"),
+        "n_devices": result.get("n_devices"),
+        "batch": result.get("batch"),
+        "e2e_batch": result.get("e2e_batch"),
+        "fallback": fallback,
+        "error": error,
+    }
+    print(json.dumps(out), flush=True)
     return 0
 
 

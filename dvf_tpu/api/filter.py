@@ -47,6 +47,16 @@ class Filter:
         output pixel depends on (0 = pointwise, k//2 for a k-tap conv,
         None = unknown/unbounded). Spatial sharding (parallel.halo) uses
         this to size the ring halo exchange.
+      pad_safe: whether repeat-last-frame batch padding preserves this
+        filter's semantics. The runtime pads short batches by repeating the
+        last valid frame (static shapes → one compilation). For stateless
+        filters padded outputs are simply dropped (always safe). For
+        stateful filters the padded rows also flow through the state
+        update, so ``pad_safe`` asserts: *the post-batch state depends only
+        on the most recent valid frame* — true for the temporal-window flow
+        family (state = last frame; the padded duplicate IS the last valid
+        frame), false for e.g. a running average, which would double-count.
+        Executors refuse short batches for ``pad_safe=False`` filters.
     """
 
     name: str
@@ -55,6 +65,7 @@ class Filter:
     compute_dtype: Any = jnp.float32
     uint8_ok: bool = False
     halo: Optional[int] = None
+    pad_safe: bool = True
 
     @property
     def stateful(self) -> bool:
@@ -110,4 +121,5 @@ def FilterChain(*filters: Filter, name: Optional[str] = None) -> Filter:
         compute_dtype=filters[0].compute_dtype if filters else jnp.float32,
         uint8_ok=all(f.uint8_ok for f in filters) if filters else False,
         halo=chain_halo,
+        pad_safe=all(f.pad_safe for f in filters) if filters else True,
     )

@@ -106,6 +106,7 @@ def cmd_worker(args) -> int:
         batch_size=args.batch,
         use_jpeg=not args.no_jpeg,
         raw_size=args.target_size,
+        delay_s=args.delay,
     )
     print(
         f"TPU worker serving {filt.name} on "
@@ -184,6 +185,9 @@ def main(argv=None) -> int:
     wp.add_argument("--batch", type=int, default=8)
     wp.add_argument("--no-jpeg", action="store_true")
     wp.add_argument("--target-size", type=int, default=512)
+    wp.add_argument("--delay", type=float, default=0.0,
+                    help="fault injection: sleep this many seconds per batch "
+                         "(simulate a slow worker, like inverter.py --delay)")
 
     bp = sub.add_parser("bench", help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")

@@ -18,6 +18,7 @@ import math
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dvf_tpu.api.filter import Filter, stateless
@@ -95,8 +96,12 @@ def box_blur(ksize: int = 3) -> Filter:
 
 
 # Sobel ksize=3 taps, separable: d = [-1, 0, 1], s = [1, 2, 1].
-_SOBEL_D = jnp.array([-1.0, 0.0, 1.0], dtype=jnp.float32)
-_SOBEL_S = jnp.array([1.0, 2.0, 1.0], dtype=jnp.float32)
+# Host numpy, NOT jnp: module-level jnp.array() would initialize the JAX
+# backend at import time — with a PJRT sitecustomize pinning a (possibly
+# unreachable) TPU platform, `import dvf_tpu` would hang before any code
+# could flip jax.config to CPU. Constants convert during tracing instead.
+_SOBEL_D = np.array([-1.0, 0.0, 1.0], dtype=np.float32)
+_SOBEL_S = np.array([1.0, 2.0, 1.0], dtype=np.float32)
 
 
 def sobel_gradients(batch: jnp.ndarray):

@@ -25,6 +25,7 @@ it is kept in-path so drop/delay semantics match the reference everywhere.
 from __future__ import annotations
 
 import dataclasses
+import sys
 import threading
 import time
 from typing import Any, Optional
@@ -32,7 +33,7 @@ from typing import Any, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import LatencyStats
+from dvf_tpu.obs.metrics import LatencyStats, RateLogger
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.sched.queues import DropOldestQueue
@@ -52,6 +53,12 @@ class PipelineConfig:
     max_inflight: int = 4         # batches in flight; bounds latency
     assemble_timeout_s: float = 0.01   # like the 10ms polls (distributor.py:224)
     trace: bool = False           # enable_trace_export (distributor.py:9)
+    resilient: bool = False       # per-iteration error containment: one bad
+    #   frame/batch is dropped+counted, the loops keep running — the
+    #   reference's live-mode semantics (distributor.py:249-251,287-289,
+    #   worker.py:71-76). Off by default so tests/benches fail fast.
+    telemetry_interval_s: float = 0.0  # >0: print capture/deliver fps every
+    #   N s, like the reference's 5 s prints (webcam_app.py:88-95,152-163)
 
 
 class Pipeline:
@@ -63,6 +70,14 @@ class Pipeline:
         config: Optional[PipelineConfig] = None,
         engine: Optional[Engine] = None,
     ):
+        if filt.stateful and not filt.pad_safe:
+            # The dispatch loop pads short batches (end-of-stream tail, slow
+            # sources) by repeating the last frame; a pad-unsafe stateful
+            # filter would silently corrupt its temporal state (Filter.pad_safe).
+            raise ValueError(
+                f"filter {filt.name!r} is stateful and not pad-safe; the "
+                f"pipeline pads short batches and cannot run it"
+            )
         self.source = source
         self.sink = sink
         self.config = config or PipelineConfig()
@@ -75,6 +90,10 @@ class Pipeline:
         )
         self.latency = LatencyStats()
         self.frame_counter = 0
+        self.errors = 0
+        _ti = self.config.telemetry_interval_s
+        self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
+        self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._inflight: "DropOldestQueue" = DropOldestQueue(maxsize=1_000_000)
         self._inflight_sem = threading.Semaphore(self.config.max_inflight)
         self._eof = threading.Event()
@@ -85,13 +104,23 @@ class Pipeline:
     # ------------------------------------------------------------------
 
     def _ingest(self) -> None:
+        it = iter(self.source)
         try:
-            for frame, ts in self.source:
+            while not self._abort.is_set():
+                try:
+                    frame, ts = next(it)
+                except StopIteration:
+                    break
+                except Exception as e:  # noqa: BLE001 — bad read, maybe next works
+                    if not self._contain(e, "ingest"):
+                        return
+                    continue
                 if frame is None:
                     break
                 idx = self.frame_counter
                 self.frame_counter += 1
                 self.queue.put((idx, frame, ts))
+                self._capture_rate.tick()
                 self.tracer.instant("frame_captured", ts, TRACK_INGEST, frame=idx)
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
@@ -102,6 +131,20 @@ class Pipeline:
         if self._error is None:
             self._error = e
         self._abort.set()
+
+    def _contain(self, e: BaseException, where: str) -> bool:
+        """Resilient mode: drop, count, continue (the reference's
+        per-iteration ``except: continue``, distributor.py:249-251,287-289).
+        Fail-fast mode: abort the pipeline. Returns True to continue."""
+        if self.config.resilient and isinstance(e, Exception):
+            self.errors += 1
+            # stderr: stdout is a data channel (one-JSON-line contract in
+            # the bench stack and CLI).
+            print(f"[pipeline:{where}] error (continuing): {e!r}",
+                  file=sys.stderr, flush=True)
+            return True
+        self._fail(e)
+        return False
 
     def _assemble(self) -> Optional[list]:
         """Collect up to batch_size fresh frames; None = stream finished.
@@ -143,17 +186,25 @@ class Pipeline:
                 valid = len(items)
                 frames = [f for _, f, _ in items]
                 # Pad short batches by repeating the last frame — static
-                # shapes mean one compilation; padded outputs are dropped.
+                # shapes mean one compilation; padded outputs are dropped
+                # (and repeat-last keeps temporal state correct, see
+                # Filter.pad_safe).
                 while len(frames) < b:
                     frames.append(frames[-1])
-                batch = np.stack(frames)
                 # Bounded in-flight depth; poll so a dead collect thread
                 # (which stops releasing permits) can't wedge dispatch.
                 while not self._inflight_sem.acquire(timeout=0.1):
                     if self._abort.is_set():
                         return
-                t0 = time.time()
-                result = self.engine.submit(batch)
+                try:
+                    batch = np.stack(frames)
+                    t0 = time.time()
+                    result = self.engine.submit(batch)
+                except Exception as e:  # noqa: BLE001 — drop this batch
+                    self._inflight_sem.release()
+                    if not self._contain(e, "dispatch"):
+                        return
+                    continue
                 meta = [(idx, ts) for idx, _, ts in items]
                 self._inflight.put((meta, valid, result, t0))
         except BaseException as e:  # noqa: BLE001
@@ -172,8 +223,12 @@ class Pipeline:
                     continue
                 try:
                     out = np.asarray(result)  # blocks until the device is done
-                finally:
+                except Exception as e:  # noqa: BLE001 — device error: drop batch
                     self._inflight_sem.release()
+                    if not self._contain(e, "collect"):
+                        return
+                    continue
+                self._inflight_sem.release()
                 t1 = time.time()
                 self.tracer.complete(
                     "batch_complete", t0, t1, TRACK_DEVICE,
@@ -193,8 +248,13 @@ class Pipeline:
         self.reorder.advance()
         for idx, (frame, ts) in self.reorder.pop_ready():
             self.latency.record(time.time() - ts)
+            self._deliver_rate.tick()
             self.tracer.instant("frame_delivered", track=TRACK_SINK, frame=idx)
-            self.sink.emit(idx, frame, ts)
+            try:
+                self.sink.emit(idx, frame, ts)
+            except Exception as e:  # noqa: BLE001 — a display hiccup must not
+                if not self._contain(e, "sink"):  # kill the stream
+                    return
 
     # ------------------------------------------------------------------
 
@@ -223,6 +283,7 @@ class Pipeline:
             **self.reorder.stats(),
             "total_frames_produced": self.frame_counter,
             "dropped_at_ingest": self.queue.dropped,
+            "errors": self.errors,
             "delivered": self.latency.count,
             "engine_batches": self.engine.stats.batches,
             **self.latency.summary(),

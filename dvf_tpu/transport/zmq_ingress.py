@@ -22,6 +22,7 @@ the app's reorder buffer.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from typing import Optional
@@ -55,9 +56,19 @@ class TpuZmqWorker:
         codec_threads: int = 4,
         engine: Optional[Engine] = None,
         poll_ms: int = 10,
+        credit_ttl_s: float = 0.05,
+        delay_s: float = 0.0,
     ):
         import zmq
 
+        if filt.stateful and not filt.pad_safe:
+            # Short batches are padded by repeating the last frame; a
+            # pad-unsafe stateful filter would corrupt its temporal state
+            # on every partial batch (see Filter.pad_safe).
+            raise ValueError(
+                f"filter {filt.name!r} is stateful and not pad-safe; "
+                f"the ZMQ worker pads short batches and cannot serve it"
+            )
         self.ctx = zmq.Context()
         self.dealer = self.ctx.socket(zmq.DEALER)
         self.dealer.connect(f"tcp://{host}:{distribute_port}")
@@ -71,8 +82,11 @@ class TpuZmqWorker:
         self.use_jpeg = use_jpeg
         self.raw_size = raw_size
         self.poll_ms = poll_ms
+        self.credit_ttl_s = credit_ttl_s
+        self.delay_s = delay_s
         self.frames_processed = 0
         self.batches = 0
+        self.errors = 0
         self._stop = threading.Event()
 
     # ------------------------------------------------------------------
@@ -93,69 +107,121 @@ class TpuZmqWorker:
             return self.codec.encode_batch(list(batch_u8))
         return [row.tobytes() for row in batch_u8]
 
-    def run(self, max_frames: Optional[int] = None) -> None:
-        """Serve until stop() (or until ``max_frames`` processed — tests)."""
-        import zmq
+    def _process_batch(self, pending, pid) -> None:
+        """Decode → engine → encode → push for one assembled batch.
 
+        Exceptions propagate to run()'s containment: one bad batch is
+        dropped and counted, never fatal (worker.py:71-76 semantics).
+        """
+        t0 = time.time()
+        indices = [i for i, _ in pending]
+        frames = self._decode([b for _, b in pending])
+        valid = len(frames)
+        # Pad to the compiled batch signature (static shapes — one
+        # compilation for every batch size). Repeat-last keeps stateful
+        # temporal windows correct — see Filter.pad_safe (enforced in
+        # __init__ for filters where it wouldn't).
+        if valid < self.batch_size:
+            frames = np.concatenate(
+                [frames, np.repeat(frames[-1:], self.batch_size - valid, 0)]
+            )
+        if self.delay_s > 0:
+            # Fault injection: simulate a slow worker to exercise the app's
+            # drop/reorder logic, like the reference's --delay
+            # (inverter.py:37-38,55-56).
+            time.sleep(self.delay_s)
+        out = np.asarray(self.engine.submit(frames))
+        t1 = time.time()
+        payloads = self._encode(out[:valid])
+        for idx, payload in zip(indices, payloads):
+            self.push.send_multipart([
+                str(idx).encode(), pid,
+                str(t0).encode(), str(t1).encode(),
+                payload,
+            ])
+        self.frames_processed += valid
+        self.batches += 1
+
+    def run(self, max_frames: Optional[int] = None) -> None:
+        """Serve until stop() (or until ``max_frames`` processed — tests).
+
+        Resilience contract (mirrors the reference loops, worker.py:71-76 /
+        distributor.py:249-251): any per-iteration failure — malformed
+        message, codec error, engine error — drops that message/batch,
+        bumps ``errors``, and keeps serving.
+        """
         pid = str(os.getpid()).encode()
         credits = 0
         pending = []  # (frame_index:int, frame_bytes)
         first_recv_t: Optional[float] = None
+        last_reply_t = time.perf_counter()
 
         while not self._stop.is_set():
-            # Keep batch_size READYs outstanding so the app's ROUTER can
-            # stream us frames back-to-back (the reference worker holds
-            # exactly one, worker.py:39-46; credits generalize that).
-            while credits < self.batch_size:
-                self.dealer.send(b"READY")
-                credits += 1
+            try:
+                # Keep batch_size READYs outstanding so the app's ROUTER can
+                # stream us frames back-to-back (the reference worker holds
+                # exactly one, worker.py:39-46; credits generalize that).
+                while credits < self.batch_size:
+                    self.dealer.send(b"READY")
+                    credits += 1
 
-            if self.dealer.poll(self.poll_ms):
-                parts = self.dealer.recv_multipart()
-                # Any reply consumes a credit — even a malformed or control
-                # message. Decrementing only on well-formed frames would
-                # leak that credit forever and eventually starve the READY
-                # replenishment loop above.
-                credits = max(0, credits - 1)
-                if len(parts) == 2:
-                    idx = int(parts[0].decode())
-                    pending.append((idx, parts[1]))
-                    if first_recv_t is None:
-                        first_recv_t = time.perf_counter()
+                if self.dealer.poll(self.poll_ms):
+                    parts = self.dealer.recv_multipart()
+                    last_reply_t = time.perf_counter()
+                    # Any reply consumes a credit — even a malformed or
+                    # control message. Decrementing only on well-formed
+                    # frames would leak that credit forever and starve the
+                    # READY replenishment loop above.
+                    credits = max(0, credits - 1)
+                    if len(parts) == 2:
+                        try:
+                            idx = int(parts[0].decode())
+                        except ValueError:
+                            self.errors += 1
+                        else:
+                            pending.append((idx, parts[1]))
+                            if first_recv_t is None:
+                                first_recv_t = time.perf_counter()
+                    else:
+                        self.errors += 1
+                elif (
+                    credits > 0
+                    and time.perf_counter() - last_reply_t > self.credit_ttl_s
+                ):
+                    # Credits EXPIRE. The reference distributor consumes a
+                    # READY and silently sends no reply whenever it has no
+                    # fresh frame (distributor.py:226-244) — the common
+                    # case between webcam frames — so outstanding credits
+                    # are a claim the server does not honor. The reference
+                    # worker survives by re-sending READY every poll
+                    # timeout (worker.py:38); we do the batched analog:
+                    # after credit_ttl_s without a reply, zero the count so
+                    # the replenish loop above re-issues all READYs.
+                    credits = 0
+                    last_reply_t = time.perf_counter()
 
-            flush = len(pending) >= self.batch_size or (
-                pending
-                and first_recv_t is not None
-                and time.perf_counter() - first_recv_t > self.assemble_timeout_s
-            )
-            if not flush:
-                continue
-
-            t0 = time.time()
-            indices = [i for i, _ in pending]
-            frames = self._decode([b for _, b in pending])
-            valid = len(frames)
-            # Pad to the compiled batch signature (static shapes — one
-            # compilation for every batch size).
-            if valid < self.batch_size:
-                frames = np.concatenate(
-                    [frames, np.repeat(frames[-1:], self.batch_size - valid, 0)]
+                flush = len(pending) >= self.batch_size or (
+                    pending
+                    and first_recv_t is not None
+                    and time.perf_counter() - first_recv_t > self.assemble_timeout_s
                 )
-            out = np.asarray(self.engine.submit(frames))
-            t1 = time.time()
-            payloads = self._encode(out[:valid])
-            for idx, payload in zip(indices, payloads):
-                self.push.send_multipart([
-                    str(idx).encode(), pid,
-                    str(t0).encode(), str(t1).encode(),
-                    payload,
-                ])
-            self.frames_processed += valid
-            self.batches += 1
-            pending = []
-            first_recv_t = None
-            if max_frames is not None and self.frames_processed >= max_frames:
-                break
+                if not flush:
+                    continue
+
+                try:
+                    self._process_batch(pending, pid)
+                finally:
+                    pending = []
+                    first_recv_t = None
+                if max_frames is not None and self.frames_processed >= max_frames:
+                    break
+            except Exception as e:  # noqa: BLE001 — per-iteration containment
+                self.errors += 1
+                print(f"[TpuZmqWorker] error (continuing): {e!r}", file=sys.stderr)
+                # Drop any half-assembled batch; poison inputs must not wedge
+                # the loop by re-raising forever.
+                pending = []
+                first_recv_t = None
 
     def close(self) -> None:
         self._stop.set()

@@ -1,0 +1,24 @@
+"""`import dvf_tpu` must never create a JAX backend client.
+
+With a PJRT sitecustomize pinning an (possibly unreachable) TPU platform at
+interpreter start, any import-time array creation initializes that backend
+before entry points can flip ``jax.config`` — every CLI then hangs inside
+``import``. Round-1's bench failure mode; keep it fixed.
+"""
+
+import subprocess
+import sys
+
+
+def test_import_does_not_initialize_backend():
+    code = (
+        "import os; os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import dvf_tpu\n"
+        "import dvf_tpu.benchmarks, dvf_tpu.cli, dvf_tpu.bench_child\n"
+        "import dvf_tpu.runtime.pipeline, dvf_tpu.transport.zmq_ingress\n"
+        "from jax._src import xla_bridge\n"
+        "raise SystemExit(0 if not xla_bridge.backends_are_initialized() else 3)\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code], timeout=180)
+    assert p.returncode == 0, "importing dvf_tpu initialized a JAX backend"
