@@ -66,6 +66,24 @@ class Filter:
     uint8_ok: bool = False
     halo: Optional[int] = None
     pad_safe: bool = True
+    # Set by FilterChain: the composed stages, in order. Lets spatial
+    # sharding (parallel.halo) exchange halos per stage — exact at global
+    # frame borders even when intermediates aren't reflection-symmetric —
+    # instead of one summed-radius exchange around the fused chain.
+    members: Optional[Tuple["Filter", ...]] = None
+    # Optional mesh-parallelism hooks (used by the Engine):
+    #
+    # state_pspecs() -> PartitionSpec pytree matching init_state's tree.
+    # The engine places state with these specs instead of replicating it —
+    # how a neural filter's weight pytree gets tensor-parallel placement
+    # (specs naming a size-1 mesh axis degrade to replication, so one spec
+    # tree serves every mesh).
+    state_pspecs: Optional[Callable[[], Any]] = None
+    # specialize(mesh, batch_shape) -> Filter | None. Called once per
+    # compile signature; returning a Filter swaps in a mesh-aware body
+    # (e.g. style transfer returns a shard_map'd Megatron-TP forward when
+    # the mesh has a model axis). None = keep the generic body.
+    specialize: Optional[Callable[[Any, Tuple[int, ...]], Optional["Filter"]]] = None
 
     @property
     def stateful(self) -> bool:
@@ -122,4 +140,5 @@ def FilterChain(*filters: Filter, name: Optional[str] = None) -> Filter:
         uint8_ok=all(f.uint8_ok for f in filters) if filters else False,
         halo=chain_halo,
         pad_safe=all(f.pad_safe for f in filters) if filters else True,
+        members=tuple(filters),
     )

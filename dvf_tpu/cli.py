@@ -55,7 +55,10 @@ def cmd_filters(_args) -> int:
 
 
 def cmd_serve(args) -> int:
-    from dvf_tpu.io.sinks import CallbackSink, NullSink
+    import signal
+
+    from dvf_tpu.io.display import LiveTap, SideBySideSink
+    from dvf_tpu.io.sinks import NullSink
     from dvf_tpu.io.sources import SyntheticSource, VideoFileSource, WebcamSource
     from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
 
@@ -69,27 +72,52 @@ def cmd_serve(args) -> int:
     else:
         source = VideoFileSource(args.source, rate=args.rate)
 
+    # Live serving is resilient (one bad frame never kills the stream,
+    # worker.py:71-76 semantics) with the reference's 5 s telemetry prints
+    # (webcam_app.py:88-95,152-163); --fail-fast restores strict mode.
+    config = PipelineConfig(
+        batch_size=args.batch,
+        frame_delay=args.frame_delay,
+        queue_size=args.queue_size,
+        trace=args.trace,
+        resilient=not args.fail_fast,
+        telemetry_interval_s=0.0 if args.quiet else 5.0,
+    )
+
     if args.display:
-        import cv2
-
-        def show(idx, frame, ts):
-            cv2.imshow("dvf_tpu", cv2.cvtColor(frame, cv2.COLOR_RGB2BGR))
-            cv2.waitKey(1)
-
-        sink = CallbackSink(show)
+        tap = LiveTap(source)
+        sink = SideBySideSink(
+            tap,
+            headless=args.headless,
+            telemetry_interval_s=config.telemetry_interval_s,
+        )
+        pipe = Pipeline(tap, filt, sink, config)
+        sink.stop_cb = pipe.stop        # ESC → graceful stop
+        sink.stats_fn = pipe.stats
     else:
         sink = NullSink()
+        pipe = Pipeline(source, filt, sink, config)
 
-    pipe = Pipeline(
-        source, filt, sink,
-        PipelineConfig(
-            batch_size=args.batch,
-            frame_delay=args.frame_delay,
-            queue_size=args.queue_size,
-            trace=args.trace,
-        ),
-    )
-    stats = pipe.run()
+    # SIGINT/SIGTERM → graceful stop; repeat → hard abort (the reference
+    # installs the same pair, webcam_app.py:46-48 / inverter.py:16-17).
+    def _graceful(signum, frame):
+        if pipe._stop_requested.is_set():
+            pipe.abort()
+        else:
+            print(f"\n[serve] signal {signum}: stopping…", file=sys.stderr, flush=True)
+            pipe.stop()
+
+    old = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            old[sig] = signal.signal(sig, _graceful)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+    try:
+        stats = pipe.run()
+    finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
     print(json.dumps({k: v for k, v in stats.items() if not isinstance(v, dict)}, default=float))
     return 0
 
@@ -173,7 +201,13 @@ def main(argv=None) -> int:
     sp.add_argument("--frame-delay", type=int, default=5)
     sp.add_argument("--queue-size", type=int, default=10)
     sp.add_argument("--target-size", type=int, default=512)
-    sp.add_argument("--display", action="store_true")
+    sp.add_argument("--display", action="store_true",
+                    help="side-by-side live|processed window (ESC stops)")
+    sp.add_argument("--headless", action="store_true",
+                    help="with --display: compose panes but open no window")
+    sp.add_argument("--fail-fast", action="store_true",
+                    help="abort on the first error instead of containing it")
+    sp.add_argument("--quiet", action="store_true", help="no 5s telemetry prints")
     sp.add_argument("--trace", action="store_true", help="export Perfetto trace")
 
     wp = sub.add_parser("worker", help="ZMQ worker for the reference app")

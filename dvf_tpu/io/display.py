@@ -1,0 +1,119 @@
+"""Side-by-side live | processed display — the reference's product UX.
+
+webcam_app.py:118-150 renders the live camera feed and the filtered stream
+next to each other in one window (live left, processed right, 2×target
+wide) and prints draw-FPS + buffer stats every 5 s (:152-163). This module
+is that surface for the TPU pipeline:
+
+- :class:`LiveTap` wraps any source and stashes the newest captured frame
+  (the reference's ``self.frame_data`` hand-off between capture thread and
+  draw loop, webcam_app.py:105-106,122-130 — here an explicit lock-free
+  single-cell swap instead of a GIL-tolerated race, SURVEY.md §5.2);
+- :class:`SideBySideSink` composes ``hstack(live, processed)`` per
+  delivered frame, shows it via cv2 (`headless=True` skips the window for
+  tests/CI), maps ESC to the pipeline's graceful stop
+  (webcam_app.py:166-170), and prints the 5 s draw-FPS + stats line.
+
+The processed pane lags the live pane by the pipeline's frame_delay — the
+same visual behavior the reference's reorder buffer produces.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from dvf_tpu.obs.metrics import RateLogger
+
+
+class LiveTap:
+    """Source wrapper: passes frames through, keeping the newest one."""
+
+    def __init__(self, source: Any):
+        self.source = source
+        self.latest: Optional[np.ndarray] = None
+
+    def __iter__(self) -> Iterator:
+        for frame, ts in self.source:
+            if frame is not None:
+                self.latest = frame  # atomic ref swap under the GIL
+            yield frame, ts
+
+
+class SideBySideSink:
+    """live | processed window (reference parity: webcam_app.py:118-164).
+
+    ``stop_cb`` is called on ESC — wire it to ``Pipeline.stop`` for the
+    reference's key-press shutdown (webcam_app.py:166-170). ``stats_fn``
+    (e.g. ``pipeline.stats``) feeds the periodic print.
+    """
+
+    def __init__(
+        self,
+        live_tap: LiveTap,
+        window: str = "dvf_tpu (live | processed)",
+        stop_cb: Optional[Callable[[], None]] = None,
+        stats_fn: Optional[Callable[[], dict]] = None,
+        telemetry_interval_s: float = 5.0,
+        headless: bool = False,
+    ):
+        self.live_tap = live_tap
+        self.window = window
+        self.stop_cb = stop_cb
+        self.stats_fn = stats_fn
+        self.headless = headless
+        self.count = 0
+        self.last_pane: Optional[np.ndarray] = None
+        # interval <= 0 disables telemetry entirely (RateLogger with a 0
+        # interval would fire on every tick, so give it a real interval
+        # and gate the print instead).
+        self._telemetry = telemetry_interval_s > 0
+        self._rate = RateLogger(
+            "draw", telemetry_interval_s if self._telemetry else 5.0, quiet=True
+        )
+        self._window_up = False
+
+    # ------------------------------------------------------------------
+
+    def _compose(self, processed: np.ndarray) -> np.ndarray:
+        live = self.live_tap.latest
+        if live is None:
+            live = np.zeros_like(processed)
+        if live.shape != processed.shape:
+            # Letterbox the live feed into the processed geometry so the
+            # panes always tile (the reference sidesteps this by using one
+            # target_size for both, webcam_app.py:27-31).
+            h, w = processed.shape[:2]
+            boxed = np.zeros_like(processed)
+            lh, lw = min(h, live.shape[0]), min(w, live.shape[1])
+            boxed[:lh, :lw] = live[:lh, :lw]
+            live = boxed
+        return np.hstack([live, processed])
+
+    def emit(self, index: int, processed: np.ndarray, capture_ts: float) -> None:
+        self.count += 1
+        pane = self._compose(processed)
+        self.last_pane = pane
+        if not self.headless:
+            import cv2
+
+            cv2.imshow(self.window, cv2.cvtColor(pane, cv2.COLOR_RGB2BGR))
+            self._window_up = True
+            if cv2.waitKey(1) & 0xFF == 27 and self.stop_cb is not None:
+                self.stop_cb()  # ESC → graceful stop (webcam_app.py:166-170)
+        rate = self._rate.tick()
+        if rate is not None and self._telemetry:
+            stats = self.stats_fn() if self.stats_fn is not None else {}
+            keys = ("buffered", "display_cursor", "latest_received",
+                    "delivered", "dropped_at_ingest")
+            brief = {k: stats[k] for k in keys if k in stats}
+            print(f"[display] {rate:.1f} fps {brief}", file=sys.stderr, flush=True)
+
+    def close(self) -> None:
+        if self._window_up:
+            import cv2
+
+            cv2.destroyWindow(self.window)
+            self._window_up = False

@@ -17,6 +17,7 @@ frame_delivered.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -88,6 +89,14 @@ class Tracer:
         doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
         with open(path, "w") as f:
             json.dump(doc, f)
+        # The reference prints capture/processing FPS stats on every export
+        # (distributor.py:152-171); match that so a traced run ends with
+        # the numbers, not just a file path.
+        stats = self.summarize()
+        if stats:
+            pretty = ", ".join(f"{k}={v:.2f}" for k, v in stats.items())
+            print(f"[trace] exported {len(events)} events to {path} ({pretty})",
+                  file=sys.stderr)
         return path
 
     def summarize(self) -> Dict[str, float]:

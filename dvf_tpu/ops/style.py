@@ -16,9 +16,16 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.models.style_transfer import StyleNetConfig, apply_style_net, init_style_net
+from dvf_tpu.models.style_transfer import (
+    StyleNetConfig,
+    apply_style_net,
+    init_style_net,
+    param_pspecs,
+    tp_inner_apply,
+)
 from dvf_tpu.ops.registry import register_filter
 
 
@@ -30,7 +37,17 @@ def style_transfer(
     seed: int = 0,
 ) -> Filter:
     """``params=None`` → seeded random init (demo/benchmark weights);
-    pass a trained param pytree for real stylization."""
+    pass a trained param pytree for real stylization.
+
+    Tensor parallelism: the filter declares ``state_pspecs`` (the Megatron
+    column/row placement of its weight pytree) and a ``specialize`` hook;
+    on a mesh with a model axis > 1 the Engine swaps in a shard_map'd
+    forward with explicit psum reductions (models.style_transfer.
+    tp_inner_apply) — the same all-manual formulation the train step uses
+    (GSPMD-auto conv partitioning is distrusted on this toolchain, see
+    train.style.make_train_step). Inference TP covers BASELINE.json
+    configs[4] when one chip can't hold the net's activation footprint.
+    """
     config = StyleNetConfig(base_channels=base_channels, n_residual=n_residual)
 
     def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
@@ -41,9 +58,52 @@ def style_transfer(
             return params
         return init_style_net(jax.random.PRNGKey(seed), config)
 
+    name = f"style_transfer(c={base_channels},r={n_residual})"
+
+    def specialize(mesh, batch_shape) -> Optional[Filter]:
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if axes.get("model", 1) <= 1:
+            return None  # generic body; params replicate over size-1 axis
+        inner = tp_inner_apply(config)
+        specs = param_pspecs(config)
+        # Batch folded over (data, space) on dim 0 — mirrors
+        # train.style.train_batch_sharding. The model axis replicates the
+        # batch and owns param shards. shard_map requires dim 0 to divide
+        # the named axes exactly, which the Engine never guarantees —
+        # degrade the fold (data+space → data → replicated) to whatever
+        # the actual batch divides.
+        b = batch_shape[0]
+        d, s = axes.get("data", 1), axes.get("space", 1)
+        if b % (d * s) == 0:
+            batch_spec = P(("data", "space"))
+        elif b % d == 0:
+            batch_spec = P("data")
+        else:
+            batch_spec = P(None)
+
+        def tp_fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+            sharded = jax.shard_map(
+                inner,
+                mesh=mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=batch_spec,
+                check_vma=False,
+            )
+            return sharded(state, batch), state
+
+        return Filter(
+            name=f"tp({name})",
+            fn=tp_fn,
+            init_state=init_state,
+            compute_dtype=jnp.float32,
+            state_pspecs=lambda: specs,
+        )
+
     return Filter(
-        name=f"style_transfer(c={base_channels},r={n_residual})",
+        name=name,
         fn=fn,
         init_state=init_state,
         compute_dtype=jnp.float32,
+        state_pspecs=lambda: param_pspecs(config),
+        specialize=specialize,
     )

@@ -21,8 +21,16 @@ import os
 from typing import Optional
 
 import jax
+import numpy as np
+from jax.sharding import NamedSharding
 
-from dvf_tpu.parallel.mesh import MeshConfig, Mesh, auto_mesh_config, make_mesh
+from dvf_tpu.parallel.mesh import (
+    MeshConfig,
+    Mesh,
+    auto_mesh_config,
+    batch_pspec,
+    make_mesh,
+)
 
 
 def init_distributed(
@@ -66,3 +74,17 @@ def global_mesh(config: Optional[MeshConfig] = None, prefer: str = "data") -> Me
     if config is None:
         config = auto_mesh_config(len(devices), prefer=prefer)
     return make_mesh(config, devices=devices)
+
+
+def host_local_batch(mesh: Mesh, local_batch: np.ndarray) -> jax.Array:
+    """Assemble the GLOBAL sharded frame batch from this host's frames.
+
+    Multi-controller ingestion: each host captures/decodes only its own
+    frames (its slice of the global batch on the ``data`` axis) and
+    contributes them as the shards it can address — no host ever
+    materializes the full batch, and the cross-host movement (if any) is
+    XLA's, over DCN. The single-host pipeline path (`Engine.submit`) keeps
+    using plain `device_put`; this is the multi-host on-ramp.
+    """
+    sharding = NamedSharding(mesh, batch_pspec(mesh, None))
+    return jax.make_array_from_process_local_data(sharding, local_batch)

@@ -18,6 +18,16 @@ reflect-padding only ever touches rows that get discarded, so any
 stencil filter of radius ≤ r composes with this wrapper unchanged. The
 global top/bottom shards substitute reflect-101 rows (cv2's default
 border, matching the unsharded ops) for the missing neighbor.
+
+Chains: for a FilterChain, halos are exchanged **per stage** (one
+``ppermute`` pair per member, inside a single shard_map). A single
+summed-radius exchange around the fused chain is NOT exact at the global
+top/bottom border: edge shards would compute stage2(stage1(reflect(x)))
+where the unsharded chain computes stage2(reflect(stage1(x))) — these
+differ whenever a stage's intermediate is not reflection-symmetric (e.g.
+a directional gradient). Per-stage exchange reproduces the unsharded
+border semantics exactly; pass ``per_stage=False`` to get the cheaper
+fused exchange when you know every intermediate is symmetric.
 """
 
 from __future__ import annotations
@@ -62,18 +72,52 @@ def halo_exchange_rows(x: jnp.ndarray, r: int, axis_name: str = "space") -> jnp.
     return jnp.concatenate([top, x, bot], axis=1)
 
 
-def spatial_filter(filt: Filter, mesh: Mesh, halo: Optional[int] = None) -> Filter:
+def _stage_apply(x: jnp.ndarray, f: Filter) -> jnp.ndarray:
+    """One overlap-and-discard stage on a local slab (inside shard_map)."""
+    r = f.halo
+    if r is None:
+        raise ValueError(f"chain member {f.name!r} has no halo radius")
+    if r > 0:
+        ext = halo_exchange_rows(x, r, "space")
+        y, _ = f.fn(ext, None)
+        return y[:, r:-r]
+    y, _ = f.fn(x, None)
+    return y
+
+
+def spatial_filter(
+    filt: Filter,
+    mesh: Mesh,
+    halo: Optional[int] = None,
+    data_sharded: bool = True,
+    per_stage: Optional[bool] = None,
+) -> Filter:
     """Wrap a stateless stencil filter for H-sharded execution.
 
     The returned Filter's fn is a shard_map over ('data', 'space'): B is
-    sharded over 'data', H over 'space'; each shard halo-exchanges ``r``
-    rows, applies the original filter body to the extended slab, and drops
-    the halo rows of the output. Requires ``filt.halo`` (stencil radius in
-    rows) or an explicit ``halo=``; stateful filters are not supported
-    (state row-sharding is filter-specific).
+    sharded over 'data' (unless ``data_sharded=False``, e.g. the batch
+    doesn't divide the data axis), H over 'space'; each shard
+    halo-exchanges ``r`` rows, applies the original filter body to the
+    extended slab, and drops the halo rows of the output. Requires
+    ``filt.halo`` (stencil radius in rows) or an explicit ``halo=``;
+    stateful filters are not supported (state row-sharding is
+    filter-specific).
+
+    ``per_stage`` (default: auto — on when the filter is a chain with
+    per-member halos): exchange halos per chain member for exact global-
+    border semantics (module docstring). ``False`` forces one fused
+    summed-radius exchange (cheaper, assumes reflection-symmetric
+    intermediates).
     """
     if filt.stateful:
         raise ValueError("spatial_filter supports stateless filters only")
+
+    members = filt.members
+    if per_stage is None:
+        per_stage = (
+            members is not None
+            and all(not m.stateful and m.halo is not None for m in members)
+        )
     r = halo if halo is not None else filt.halo
     if r is None:
         raise ValueError(
@@ -82,15 +126,6 @@ def spatial_filter(filt: Filter, mesh: Mesh, halo: Optional[int] = None) -> Filt
 
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_space = axes.get("space", 1)
-
-    def local_fn(batch: jnp.ndarray, state):
-        if r > 0:
-            ext = halo_exchange_rows(batch, r, "space")
-            y, _ = filt.fn(ext, None)
-            y = y[:, r:-r]
-        else:
-            y, _ = filt.fn(batch, None)
-        return y, state
 
     if n_space == 1:
         return Filter(
@@ -101,11 +136,25 @@ def spatial_filter(filt: Filter, mesh: Mesh, halo: Optional[int] = None) -> Filt
             halo=filt.halo,
         )
 
-    spec = P("data", "space")
+    if per_stage:
+        def local_fn(x: jnp.ndarray) -> jnp.ndarray:
+            for m in members:
+                x = _stage_apply(x, m)
+            return x
+    else:
+        def local_fn(x: jnp.ndarray) -> jnp.ndarray:
+            if r > 0:
+                ext = halo_exchange_rows(x, r, "space")
+                y, _ = filt.fn(ext, None)
+                return y[:, r:-r]
+            y, _ = filt.fn(x, None)
+            return y
+
+    spec = P("data" if data_sharded else None, "space")
 
     def fn(batch: jnp.ndarray, state):
         sharded = jax.shard_map(
-            lambda b: local_fn(b, None)[0],
+            local_fn,
             mesh=mesh,
             in_specs=spec,
             out_specs=spec,

@@ -25,6 +25,7 @@ Key TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Any, Optional, Tuple
 
 import jax
@@ -33,7 +34,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+from dvf_tpu.parallel.halo import spatial_filter
+from dvf_tpu.parallel.mesh import batch_pspec, batch_sharding, make_mesh, replicated
 from dvf_tpu.utils.image import to_float, to_uint8
 
 
@@ -57,6 +59,7 @@ class Engine:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.out_uint8 = out_uint8
         self.stats = EngineStats()
+        self._exec_filter = filt   # possibly halo-wrapped in compile()
         self._step = None
         self._signature: Optional[Tuple] = None
         self._state: Any = None
@@ -65,8 +68,43 @@ class Engine:
 
     # ------------------------------------------------------------------
 
+    def _pick_exec_filter(self, filt: Filter, batch_shape) -> "Filter":
+        """Choose the executed filter + H-axis sharding for this signature.
+
+        GSPMD's automatic spatial partitioning of stencil ops is distrusted
+        on this toolchain (wrong halo values in some conv layouts), so an
+        H-sharded mesh routes stencil filters through the EXPLICIT
+        ppermute halo exchange (parallel.halo.spatial_filter). Pointwise
+        filters (halo == 0) have no halo traffic and stay on plain GSPMD
+        sharding. Filters that can't halo-exchange (stateful, unknown
+        radius, slab thinner than the radius, indivisible H) keep H
+        replicated — correct first, the inefficiency is logged.
+        """
+        pspec = batch_pspec(self.mesh, batch_shape)
+        if pspec[1] != "space" or (filt.halo == 0 and not filt.stateful):
+            return filt  # H unsharded, or pointwise: GSPMD is fine
+        n_space = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["space"]
+        can_halo = (
+            not filt.stateful
+            and filt.halo is not None
+            and batch_shape[1] // n_space > filt.halo
+        )
+        if can_halo:
+            return spatial_filter(
+                filt, self.mesh, data_sharded=(pspec[0] == "data")
+            )
+        # Fall back to replicating H (shard batch only).
+        print(
+            f"[engine] filter {filt.name!r} can't halo-shard H "
+            f"(stateful={filt.stateful}, halo={filt.halo}, "
+            f"H={batch_shape[1]}, space={n_space}); replicating H",
+            file=sys.stderr,
+        )
+        self._sharding = NamedSharding(self.mesh, P(pspec[0], None, None, None))
+        return filt
+
     def _build_step(self, batch_shape, in_dtype):
-        filt = self.filter
+        filt = self._exec_filter
         out_uint8 = self.out_uint8
 
         def step(batch, state):
@@ -79,8 +117,10 @@ class Engine:
                 y = to_uint8(y)
             return y, new_state
 
-        # State sharding: replicate (it's small — e.g. one previous frame).
-        state_shardings = jax.tree.map(lambda _: self._replicated, self._state)
+        # State placement: the filter's declared PartitionSpecs (neural
+        # filters shard their weight pytree over 'model' — tensor
+        # parallelism), else replicate (temporal state is small).
+        state_shardings = self._state_shardings() if filt.stateful else None
         return jax.jit(
             step,
             in_shardings=(self._sharding, state_shardings),
@@ -88,22 +128,44 @@ class Engine:
             donate_argnums=(0, 1),
         )
 
+    def _state_shardings(self):
+        """Sharding (tree or single) for the state pytree; also valid as a
+        jit in/out_shardings prefix and a device_put target."""
+        if self._exec_filter.state_pspecs is not None:
+            return jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._exec_filter.state_pspecs(),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        return self._replicated
+
     def compile(self, batch_shape: Tuple[int, ...], dtype=np.uint8) -> None:
         """Trace + compile for a fixed (B,H,W,C) signature; builds state."""
         sig = (tuple(batch_shape), np.dtype(dtype))
         if sig == self._signature:
             return
         self._sharding = batch_sharding(self.mesh, batch_shape)
+        # Mesh-aware body swap first (e.g. style transfer → shard_map'd
+        # Megatron TP forward when the mesh has a model axis) …
+        base = self.filter
+        if base.specialize is not None:
+            specialized = base.specialize(self.mesh, tuple(batch_shape))
+            if specialized is not None:
+                base = specialized
+        # … then the H-axis halo routing — see _pick_exec_filter.
+        self._exec_filter = self._pick_exec_filter(base, batch_shape)
+
         def fresh_state():
-            if not self.filter.stateful:
+            ef = self._exec_filter
+            if not ef.stateful:
                 return None
             state_dtype = (
-                self.filter.compute_dtype
-                if np.dtype(dtype) == np.uint8 and not self.filter.uint8_ok
+                ef.compute_dtype
+                if np.dtype(dtype) == np.uint8 and not ef.uint8_ok
                 else dtype
             )
             return jax.device_put(
-                self.filter.init_state(batch_shape, state_dtype), self._replicated
+                ef.init_state(batch_shape, state_dtype), self._state_shardings()
             )
 
         self._state = fresh_state()
@@ -144,13 +206,14 @@ class Engine:
         return y
 
     def reset_state(self) -> None:
-        if self.filter.stateful and self._signature is not None:
+        if self._exec_filter.stateful and self._signature is not None:
             shape, dtype = self._signature
+            ef = self._exec_filter
             state_dtype = (
-                self.filter.compute_dtype
-                if dtype == np.uint8 and not self.filter.uint8_ok
+                ef.compute_dtype
+                if dtype == np.uint8 and not ef.uint8_ok
                 else dtype
             )
             self._state = jax.device_put(
-                self.filter.init_state(shape, state_dtype), self._replicated
+                ef.init_state(shape, state_dtype), self._state_shardings()
             )

@@ -99,14 +99,29 @@ class Pipeline:
         self._eof = threading.Event()
         self._dispatch_done = threading.Event()
         self._abort = threading.Event()
+        self._stop_requested = threading.Event()
         self._error: Optional[BaseException] = None
+
+    def stop(self) -> None:
+        """Graceful shutdown: stop ingesting, drain what's in flight,
+        deliver the tail, then run() finishes normally (stats print, sink
+        close, trace export) — the reference's cleanup() path
+        (webcam_app.py:172-180 → distributor.py:356-376). Safe to call
+        from signal handlers, the display's ESC callback, or any thread."""
+        self._stop_requested.set()
+
+    def abort(self) -> None:
+        """Hard stop: drop everything in flight and unwind now (second
+        Ctrl-C semantics)."""
+        self._stop_requested.set()
+        self._abort.set()
 
     # ------------------------------------------------------------------
 
     def _ingest(self) -> None:
         it = iter(self.source)
         try:
-            while not self._abort.is_set():
+            while not self._abort.is_set() and not self._stop_requested.is_set():
                 try:
                     frame, ts = next(it)
                 except StopIteration:
@@ -126,6 +141,14 @@ class Pipeline:
             self._fail(e)
         finally:
             self._eof.set()
+            # Release the source promptly (camera handle — the reference
+            # does cap.release() in cleanup(), webcam_app.py:174-177).
+            # Generator sources run their finally on .close().
+            if hasattr(it, "close"):
+                try:
+                    it.close()
+                except Exception:
+                    pass
 
     def _fail(self, e: BaseException) -> None:
         if self._error is None:
@@ -267,11 +290,27 @@ class Pipeline:
         ]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        while any(t.is_alive() for t in threads):
+            try:
+                for t in threads:
+                    t.join(timeout=0.2)
+            except KeyboardInterrupt:
+                # First Ctrl-C: graceful stop — drain, deliver the tail,
+                # print stats, export the trace (the reference's signal →
+                # cleanup path, webcam_app.py:46-48,62-65). Second: abort.
+                if self._stop_requested.is_set():
+                    self.abort()
+                else:
+                    print("\n[pipeline] stopping (Ctrl-C again to abort)…",
+                          file=sys.stderr, flush=True)
+                    self.stop()
         if self._error is not None:
             raise self._error
-        self._deliver(flush=True)  # drain the trailing frame_delay window
+        if not self._abort.is_set():
+            # Drain the trailing frame_delay window — but not on hard
+            # abort, whose contract is "unwind now", not "emit up to
+            # reorder_capacity buffered frames through the sink first".
+            self._deliver(flush=True)
         self.sink.close()
         if self.tracer.enabled:
             self.tracer.export()

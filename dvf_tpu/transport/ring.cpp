@@ -51,12 +51,16 @@ struct Control {
   std::atomic<uint64_t> tail;
   std::atomic<uint64_t> dropped;
   std::atomic<uint64_t> pushed;
+  std::atomic<uint64_t> popped;
   uint64_t capacity;  // bytes of the data region
   uint32_t magic;
   uint32_t _pad;
 };
 
-constexpr uint32_t kMagic = 0x64766672;  // "dvfr"
+// Bumped ("dvfs") when Control grew the popped counter — a layout change;
+// a stale peer attaching to the old shm layout must be refused, not read
+// garbage offsets.
+constexpr uint32_t kMagic = 0x64766673;  // "dvfs"
 
 struct Ring {
   Control* ctl;
@@ -102,6 +106,7 @@ Ring* make_ring(void* base, uint64_t total, bool init, bool owns_shm, const char
     r->ctl->tail.store(0, std::memory_order_relaxed);
     r->ctl->dropped.store(0, std::memory_order_relaxed);
     r->ctl->pushed.store(0, std::memory_order_relaxed);
+    r->ctl->popped.store(0, std::memory_order_relaxed);
     r->ctl->capacity = total - align_up(sizeof(Control));
     r->ctl->magic = kMagic;
   } else if (r->ctl->magic != kMagic) {
@@ -213,6 +218,7 @@ int64_t ring_pop(Ring* r, uint8_t* buf, uint64_t buflen,
     uint64_t expect = tail;
     if (r->ctl->tail.compare_exchange_strong(expect, next,
                                              std::memory_order_acq_rel)) {
+      r->ctl->popped.fetch_add(1, std::memory_order_relaxed);
       if (frame_index) *frame_index = h.frame_index;
       if (timestamp) *timestamp = h.timestamp;
       return static_cast<int64_t>(h.payload_len);
@@ -222,17 +228,17 @@ int64_t ring_pop(Ring* r, uint8_t* buf, uint64_t buflen,
 }
 
 uint64_t ring_approx_len(Ring* r) {
-  uint64_t tail = r->ctl->tail.load(std::memory_order_acquire);
-  uint64_t head = r->ctl->head.load(std::memory_order_acquire);
-  // Count records by walking; bounded by capacity/header size.
-  uint64_t n = 0;
-  while (tail < head) {
-    RecordHeader h;
-    ring_read(r, tail, &h, sizeof(h));
-    tail += align_up(sizeof(RecordHeader) + h.payload_len);
-    ++n;
-  }
-  return n;
+  // Pure counter arithmetic — no header walk. Walking record headers
+  // raced with the producer: a header mid-overwrite could yield a garbage
+  // payload_len, skipping the walk past head and returning a wrong count.
+  // The three relaxed loads below are each coherent; the combination can
+  // be transiently off by one under concurrent push/pop (hence "approx"),
+  // never garbage.
+  uint64_t pushed = r->ctl->pushed.load(std::memory_order_relaxed);
+  uint64_t dropped = r->ctl->dropped.load(std::memory_order_relaxed);
+  uint64_t popped = r->ctl->popped.load(std::memory_order_relaxed);
+  uint64_t consumed = dropped + popped;
+  return pushed > consumed ? pushed - consumed : 0;
 }
 
 uint64_t ring_dropped(Ring* r) { return r->ctl->dropped.load(std::memory_order_relaxed); }

@@ -2,12 +2,17 @@
 
 Compiles the shared library on first use with g++ (no pybind11 in this
 environment; ctypes keeps the binding dependency-free) and caches the .so
-next to the source, rebuilding when ring.cpp is newer.
+next to the source. Staleness is decided by a CONTENT HASH of ring.cpp
+stored in a sidecar file — not mtimes, which are arbitrary after a fresh
+clone and would let a stale (or tampered) artifact load silently. The .so
+is never committed (.gitignore); it is always the product of the reviewed
+source on this machine.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -16,13 +21,28 @@ from typing import Optional, Tuple
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ring.cpp")
 _LIB = os.path.join(_DIR, "_ring.so")
+_HASH = _LIB + ".srchash"
 _BUILD_LOCK = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
-def _build() -> None:
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(digest: str) -> None:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
+    with open(_HASH, "w") as f:
+        f.write(digest)
+
+
+def _stale(digest: str) -> bool:
+    if not os.path.exists(_LIB) or not os.path.exists(_HASH):
+        return True
+    with open(_HASH) as f:
+        return f.read().strip() != digest
 
 
 def _load() -> ctypes.CDLL:
@@ -32,8 +52,9 @@ def _load() -> ctypes.CDLL:
     with _BUILD_LOCK:
         if _lib is not None:
             return _lib
-        if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            _build()
+        digest = _src_digest()
+        if _stale(digest):
+            _build(digest)
         # PyDLL: keep the GIL across calls. Every ring op is sub-microsecond;
         # releasing/reacquiring the GIL per call (CDLL) causes a handoff
         # convoy (~5 ms each, the interpreter switch interval) as producer

@@ -1,0 +1,112 @@
+"""Display + lifecycle parity: side-by-side composition (webcam_app.py:
+118-150), graceful stop mid-stream with stats + trace export
+(webcam_app.py:166-180 → distributor.py:356-376), CLI serve wiring."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from dvf_tpu.io.display import LiveTap, SideBySideSink
+
+
+def test_live_tap_passthrough_and_latest():
+    frames = [(np.full((4, 4, 3), i, np.uint8), float(i)) for i in range(3)]
+    frames.append((None, 3.0))
+    tap = LiveTap(frames)
+    seen = list(tap)
+    assert len(seen) == 4
+    # latest holds the newest non-None frame
+    np.testing.assert_array_equal(tap.latest, frames[2][0])
+
+
+def test_side_by_side_composition_headless():
+    tap = LiveTap([])
+    tap.latest = np.full((8, 6, 3), 10, np.uint8)
+    sink = SideBySideSink(tap, headless=True)
+    processed = np.full((8, 6, 3), 200, np.uint8)
+    sink.emit(0, processed, time.time())
+    pane = sink.last_pane
+    assert pane.shape == (8, 12, 3)  # live | processed, 2x wide
+    np.testing.assert_array_equal(pane[:, :6], tap.latest)
+    np.testing.assert_array_equal(pane[:, 6:], processed)
+    sink.close()
+
+
+def test_side_by_side_letterboxes_mismatched_live():
+    tap = LiveTap([])
+    tap.latest = np.full((4, 3, 3), 7, np.uint8)
+    sink = SideBySideSink(tap, headless=True)
+    processed = np.zeros((8, 6, 3), np.uint8)
+    sink.emit(0, processed, time.time())
+    assert sink.last_pane.shape == (8, 12, 3)
+    np.testing.assert_array_equal(sink.last_pane[:4, :3], tap.latest)
+
+
+def test_esc_invokes_stop_callback(monkeypatch):
+    """The ESC branch must call stop_cb — drive emit with a fake cv2."""
+    import sys
+    import types
+
+    calls = []
+    fake_cv2 = types.SimpleNamespace(
+        imshow=lambda *a: None,
+        waitKey=lambda *_: 27,
+        cvtColor=lambda img, _: img,
+        COLOR_RGB2BGR=0,
+        destroyWindow=lambda *_: None,
+    )
+    monkeypatch.setitem(sys.modules, "cv2", fake_cv2)
+    tap = LiveTap([])
+    sink = SideBySideSink(tap, headless=False, stop_cb=lambda: calls.append(1))
+    sink.emit(0, np.zeros((4, 4, 3), np.uint8), time.time())
+    assert calls == [1]
+    sink.close()
+
+
+def test_pipeline_graceful_stop_mid_stream(tmp_path, monkeypatch):
+    """stop() from another thread (what SIGINT/ESC call) ends the run
+    cleanly: delivered subset, stats returned, trace exported."""
+    from dvf_tpu.io.sinks import NullSink
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+    monkeypatch.chdir(tmp_path)
+    sink = NullSink()
+    pipe = Pipeline(
+        SyntheticSource(height=16, width=16, n_frames=100_000, rate=200.0),
+        get_filter("invert"),
+        sink,
+        PipelineConfig(batch_size=4, frame_delay=0, queue_size=64, trace=True),
+    )
+
+    def stopper():
+        deadline = time.time() + 30
+        while sink.count < 8 and time.time() < deadline:
+            time.sleep(0.01)
+        pipe.stop()
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    stats = pipe.run()
+    t.join(timeout=5)
+    assert 8 <= stats["delivered"] < 100_000
+    assert os.path.exists("dvf_frame_timing.pftrace")
+
+
+def test_cli_serve_display_headless(capsys):
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--filter", "invert", "--source", "synthetic",
+        "--height", "16", "--width", "16", "--frames", "24",
+        "--batch", "4", "--frame-delay", "0", "--queue-size", "64",
+        "--display", "--headless", "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(out)
+    assert stats["delivered"] == 24

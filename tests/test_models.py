@@ -86,6 +86,57 @@ def test_tp_sharded_forward_matches_replicated():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2)
 
 
+def test_style_engine_tp_matches_replicated():
+    """VERDICT item 7: style-transfer inference must get real TP *through
+    the Engine* — the Engine honors the filter's state PartitionSpecs and
+    swaps in the shard_map'd TP forward on a model-sharded mesh, matching
+    the replicated single-device forward."""
+    import numpy as np
+
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.runtime.engine import Engine
+
+    x = np.random.default_rng(0).integers(0, 255, (2, 32, 32, 3), np.uint8)
+
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    eng = Engine(get_filter("style_transfer", base_channels=8, n_residual=2),
+                 mesh=mesh)
+    eng.compile(x.shape, np.uint8)
+    assert eng._exec_filter.name.startswith("tp("), eng._exec_filter.name
+    # Weight pytree actually lands model-sharded on device:
+    stem_w = eng._state["stem"]["w"]
+    assert stem_w.sharding.spec == P(None, None, None, "model"), stem_w.sharding
+    got = np.asarray(eng.submit(x))
+
+    ref = Engine(get_filter("style_transfer", base_channels=8, n_residual=2),
+                 mesh=make_mesh(MeshConfig()))
+    want = np.asarray(ref.submit(x))
+    # bfloat16 trunk: sharded psum order differs; uint8 outputs may differ
+    # by a couple of levels.
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 3
+
+
+def test_style_engine_tp_with_space_axis_and_odd_batch():
+    """The TP fold must degrade to whatever the batch divides: B=2 on a
+    (data=1, space=4, model=2) mesh can't fold over data*space=4 — it must
+    still compile (batch replicated over the fold) and match."""
+    import numpy as np
+
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.runtime.engine import Engine
+
+    x = np.random.default_rng(1).integers(0, 255, (2, 32, 32, 3), np.uint8)
+    mesh = make_mesh(MeshConfig(data=1, space=4, model=2))
+    eng = Engine(get_filter("style_transfer", base_channels=8, n_residual=2),
+                 mesh=mesh)
+    got = np.asarray(eng.submit(x))
+
+    ref = Engine(get_filter("style_transfer", base_channels=8, n_residual=2),
+                 mesh=make_mesh(MeshConfig()))
+    want = np.asarray(ref.submit(x))
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 3
+
+
 def test_upsample_nearest():
     x = jnp.arange(4.0).reshape(1, 2, 2, 1)
     y = upsample_nearest(x, 2)
