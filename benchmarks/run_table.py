@@ -1,0 +1,187 @@
+"""Run the full BASELINE.json benchmark table and write results to disk.
+
+Produces ``benchmarks/BENCH_TABLE.json`` (machine) and
+``benchmarks/BENCH_TABLE.md`` (human): device-resident fps + e2e latency
+per config, plus the Pallas-vs-jnp bilateral comparison, with the faster
+implementation marked. Same reliability scheme as bench.py: each config
+runs in a bounded subprocess (a hang or crash records an error entry
+instead of killing the table).
+
+Usage: python benchmarks/run_table.py [--cpu] [--out-dir benchmarks]
+       [--timeout 420] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# cli.BENCH_CONFIGS keys, in table order.
+TABLE = [
+    "invert_640x480",
+    "invert_1080p",
+    "gauss3_1080p",
+    "gauss9_1080p",
+    "sobel_bilateral_1080p",
+    "flow_720p",
+    "style_720p",
+]
+
+
+def _run(cmd, env, timeout):
+    try:
+        p = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                           stderr=subprocess.PIPE, timeout=timeout, text=True,
+                           cwd=REPO)
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        def _s(x):
+            if x is None:
+                return ""
+            return x.decode(errors="replace") if isinstance(x, bytes) else x
+        return -9, _s(e.stdout), _s(e.stderr) + f"\n[timeout {timeout}s]"
+
+
+def _last_json(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def bench_config(config: str, env, timeout: float, iters: int, frames: int,
+                 e2e: bool, batch: int = 0) -> dict:
+    cmd = [sys.executable, "-m", "dvf_tpu", "bench", "--config", config,
+           "--iters", str(iters), "--frames", str(frames)]
+    if batch:
+        cmd += ["--batch", str(batch)]
+    if e2e:
+        cmd.append("--e2e")
+    rc, out, err = _run(cmd, env, timeout)
+    parsed = _last_json(out)
+    if parsed is None:
+        tail = "\n".join(err.strip().splitlines()[-6:])
+        return {"error": f"rc={rc}: {tail}"}
+    return parsed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX_PLATFORMS=cpu (validation / fallback run)")
+    ap.add_argument("--out-dir", default=os.path.join(REPO, "benchmarks"))
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--frames", type=int, default=256)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny iteration counts (mechanics check)")
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ)
+    if args.cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DVF_FORCE_PLATFORM"] = "cpu"
+    iters = 5 if args.quick else args.iters
+    frames = 16 if args.quick else args.frames
+    batch = 2 if args.quick else 0
+
+    t0 = time.time()
+    results = {}
+    for name in TABLE:
+        print(f"[table] {name}: device…", file=sys.stderr, flush=True)
+        dev = bench_config(name, env, args.timeout, iters, frames,
+                           e2e=False, batch=batch)
+        print(f"[table] {name}: e2e…", file=sys.stderr, flush=True)
+        e2e = bench_config(name, env, args.timeout, iters, frames,
+                           e2e=True, batch=batch)
+        results[name] = {"device": dev, "e2e": e2e}
+        print(f"[table] {name}: device={dev.get('value', dev.get('error'))} "
+              f"e2e={e2e.get('value', e2e.get('error'))}", file=sys.stderr,
+              flush=True)
+
+    # Pallas vs jnp bilateral: same shape, both impls, pick the winner.
+    # (On a forced-CPU validation run the Pallas kernel runs in interpret
+    # mode — mechanics only, not a perf datapoint.)
+    print("[table] bilateral impl comparison…", file=sys.stderr, flush=True)
+    comparison = {}
+    for impl, fname in (("jnp", "bilateral"), ("pallas", "bilateral_pallas")):
+        kw = ", interpret=True" if (args.cpu and impl == "pallas") else ""
+        code = (
+            "import json, sys\n"
+            "from dvf_tpu.cli import _force_platform\n"
+            "_force_platform()\n"
+            "from dvf_tpu.benchmarks import bench_device_resident\n"
+            "from dvf_tpu.ops import get_filter\n"
+            f"r = bench_device_resident(get_filter({fname!r}{kw}), {iters}, {batch or 8}, 1080, 1920)\n"
+            "print(json.dumps({'fps': round(r['fps'],1), 'ms_per_frame': round(r['ms_per_frame'],4)}))\n"
+        )
+        rc, out, err = _run([sys.executable, "-c", code], env, args.timeout)
+        parsed = _last_json(out)
+        comparison[impl] = parsed if parsed else {
+            "error": f"rc={rc}: " + "\n".join(err.strip().splitlines()[-4:])
+        }
+    fps = {k: v.get("fps", 0) for k, v in comparison.items()}
+    comparison["winner"] = max(fps, key=fps.get) if any(fps.values()) else "n/a"
+
+    doc = {
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "platform_forced_cpu": bool(args.cpu),
+        "wall_s": round(time.time() - t0, 1),
+        "iters": iters,
+        "frames": frames,
+        "configs": results,
+        "bilateral_impl_comparison": comparison,
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    json_path = os.path.join(args.out_dir, "BENCH_TABLE.json")
+    with open(json_path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+    lines = [
+        "# Benchmark table — BASELINE.json configs",
+        "",
+        f"Generated {doc['timestamp']} · "
+        + ("**CPU (forced — validation run, not the TPU numbers)**"
+           if args.cpu else "TPU") + f" · {doc['wall_s']}s wall",
+        "",
+        "| config | device fps | ms/frame | e2e fps | p50 ms | p99 ms |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, r in results.items():
+        d, e = r["device"], r["e2e"]
+        lines.append(
+            f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
+            f"| {e.get('value', 'ERR')} | {e.get('p50_ms', '—')} "
+            f"| {e.get('p99_ms', '—')} |"
+        )
+    lines += [
+        "",
+        "## Bilateral implementation (1080p, batch 8)",
+        "",
+        "| impl | fps | ms/frame |",
+        "|---|---|---|",
+    ]
+    for impl in ("jnp", "pallas"):
+        c = comparison[impl]
+        lines.append(f"| {impl} | {c.get('fps', 'ERR')} | {c.get('ms_per_frame', '—')} |")
+    lines.append(f"\nWinner: **{comparison['winner']}**")
+    md_path = os.path.join(args.out_dir, "BENCH_TABLE.md")
+    with open(md_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps({"written": [json_path, md_path], "wall_s": doc["wall_s"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,19 @@ BENCH_CONFIGS = {
 }
 
 
+def _force_platform() -> None:
+    """Honor DVF_FORCE_PLATFORM by flipping jax.config before first backend
+    use — env vars alone are overridden by a PJRT sitecustomize that pins a
+    (possibly unreachable) TPU platform (see dvf_tpu.bench_child)."""
+    import os
+
+    platform = os.environ.get("DVF_FORCE_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def _parse_filter_arg(name: str, config_json: Optional[str]):
     from dvf_tpu.ops import get_filter
 
@@ -82,6 +95,7 @@ def cmd_serve(args) -> int:
         trace=args.trace,
         resilient=not args.fail_fast,
         telemetry_interval_s=0.0 if args.quiet else 5.0,
+        device_trace_dir=args.device_trace,
     )
 
     if args.display:
@@ -151,6 +165,8 @@ def cmd_worker(args) -> int:
 
 
 def cmd_bench(args) -> int:
+    _force_platform()
+
     from dvf_tpu.benchmarks import bench_device_resident, bench_e2e_streaming
     from dvf_tpu.ops import get_filter
 
@@ -183,6 +199,79 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    """Train the style net on synthetic (or video) frames; checkpoint and
+    resume. The reference has no training story at all — this covers the
+    framework's checkpoint/resume subsystem (SURVEY.md §5.4)."""
+    import os
+
+    _force_platform()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.models import StyleNetConfig
+    from dvf_tpu.models.vgg import VGGConfig
+    from dvf_tpu.parallel.mesh import make_mesh
+    from dvf_tpu.train import StyleTrainConfig, init_train_state, make_train_step
+    from dvf_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+    from dvf_tpu.train.style import shard_train_state, train_batch_sharding
+
+    config = StyleTrainConfig(
+        net=StyleNetConfig(base_channels=args.base_channels, n_residual=args.n_residual),
+        vgg=VGGConfig(),
+        learning_rate=args.lr,
+    )
+    # Data axis must divide the batch (the train step folds the batch over
+    # (data, space)); unused devices idle rather than erroring.
+    import math
+
+    from dvf_tpu.parallel.mesh import MeshConfig
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=math.gcd(args.batch, n_dev)))
+    src = SyntheticSource(height=args.size, width=args.size,
+                          n_frames=args.steps * args.batch, rate=0.0)
+    frames = iter(src)
+
+    style_img = jnp.full((1, args.size, args.size, 3), 0.3, jnp.float32)
+    state = init_train_state(jax.random.PRNGKey(args.seed), style_img, config)
+    if args.resume:
+        if not os.path.isdir(args.resume):
+            # A typo'd path must not silently restart from scratch.
+            print(f"error: --resume path {args.resume!r} does not exist",
+                  file=sys.stderr)
+            return 2
+        state = restore_checkpoint(args.resume, state, mesh=mesh, config=config)
+        print(f"resumed from {args.resume} at step {int(state.step)}", file=sys.stderr)
+    else:
+        state = shard_train_state(state, mesh, config)
+    step_fn = make_train_step(mesh, config, state_template=state)
+
+    start = int(state.step)
+    for i in range(start, args.steps):
+        batch_np = np.stack([
+            next(frames)[0] for _ in range(args.batch)
+        ]).astype(np.float32) / 255.0
+        batch = jax.device_put(batch_np, train_batch_sharding(mesh))
+        state, metrics = step_fn(state, batch)
+        if (i + 1) % args.log_every == 0:
+            print(f"step {i + 1}: loss={float(metrics['loss']):.5f}", file=sys.stderr)
+        if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
+            path = os.path.join(args.checkpoint_dir, f"step_{i + 1:06d}")
+            save_checkpoint(path, state)
+            print(f"checkpointed {path}", file=sys.stderr)
+    final_loss = float(metrics["loss"]) if args.steps > start else float("nan")
+    if args.checkpoint_dir:
+        path = os.path.join(args.checkpoint_dir, "final")
+        save_checkpoint(path, state)
+        print(f"checkpointed {path}", file=sys.stderr)
+    print(json.dumps({"steps": args.steps, "final_loss": final_loss}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="dvf_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -209,6 +298,8 @@ def main(argv=None) -> int:
                     help="abort on the first error instead of containing it")
     sp.add_argument("--quiet", action="store_true", help="no 5s telemetry prints")
     sp.add_argument("--trace", action="store_true", help="export Perfetto trace")
+    sp.add_argument("--device-trace", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace into DIR")
 
     wp = sub.add_parser("worker", help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")
@@ -223,6 +314,19 @@ def main(argv=None) -> int:
                     help="fault injection: sleep this many seconds per batch "
                          "(simulate a slow worker, like inverter.py --delay)")
 
+    tp = sub.add_parser("train", help="train the style net (checkpoint/resume)")
+    tp.add_argument("--steps", type=int, default=50)
+    tp.add_argument("--batch", type=int, default=4)
+    tp.add_argument("--size", type=int, default=64, help="square frame size")
+    tp.add_argument("--base-channels", type=int, default=8)
+    tp.add_argument("--n-residual", type=int, default=2)
+    tp.add_argument("--lr", type=float, default=1e-3)
+    tp.add_argument("--seed", type=int, default=0)
+    tp.add_argument("--log-every", type=int, default=10)
+    tp.add_argument("--checkpoint-dir", default=None)
+    tp.add_argument("--checkpoint-every", type=int, default=25)
+    tp.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+
     bp = sub.add_parser("bench", help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")
     bp.add_argument("--iters", type=int, default=200)
@@ -231,7 +335,10 @@ def main(argv=None) -> int:
     bp.add_argument("--e2e", action="store_true")
 
     args = ap.parse_args(argv)
-    return {"filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker, "bench": cmd_bench}[args.cmd](args)
+    return {
+        "filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker,
+        "bench": cmd_bench, "train": cmd_train,
+    }[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -59,6 +59,9 @@ class PipelineConfig:
     #   worker.py:71-76). Off by default so tests/benches fail fast.
     telemetry_interval_s: float = 0.0  # >0: print capture/deliver fps every
     #   N s, like the reference's 5 s prints (webcam_app.py:88-95,152-163)
+    device_trace_dir: Optional[str] = None  # capture a jax.profiler device
+    #   trace for the whole run into this dir — Perfetto-compatible, views
+    #   alongside the host-side frame-lifecycle trace (obs.trace) in one UI
 
 
 class Pipeline:
@@ -283,27 +286,42 @@ class Pipeline:
 
     def run(self) -> dict:
         """Run to stream end (or Ctrl-C); returns a stats summary."""
+        device_tracing = False
+        if self.config.device_trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self.config.device_trace_dir)
+            device_tracing = True
         threads = [
             threading.Thread(target=self._ingest, name="dvf-ingest", daemon=True),
             threading.Thread(target=self._dispatch, name="dvf-dispatch", daemon=True),
             threading.Thread(target=self._collect, name="dvf-collect", daemon=True),
         ]
-        for t in threads:
-            t.start()
-        while any(t.is_alive() for t in threads):
-            try:
-                for t in threads:
-                    t.join(timeout=0.2)
-            except KeyboardInterrupt:
-                # First Ctrl-C: graceful stop — drain, deliver the tail,
-                # print stats, export the trace (the reference's signal →
-                # cleanup path, webcam_app.py:46-48,62-65). Second: abort.
-                if self._stop_requested.is_set():
-                    self.abort()
-                else:
-                    print("\n[pipeline] stopping (Ctrl-C again to abort)…",
-                          file=sys.stderr, flush=True)
-                    self.stop()
+        try:
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                try:
+                    for t in threads:
+                        t.join(timeout=0.2)
+                except KeyboardInterrupt:
+                    # First Ctrl-C: graceful stop — drain, deliver the
+                    # tail, print stats, export the trace (the reference's
+                    # signal → cleanup path, webcam_app.py:46-48,62-65).
+                    # Second: abort.
+                    if self._stop_requested.is_set():
+                        self.abort()
+                    else:
+                        print("\n[pipeline] stopping (Ctrl-C again to abort)…",
+                              file=sys.stderr, flush=True)
+                        self.stop()
+        finally:
+            # Always stop the profiler — the abort path (double Ctrl-C /
+            # escaping exception) is exactly the run someone inspects.
+            if device_tracing:
+                import jax
+
+                jax.profiler.stop_trace()
         if self._error is not None:
             raise self._error
         if not self._abort.is_set():

@@ -1,0 +1,59 @@
+"""Checkpoint / resume for style-transfer training (orbax-backed).
+
+The reference has nothing persistent (SURVEY.md §5.4 — its pipeline is
+stateless per frame); the framework's training loop does: net params, adam
+moments, frozen VGG weights, target Grams, step counter. Orbax writes the
+whole TrainState pytree; restore takes the abstract template (from
+``init_train_state``) so dtypes/shapes — and on restore-onto-a-mesh, the
+shardings — come back exactly.
+
+Checkpoints are standard orbax directories: resumable across processes and
+readable by any orbax tool.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from dvf_tpu.train.style import StyleTrainConfig, TrainState, shard_train_state
+
+
+def save_checkpoint(path: str, state: TrainState) -> str:
+    """Write ``state`` to ``path`` (an empty/new directory). Blocking."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        # force=True: re-writing "final" (or a colliding step dir) on a
+        # resumed run must overwrite, not crash the end of training.
+        ckptr.save(path, jax.device_get(state), force=True)
+    return path
+
+
+def restore_checkpoint(
+    path: str,
+    template: TrainState,
+    mesh=None,
+    config: Optional[StyleTrainConfig] = None,
+) -> TrainState:
+    """Load a TrainState from ``path``.
+
+    ``template`` (e.g. a fresh ``init_train_state``) supplies the pytree
+    structure. With ``mesh`` + ``config`` the restored state is placed
+    straight onto the mesh per ``state_pspecs`` (resume-on-slice).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=jax.device_get(template))
+    state = TrainState(**{
+        f: getattr(restored, f) if hasattr(restored, f) else restored[f]
+        for f in ("params", "opt_state", "vgg_params", "style_grams", "step")
+    }) if not isinstance(restored, TrainState) else restored
+    if mesh is not None:
+        state = shard_train_state(state, mesh, config or StyleTrainConfig())
+    return state

@@ -139,6 +139,21 @@ class TestPipelineEndToEnd:
         assert stats["engine_batches"] <= 8
 
 
+def test_device_trace_capture(tmp_path):
+    """device_trace_dir captures a jax.profiler trace alongside the run —
+    the Perfetto-mergeable device half of the tracing story (obs.trace is
+    the host half)."""
+    from dvf_tpu.ops import get_filter
+
+    _, stats = run_pipeline(
+        get_filter("invert"), n_frames=8, frame_delay=0,
+        device_trace_dir=str(tmp_path / "devtrace"),
+    )
+    assert stats["delivered"] == 8
+    found = list((tmp_path / "devtrace").rglob("*"))
+    assert any(f.is_file() for f in found), "no device trace written"
+
+
 class TestEngineMesh:
     def test_data_parallel_mesh(self):
         """8 virtual CPU devices, batch sharded over the data axis."""
