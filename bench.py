@@ -37,9 +37,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
+
+from benchtools import last_json_line, run_cmd as _run, tail as _tail
 
 PROBE_CODE = (
     "import jax; d = jax.devices(); "
@@ -52,27 +53,6 @@ def _log(msg: str) -> None:
 
 
 _T0 = time.perf_counter()
-
-
-def _run(cmd, env, timeout):
-    """Run a child; returns (rc, stdout, stderr). rc=-9 on timeout."""
-    try:
-        p = subprocess.run(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            timeout=timeout, text=True,
-        )
-        return p.returncode, p.stdout, p.stderr
-    except subprocess.TimeoutExpired as e:
-        def _s(x):
-            if x is None:
-                return ""
-            return x.decode(errors="replace") if isinstance(x, bytes) else x
-        return -9, _s(e.stdout), _s(e.stderr) + f"\n[killed: timeout after {timeout}s]"
-
-
-def _tail(s: str, n: int = 12) -> str:
-    lines = [ln for ln in s.strip().splitlines() if ln.strip()]
-    return "\n".join(lines[-n:])
 
 
 def probe_backend(timeout: float, attempts: int = 2):
@@ -95,13 +75,9 @@ def run_bench_child(child_args, env, timeout):
     """Run bench_child; returns (result_dict_or_None, error_or_None)."""
     cmd = [sys.executable, "-m", "dvf_tpu.bench_child", *child_args]
     rc, out, err = _run(cmd, env, timeout)
-    for line in reversed(out.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                continue
+    parsed = last_json_line(out)
+    if parsed is not None:
+        return parsed, None
     return None, f"child rc={rc}; stderr tail:\n{_tail(err)}"
 
 

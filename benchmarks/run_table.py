@@ -17,11 +17,13 @@ import argparse
 import datetime
 import json
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchtools import last_json_line as _last_json, run_cmd, tail  # noqa: E402
 
 # cli.BENCH_CONFIGS keys, in table order.
 TABLE = [
@@ -36,28 +38,7 @@ TABLE = [
 
 
 def _run(cmd, env, timeout):
-    try:
-        p = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
-                           stderr=subprocess.PIPE, timeout=timeout, text=True,
-                           cwd=REPO)
-        return p.returncode, p.stdout, p.stderr
-    except subprocess.TimeoutExpired as e:
-        def _s(x):
-            if x is None:
-                return ""
-            return x.decode(errors="replace") if isinstance(x, bytes) else x
-        return -9, _s(e.stdout), _s(e.stderr) + f"\n[timeout {timeout}s]"
-
-
-def _last_json(out: str):
-    for line in reversed(out.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return None
+    return run_cmd(cmd, env, timeout, cwd=REPO)
 
 
 def bench_config(config: str, env, timeout: float, iters: int, frames: int,
@@ -71,8 +52,7 @@ def bench_config(config: str, env, timeout: float, iters: int, frames: int,
     rc, out, err = _run(cmd, env, timeout)
     parsed = _last_json(out)
     if parsed is None:
-        tail = "\n".join(err.strip().splitlines()[-6:])
-        return {"error": f"rc={rc}: {tail}"}
+        return {"error": f"rc={rc}: {tail(err, 6)}"}
     return parsed
 
 

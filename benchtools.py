@@ -1,0 +1,45 @@
+"""Subprocess helpers shared by bench.py and benchmarks/run_table.py.
+
+Deliberately free of jax (and dvf_tpu) imports: the orchestrator processes
+must stay backend-free so a hanging TPU init can never take them down —
+all device work happens in timeout-bounded children.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Optional, Tuple
+
+
+def run_cmd(cmd, env, timeout, cwd=None) -> Tuple[int, str, str]:
+    """Run a child process; (rc, stdout, stderr). rc=-9 on timeout."""
+    try:
+        p = subprocess.run(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout, text=True, cwd=cwd,
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        def _s(x):
+            if x is None:
+                return ""
+            return x.decode(errors="replace") if isinstance(x, bytes) else x
+        return -9, _s(e.stdout), _s(e.stderr) + f"\n[killed: timeout after {timeout}s]"
+
+
+def last_json_line(out: str) -> Optional[dict]:
+    """Parse the last JSON-object line of a child's stdout."""
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def tail(s: str, n: int = 12) -> str:
+    lines = [ln for ln in s.strip().splitlines() if ln.strip()]
+    return "\n".join(lines[-n:])

@@ -56,7 +56,6 @@ class TpuZmqWorker:
         codec_threads: int = 4,
         engine: Optional[Engine] = None,
         poll_ms: int = 10,
-        credit_ttl_s: float = 0.05,
         delay_s: float = 0.0,
     ):
         import zmq
@@ -82,7 +81,6 @@ class TpuZmqWorker:
         self.use_jpeg = use_jpeg
         self.raw_size = raw_size
         self.poll_ms = poll_ms
-        self.credit_ttl_s = credit_ttl_s
         self.delay_s = delay_s
         self.frames_processed = 0
         self.batches = 0
@@ -154,7 +152,6 @@ class TpuZmqWorker:
         credits = 0
         pending = []  # (frame_index:int, frame_bytes)
         first_recv_t: Optional[float] = None
-        last_reply_t = time.perf_counter()
 
         while not self._stop.is_set():
             try:
@@ -167,7 +164,6 @@ class TpuZmqWorker:
 
                 if self.dealer.poll(self.poll_ms):
                     parts = self.dealer.recv_multipart()
-                    last_reply_t = time.perf_counter()
                     # Any reply consumes a credit — even a malformed or
                     # control message. Decrementing only on well-formed
                     # frames would leak that credit forever and starve the
@@ -184,21 +180,21 @@ class TpuZmqWorker:
                                 first_recv_t = time.perf_counter()
                     else:
                         self.errors += 1
-                elif (
-                    credits > 0
-                    and time.perf_counter() - last_reply_t > self.credit_ttl_s
-                ):
-                    # Credits EXPIRE. The reference distributor consumes a
-                    # READY and silently sends no reply whenever it has no
-                    # fresh frame (distributor.py:226-244) — the common
-                    # case between webcam frames — so outstanding credits
-                    # are a claim the server does not honor. The reference
-                    # worker survives by re-sending READY every poll
-                    # timeout (worker.py:38); we do the batched analog:
-                    # after credit_ttl_s without a reply, zero the count so
-                    # the replenish loop above re-issues all READYs.
-                    credits = 0
-                    last_reply_t = time.perf_counter()
+                else:
+                    # Credits DECAY on every poll timeout. The reference
+                    # distributor consumes one READY per ~poll iteration
+                    # and silently sends no reply whenever it has no fresh
+                    # frame (distributor.py:226-244) — the common case
+                    # between webcam frames — so outstanding credits are a
+                    # claim the server forgets at about one per poll
+                    # interval. The reference worker survives by re-sending
+                    # READY every poll timeout (worker.py:38); the batched
+                    # analog is to decay one credit per quiet poll, which
+                    # makes the replenish loop above re-issue one READY at
+                    # the same cadence. A fixed long expiry deadlocks
+                    # nothing but starves the latest-wins slot: frames get
+                    # overwritten while the worker sits on phantom credits.
+                    credits = max(0, credits - 1)
 
                 flush = len(pending) >= self.batch_size or (
                     pending

@@ -41,7 +41,6 @@ def _mk_worker(app, **kw):
         batch_size=4,
         use_jpeg=False,
         raw_size=16,
-        credit_ttl_s=0.05,
     )
     defaults.update(kw)
     return TpuZmqWorker(get_filter("invert"), **defaults)
