@@ -42,23 +42,33 @@ class SyntheticSource:
         self.rate = rate
         self.motion = motion
         rng = np.random.default_rng(seed)
-        # One textured base frame; per-frame variation is a cheap roll +
-        # brightness ramp so generation never bottlenecks the pipeline.
+        # One textured base frame; per-frame variation is a cyclic roll +
+        # brightness ramp. The rolls are PRE-COMPUTED (a small cycle of
+        # distinct frames served round-robin as read-only views): an
+        # unthrottled 1080p source doing a fresh 6 MB np.roll copy per frame
+        # burns ~1 GB/s of host bandwidth + GIL inside the ingest thread and
+        # becomes the pipeline bottleneck it exists to measure around.
         base = rng.integers(0, 255, size=(height, width, channels), dtype=np.uint8)
         ramp = np.linspace(0, 255, width, dtype=np.uint8)[None, :, None]
         self._base = (base // 2 + ramp // 2).astype(np.uint8)
+        n_cycle = min(16, n_frames) if motion else 1
+        self._cycle = [
+            np.roll(self._base, (i * 2) % self.width, axis=1) for i in range(n_cycle)
+        ]
+        for f in self._cycle:
+            f.setflags(write=False)  # served as shared views — keep them immutable
 
     def __iter__(self) -> Iterator[Frame]:
         period = 1.0 / self.rate if self.rate > 0 else 0.0
         next_t = time.perf_counter()
+        n_cycle = len(self._cycle)
         for i in range(self.n_frames):
             if period:
                 now = time.perf_counter()
                 if now < next_t:
                     time.sleep(next_t - now)
                 next_t += period
-            frame = np.roll(self._base, (i * 2) % self.width, axis=1) if self.motion else self._base
-            yield frame, time.time()
+            yield self._cycle[i % n_cycle], time.time()
         yield None, time.time()
 
 

@@ -97,6 +97,7 @@ class Pipeline:
         _ti = self.config.telemetry_interval_s
         self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
+        self._staging: Optional[list] = None
         self._inflight: "DropOldestQueue" = DropOldestQueue(maxsize=1_000_000)
         self._inflight_sem = threading.Semaphore(self.config.max_inflight)
         self._eof = threading.Event()
@@ -200,7 +201,26 @@ class Pipeline:
             return None
         return items
 
+    def _staging_for(self, frame: np.ndarray, slot: int) -> np.ndarray:
+        """Preallocated batch staging buffers, one per in-flight slot.
+
+        `np.stack` per batch allocates + zero-fills a fresh multi-MB array
+        on the hot path; reusing a pool removes the allocator from the
+        loop. Pool size is max_inflight + 1: the semaphore guarantees at
+        most max_inflight batches outstanding, so the buffer being rewritten
+        belongs to a batch that has already been collected (its device_put
+        finished long ago).
+        """
+        shape = (self.config.batch_size, *frame.shape)
+        if self._staging is None or self._staging[0].shape != shape or self._staging[0].dtype != frame.dtype:
+            self._staging = [
+                np.empty(shape, dtype=frame.dtype)
+                for _ in range(self.config.max_inflight + 1)
+            ]
+        return self._staging[slot % len(self._staging)]
+
     def _dispatch(self) -> None:
+        seq = 0
         try:
             while not self._abort.is_set():
                 items = self._assemble()
@@ -210,27 +230,39 @@ class Pipeline:
                     continue
                 b = self.config.batch_size
                 valid = len(items)
-                frames = [f for _, f, _ in items]
-                # Pad short batches by repeating the last frame — static
-                # shapes mean one compilation; padded outputs are dropped
-                # (and repeat-last keeps temporal state correct, see
-                # Filter.pad_safe).
-                while len(frames) < b:
-                    frames.append(frames[-1])
                 # Bounded in-flight depth; poll so a dead collect thread
                 # (which stops releasing permits) can't wedge dispatch.
+                # Acquired BEFORE touching the staging buffer — the permit
+                # is what makes buffer reuse safe (see _staging_for).
                 while not self._inflight_sem.acquire(timeout=0.1):
                     if self._abort.is_set():
                         return
                 try:
-                    batch = np.stack(frames)
+                    batch = self._staging_for(items[0][1], seq)
+                    for row, (_, frame, _) in enumerate(items):
+                        np.copyto(batch[row], frame)
+                    # Pad short batches by repeating the last frame — static
+                    # shapes mean one compilation; padded outputs are dropped
+                    # (and repeat-last keeps temporal state correct, see
+                    # Filter.pad_safe).
+                    for row in range(valid, b):
+                        np.copyto(batch[row], batch[valid - 1])
                     t0 = time.time()
                     result = self.engine.submit(batch)
+                    # Start the D2H transfer now, overlapped with the next
+                    # batch's staging + device compute; the collect thread's
+                    # np.asarray then only waits for completion instead of
+                    # initiating the copy.
+                    try:
+                        result.copy_to_host_async()
+                    except AttributeError:
+                        pass
                 except Exception as e:  # noqa: BLE001 — drop this batch
                     self._inflight_sem.release()
                     if not self._contain(e, "dispatch"):
                         return
                     continue
+                seq += 1
                 meta = [(idx, ts) for idx, _, ts in items]
                 self._inflight.put((meta, valid, result, t0))
         except BaseException as e:  # noqa: BLE001
