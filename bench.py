@@ -4,32 +4,40 @@ Prints ONE JSON line:
 
     {"metric": "1080p_invert", "value": <device fps>, "unit": "fps",
      "vs_baseline": value/2000, "p50_latency_ms": ..., "p99_latency_ms": ...,
-     "e2e_fps": ..., "backend": "tpu"|"cpu", "fallback": bool, "error": ...}
+     "e2e_fps": ..., "link_roofline_fps": ..., "backend": "tpu"|"cpu",
+     "fallback": bool, "error": ...}
 
 ``vs_baseline`` is value / 2000 — the north-star target from BASELINE.json
-(≥2000 fps AND p50 < 10 ms, 1080p invert on a v5e-4). Both halves of that
-target are in the default output: ``value`` is sustained device-resident
-filter throughput, ``p50_latency_ms``/``p99_latency_ms`` are delivered
-end-to-end latency through the full streaming pipeline (the two numbers the
-reference itself measures, webcam_app.py:88-95,152-163 and
-distributor.py:152-171).
+(≥2000 fps AND p50 < 10 ms, 1080p invert on a v5e-4; this env exposes ONE
+tunneled chip, so ``value`` is per-chip device throughput — the v5e-4
+number is ~4× under batch DP, which the multichip dryrun validates).
+``p50_latency_ms`` comes from a rate-controlled run (source at 0.8×
+measured throughput, ingest queue ≈ one batch) so it measures pipeline
+transit, not standing queue depth. ``link_roofline_fps`` is the measured
+host↔device link ceiling for full-frame delivery: on the tunneled bench
+chip the device→host link runs at ~20 MB/s, which caps any honest 1080p
+e2e fps at a few fps regardless of the framework (a real v5e PCIe link is
+~3 orders of magnitude faster); ``roofline_frac`` says how close the
+pipeline gets to that ceiling, which is the framework-attributable part.
 
-Reliability design (round 1 post-mortem: the driver's run died in TPU
-backend init and a re-run hung >280 s with no output):
+Reliability design (round 1-2 post-mortems: backend init hung or was
+SIGKILLed in both rounds; the old probe+child structure paid init twice
+and starved the real bench):
 
-- This parent process NEVER imports jax. All device work runs in a child
-  (``dvf_tpu/bench_child.py``) bounded by subprocess timeouts.
-- Backend init is probed first with a short timeout and retried once on
-  failure (UNAVAILABLE init errors are often transient tunnel hiccups).
-- If the TPU cannot initialize, the bench degrades LOUDLY: it reruns on
-  CPU with a scaled-down workload and emits the JSON line with
-  ``"fallback": true`` and the real TPU error in ``"error"`` — a smoke
-  number plus diagnostics instead of a hang or a bare traceback.
+- This parent process NEVER imports jax. ALL device work — init included —
+  runs in ONE child (``dvf_tpu/bench_child.py``) bounded by the full
+  ``--bench-timeout`` budget, heartbeat-logging init/compile progress to
+  stderr so a timeout post-mortem shows how far it got.
+- ``JAX_COMPILATION_CACHE_DIR`` is set so any rerun (or fallback after a
+  partial run) skips compiles.
+- If the TPU child fails or times out, the bench degrades LOUDLY: it
+  reruns on CPU with a scaled-down workload and emits the JSON line with
+  ``"fallback": true`` and the real TPU error in ``"error"``.
 - Whatever happens, exactly one JSON line goes to stdout. Exit code is 0
   whenever a measurement (even the CPU fallback) was obtained.
 
 Usage: python bench.py [--iters K] [--batch B] [--frames N] [--cpu]
-                       [--probe-timeout S] [--bench-timeout S] [--e2e]
+                       [--bench-timeout S] [--e2e]
 """
 
 from __future__ import annotations
@@ -42,33 +50,12 @@ import time
 
 from benchtools import last_json_line, run_cmd as _run, tail as _tail
 
-PROBE_CODE = (
-    "import jax; d = jax.devices(); "
-    "print(jax.default_backend(), len(d), flush=True)"
-)
-
 
 def _log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 _T0 = time.perf_counter()
-
-
-def probe_backend(timeout: float, attempts: int = 2):
-    """Bounded backend-init probe. Returns (platform_name, error_or_None)."""
-    env = dict(os.environ)
-    last_err = ""
-    for i in range(attempts):
-        _log(f"probing TPU backend (attempt {i + 1}/{attempts}, timeout {timeout:.0f}s)")
-        rc, out, err = _run([sys.executable, "-c", PROBE_CODE], env, timeout)
-        if rc == 0 and out.strip():
-            platform = out.split()[0]
-            _log(f"backend ok: {out.strip()}")
-            return platform, None
-        last_err = _tail(err) or f"probe exited rc={rc} with no output"
-        _log(f"probe failed (rc={rc}): {_tail(err, 3)}")
-    return None, last_err
 
 
 def run_bench_child(child_args, env, timeout):
@@ -87,12 +74,12 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--height", type=int, default=1080)
     ap.add_argument("--width", type=int, default=1920)
-    ap.add_argument("--frames", type=int, default=512, help="e2e streaming frames")
+    ap.add_argument("--frames", type=int, default=512, help="e2e streaming frame cap")
     ap.add_argument("--e2e-batch", type=int, default=16)
+    ap.add_argument("--lat-batch", type=int, default=4)
     ap.add_argument("--e2e", action="store_true",
                     help="(compat) e2e-only mode; default now reports both")
-    ap.add_argument("--cpu", action="store_true", help="skip probe, run on CPU")
-    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--cpu", action="store_true", help="run on CPU directly")
     ap.add_argument("--bench-timeout", type=float, default=420.0)
     args = ap.parse_args(argv)
 
@@ -100,47 +87,46 @@ def main(argv=None) -> int:
     error = None
     fallback = False
 
-    if args.cpu:
-        platform = None  # force fallback path below
-        error = "cpu requested via --cpu"
-    else:
-        platform, error = probe_backend(args.probe_timeout)
-        if platform == "cpu":
-            # jax initialized but silently landed on CPU (no TPU plugin /
-            # plugin failed to claim the chip). Running the full TPU-scale
-            # workload there would either eat the whole bench timeout or
-            # mislabel a CPU number as the real measurement — take the
-            # loud, scaled-down fallback path instead.
-            error = "backend probe returned 'cpu' — no TPU available"
-            platform = None
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dvf_jaxcache")
 
     result = None
-    if platform is not None:
+    if not args.cpu:
         child_args = [
             "--mode", mode,
             "--iters", str(args.iters), "--batch", str(args.batch),
             "--height", str(args.height), "--width", str(args.width),
             "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
+            "--lat-batch", str(args.lat_batch),
         ]
-        _log(f"running bench on {platform} (timeout {args.bench_timeout:.0f}s)")
-        result, bench_err = run_bench_child(child_args, dict(os.environ),
-                                            args.bench_timeout)
+        _log(f"running bench (init + measure in one child, "
+             f"timeout {args.bench_timeout:.0f}s)")
+        result, bench_err = run_bench_child(child_args, env, args.bench_timeout)
         if result is None:
-            error = f"TPU bench failed after successful probe: {bench_err}"
+            error = f"TPU bench failed: {bench_err}"
             _log(error)
+        elif result.get("backend") != "tpu":
+            # jax initialized but landed on CPU (no TPU plugin / plugin
+            # failed to claim the chip). The numbers are real but must be
+            # labeled as the fallback they are.
+            error = (f"backend came up as {result.get('backend')!r}, not tpu")
+            fallback = True
+            _log(error)
+    else:
+        error = "cpu requested via --cpu"
 
     if result is None:
         # Loud CPU fallback: scaled-down workload, clearly labeled. The
         # point is a verifiable smoke number + the real failure reason,
         # instead of a hang (round-1 failure mode).
         fallback = True
-        env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         child_args = [
             "--mode", mode, "--platform", "cpu",
             "--iters", "20", "--batch", "8",
             "--height", str(args.height), "--width", str(args.width),
-            "--frames", "64", "--e2e-batch", "8",
+            "--frames", "64", "--e2e-batch", "8", "--lat-batch", "4",
+            "--e2e-budget-s", "30",
         ]
         _log("falling back to CPU (timeout 240s)")
         result, cpu_err = run_bench_child(child_args, env, 240.0)
@@ -165,9 +151,14 @@ def main(argv=None) -> int:
         "vs_baseline": round(headline / 2000.0, 3) if headline else None,
         "p50_latency_ms": result.get("p50_ms"),
         "p99_latency_ms": result.get("p99_ms"),
+        "lat_target_fps": result.get("lat_target_fps"),
+        "lat_batch": result.get("lat_batch"),
         "e2e_fps": result.get("e2e_fps"),
         "ms_per_frame": result.get("ms_per_frame"),
         "h2d_mbps": result.get("h2d_mbps"),
+        "d2h_mbps": result.get("d2h_mbps"),
+        "link_roofline_fps": result.get("link_roofline_fps"),
+        "roofline_frac": result.get("roofline_frac"),
         "backend": result.get("backend"),
         "n_devices": result.get("n_devices"),
         "batch": result.get("batch"),

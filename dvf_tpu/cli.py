@@ -83,7 +83,13 @@ def cmd_serve(args) -> int:
     elif args.source == "webcam":
         source = WebcamSource(target_size=args.target_size)
     else:
-        source = VideoFileSource(args.source, rate=args.rate)
+        # Ring transport carries fixed-geometry payloads, so file sources
+        # must be cropped to the same --target-size square the ring queue
+        # below is constructed with (native geometry otherwise).
+        source = VideoFileSource(
+            args.source, rate=args.rate,
+            target_size=args.target_size if args.transport == "ring" else None,
+        )
 
     # Live serving is resilient (one bad frame never kills the stream,
     # worker.py:71-76 semantics) with the reference's 5 s telemetry prints
@@ -98,6 +104,24 @@ def cmd_serve(args) -> int:
         device_trace_dir=args.device_trace,
     )
 
+    queue = None
+    if args.transport == "ring":
+        from dvf_tpu.transport.ring_queue import RingFrameQueue
+
+        # The ring carries fixed-geometry payloads; every source above is
+        # constructed to a known frame shape (synthetic: --height/--width;
+        # webcam and file: --target-size center crop — file sources get
+        # target_size forced above exactly for this).
+        if args.source == "synthetic":
+            shape = (args.height, args.width, 3)
+        else:
+            shape = (args.target_size, args.target_size, 3)
+        queue = RingFrameQueue(
+            frame_shape=shape,
+            capacity_frames=args.queue_size,
+            jpeg=(args.wire == "jpeg"),
+        )
+
     if args.display:
         tap = LiveTap(source)
         sink = SideBySideSink(
@@ -105,12 +129,12 @@ def cmd_serve(args) -> int:
             headless=args.headless,
             telemetry_interval_s=config.telemetry_interval_s,
         )
-        pipe = Pipeline(tap, filt, sink, config)
+        pipe = Pipeline(tap, filt, sink, config, queue=queue)
         sink.stop_cb = pipe.stop        # ESC → graceful stop
         sink.stats_fn = pipe.stats
     else:
         sink = NullSink()
-        pipe = Pipeline(source, filt, sink, config)
+        pipe = Pipeline(source, filt, sink, config, queue=queue)
 
     # SIGINT/SIGTERM → graceful stop; repeat → hard abort (the reference
     # installs the same pair, webcam_app.py:46-48 / inverter.py:16-17).
@@ -300,6 +324,14 @@ def main(argv=None) -> int:
     sp.add_argument("--trace", action="store_true", help="export Perfetto trace")
     sp.add_argument("--device-trace", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace into DIR")
+    sp.add_argument("--transport", choices=("python", "ring"), default="python",
+                    help="ingest queue: 'ring' routes frames through the "
+                         "native C++ shared-memory ring (drop counter shows "
+                         "up in stats as dropped_at_ingest)")
+    sp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
+                    help="with --transport ring: payload format on the ring "
+                         "(jpeg = encode at capture, decode into the device "
+                         "staging buffer — the reference's use_jpeg path)")
 
     wp = sub.add_parser("worker", help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")

@@ -84,11 +84,20 @@ class SideBySideSink:
         if live.shape != processed.shape:
             # Letterbox the live feed into the processed geometry so the
             # panes always tile (the reference sidesteps this by using one
-            # target_size for both, webcam_app.py:27-31).
+            # target_size for both, webcam_app.py:27-31): scale preserving
+            # aspect, centered on a black canvas — never corner-crop, which
+            # would misrepresent a larger live feed in the comparison.
             h, w = processed.shape[:2]
+            scale = min(h / live.shape[0], w / live.shape[1])
+            sh = max(1, int(round(live.shape[0] * scale)))
+            sw = max(1, int(round(live.shape[1] * scale)))
+            if (sh, sw) != live.shape[:2]:
+                ri = (np.arange(sh) * live.shape[0] / sh).astype(np.intp)
+                ci = (np.arange(sw) * live.shape[1] / sw).astype(np.intp)
+                live = live[ri][:, ci]  # nearest-neighbor; no cv2 dependency
             boxed = np.zeros_like(processed)
-            lh, lw = min(h, live.shape[0]), min(w, live.shape[1])
-            boxed[:lh, :lw] = live[:lh, :lw]
+            y0, x0 = (h - sh) // 2, (w - sw) // 2
+            boxed[y0:y0 + sh, x0:x0 + sw] = live
             live = boxed
         return np.hstack([live, processed])
 

@@ -72,13 +72,36 @@ class SyntheticSource:
         yield None, time.time()
 
 
-class VideoFileSource:
-    """Decode a video file via cv2 (RGB uint8)."""
+def center_square(frame: "np.ndarray", size: int) -> "np.ndarray":
+    """Center-crop to ``size``² (the reference's crop, webcam_app.py:97-101),
+    upscaling first when the frame is smaller than the target so any input
+    geometry yields the fixed shape consumers like the ring transport need."""
+    import cv2
 
-    def __init__(self, path: str, loop: bool = False, rate: float = 0.0):
+    h, w = frame.shape[:2]
+    if h < size or w < size:
+        scale = max(size / h, size / w)
+        frame = cv2.resize(
+            frame, (int(np.ceil(w * scale)), int(np.ceil(h * scale))))
+        h, w = frame.shape[:2]
+    top, left = (h - size) // 2, (w - size) // 2
+    return frame[top: top + size, left: left + size]
+
+
+class VideoFileSource:
+    """Decode a video file via cv2 (RGB uint8).
+
+    ``target_size`` center-crops every frame to a fixed square — required
+    for fixed-geometry consumers (``--transport ring``); None yields the
+    file's native geometry.
+    """
+
+    def __init__(self, path: str, loop: bool = False, rate: float = 0.0,
+                 target_size: Optional[int] = None):
         self.path = path
         self.loop = loop
         self.rate = rate
+        self.target_size = target_size
 
     def __iter__(self) -> Iterator[Frame]:
         import cv2
@@ -94,7 +117,10 @@ class VideoFileSource:
                     if now < next_t:
                         time.sleep(next_t - now)
                     next_t += period
-                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), time.time()
+                rgb = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+                if self.target_size:
+                    rgb = center_square(rgb, self.target_size)
+                yield rgb, time.time()
                 ok, frame = cap.read()
             cap.release()
             if not self.loop:

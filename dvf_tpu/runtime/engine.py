@@ -81,8 +81,13 @@ class Engine:
         replicated — correct first, the inefficiency is logged.
         """
         pspec = batch_pspec(self.mesh, batch_shape)
-        if pspec[1] != "space" or (filt.halo == 0 and not filt.stateful):
-            return filt  # H unsharded, or pointwise: GSPMD is fine
+        if pspec[1] != "space" or filt.halo == 0:
+            # H unsharded, or pointwise (halo == 0): GSPMD is fine. A
+            # pointwise filter needs no halo exchange even when stateful —
+            # state placement is already handled by state_pspecs /
+            # replication — so statefulness alone must not cost it H-axis
+            # parallelism (or spam the can't-halo-shard warning).
+            return filt
         n_space = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["space"]
         can_halo = (
             not filt.stateful

@@ -72,6 +72,7 @@ class Pipeline:
         sink: Any,
         config: Optional[PipelineConfig] = None,
         engine: Optional[Engine] = None,
+        queue: Optional[Any] = None,
     ):
         if filt.stateful and not filt.pad_safe:
             # The dispatch loop pads short batches (end-of-stream tail, slow
@@ -86,7 +87,13 @@ class Pipeline:
         self.config = config or PipelineConfig()
         self.engine = engine or Engine(filt)
         self.tracer = Tracer(enabled=self.config.trace)
-        self.queue = DropOldestQueue(maxsize=self.config.queue_size)
+        # Injectable ingest queue: default is the Python drop-oldest queue;
+        # `--transport ring` passes a transport.ring_queue.RingFrameQueue,
+        # putting the native C++ ring on the hot path (frames then cross
+        # ingest→assembler as serialized payloads, decoded straight into
+        # the dispatch staging buffer via queue.decode_into).
+        self.queue = queue if queue is not None else DropOldestQueue(
+            maxsize=self.config.queue_size)
         self.reorder = ReorderBuffer(
             frame_delay=self.config.frame_delay,
             capacity=self.config.reorder_capacity,
@@ -138,7 +145,17 @@ class Pipeline:
                     break
                 idx = self.frame_counter
                 self.frame_counter += 1
-                self.queue.put((idx, frame, ts))
+                evicted = self.queue.put((idx, frame, ts))
+                if evicted is not None:
+                    # The source is outrunning the pipeline (put evicted an
+                    # older frame — drop-oldest semantics, so freshness is
+                    # already preserved). Pace this thread: an unthrottled
+                    # source spinning here starves dispatch/collect of the
+                    # GIL and *triples* e2e frame time (measured on CPU:
+                    # 44→135 fps at 1080p just from this yield). 200 µs
+                    # caps the drop loop at ~5k puts/s, far above any
+                    # full-frame delivery rate a host link can sustain.
+                    time.sleep(0.0002)
                 self._capture_rate.tick()
                 self.tracer.instant("frame_captured", ts, TRACK_INGEST, frame=idx)
         except BaseException as e:  # noqa: BLE001
@@ -201,7 +218,7 @@ class Pipeline:
             return None
         return items
 
-    def _staging_for(self, frame: np.ndarray, slot: int) -> np.ndarray:
+    def _staging_for(self, frame_shape, dtype, slot: int) -> np.ndarray:
         """Preallocated batch staging buffers, one per in-flight slot.
 
         `np.stack` per batch allocates + zero-fills a fresh multi-MB array
@@ -211,10 +228,10 @@ class Pipeline:
         belongs to a batch that has already been collected (its device_put
         finished long ago).
         """
-        shape = (self.config.batch_size, *frame.shape)
-        if self._staging is None or self._staging[0].shape != shape or self._staging[0].dtype != frame.dtype:
+        shape = (self.config.batch_size, *frame_shape)
+        if self._staging is None or self._staging[0].shape != shape or self._staging[0].dtype != dtype:
             self._staging = [
-                np.empty(shape, dtype=frame.dtype)
+                np.empty(shape, dtype=dtype)
                 for _ in range(self.config.max_inflight + 1)
             ]
         return self._staging[slot % len(self._staging)]
@@ -238,9 +255,19 @@ class Pipeline:
                     if self._abort.is_set():
                         return
                 try:
-                    batch = self._staging_for(items[0][1], seq)
-                    for row, (_, frame, _) in enumerate(items):
-                        np.copyto(batch[row], frame)
+                    decode = getattr(self.queue, "decode_into", None)
+                    if decode is not None:
+                        # Ring transport: items carry serialized payloads;
+                        # the queue decodes them (JPEG via the threaded
+                        # codec) straight into the staging rows.
+                        batch = self._staging_for(
+                            self.queue.frame_shape, self.queue.frame_dtype, seq)
+                        decode(items, batch)
+                    else:
+                        f0 = items[0][1]
+                        batch = self._staging_for(f0.shape, f0.dtype, seq)
+                        for row, (_, frame, _) in enumerate(items):
+                            np.copyto(batch[row], frame)
                     # Pad short batches by repeating the last frame — static
                     # shapes mean one compilation; padded outputs are dropped
                     # (and repeat-last keeps temporal state correct, see
@@ -362,6 +389,8 @@ class Pipeline:
             # reorder_capacity buffered frames through the sink first".
             self._deliver(flush=True)
         self.sink.close()
+        if hasattr(self.queue, "close"):
+            self.queue.close()  # ring transport: release shm + codec pool
         if self.tracer.enabled:
             self.tracer.export()
         return self.stats()
@@ -372,6 +401,7 @@ class Pipeline:
             **self.reorder.stats(),
             "total_frames_produced": self.frame_counter,
             "dropped_at_ingest": self.queue.dropped,
+            "transport": type(self.queue).__name__,
             "errors": self.errors,
             "delivered": self.latency.count,
             "engine_batches": self.engine.stats.batches,

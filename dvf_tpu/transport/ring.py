@@ -116,7 +116,7 @@ class FrameRing:
 
     def push(self, payload: bytes, frame_index: int, timestamp: float) -> int:
         """Returns how many old frames were evicted to make room."""
-        n = self._lib.ring_push(self._ptr, payload, len(payload), frame_index, timestamp)
+        n = self._lib.ring_push(self._live_ptr(), payload, len(payload), frame_index, timestamp)
         if n < 0:
             raise ValueError(f"frame of {len(payload)} bytes exceeds ring capacity")
         return int(n)
@@ -125,7 +125,7 @@ class FrameRing:
         """(payload, frame_index, timestamp) or None if empty."""
         idx = ctypes.c_uint64()
         ts = ctypes.c_double()
-        n = self._lib.ring_pop(self._ptr, self._buf, len(self._buf), ctypes.byref(idx), ctypes.byref(ts))
+        n = self._lib.ring_pop(self._live_ptr(), self._buf, len(self._buf), ctypes.byref(idx), ctypes.byref(ts))
         if n == 0:
             return None
         if n < 0:
@@ -134,20 +134,38 @@ class FrameRing:
         # staging buffer per pop — 32 MB for a 5-byte frame).
         return ctypes.string_at(self._buf, int(n)), int(idx.value), float(ts.value)
 
+    def pop_up_to(self, n: int) -> list:
+        """Pop up to n records in FIFO order — the shared batch-drain used
+        by both the pipeline ring queue and the ZMQ ingress."""
+        out = []
+        for _ in range(n):
+            rec = self.pop()
+            if rec is None:
+                break
+            out.append(rec)
+        return out
+
+    def _live_ptr(self):
+        if not self._ptr:
+            # ctypes would happily pass NULL through to C and segfault the
+            # interpreter — turn use-after-close into a Python error.
+            raise ValueError("FrameRing is closed")
+        return self._ptr
+
     def __len__(self) -> int:
-        return int(self._lib.ring_approx_len(self._ptr))
+        return int(self._lib.ring_approx_len(self._live_ptr()))
 
     @property
     def dropped(self) -> int:
-        return int(self._lib.ring_dropped(self._ptr))
+        return int(self._lib.ring_dropped(self._live_ptr()))
 
     @property
     def pushed(self) -> int:
-        return int(self._lib.ring_pushed(self._ptr))
+        return int(self._lib.ring_pushed(self._live_ptr()))
 
     @property
     def capacity(self) -> int:
-        return int(self._lib.ring_capacity(self._ptr))
+        return int(self._lib.ring_capacity(self._live_ptr()))
 
     def close(self) -> None:
         if getattr(self, "_ptr", None):

@@ -1,0 +1,144 @@
+"""RingFrameQueue — the native C++ ring as the pipeline's ingest queue.
+
+The reference's transport *is* its hot path: every frame crosses libzmq
+between the capture thread and the workers (distributor.py:27-35,
+worker.py:17-25). The TPU framework's equivalent hot path is
+source → ingest queue → batch assembler, and this adapter puts the native
+SPSC ring (ring.cpp) on it, drop-in compatible with the Python
+``DropOldestQueue`` surface the :class:`~dvf_tpu.runtime.pipeline.Pipeline`
+uses (``put`` / ``pop_up_to`` / ``__len__`` / ``dropped`` / ``put_total``).
+
+Two wire formats, mirroring the reference's ``use_jpeg`` switch
+(webcam_app.py:109-113):
+
+- **raw** — ``frame.tobytes()``; zero codec cost, ring capacity sized in
+  whole frames.
+- **jpeg** — encoded on ``put`` (the capture side, like webcam_app.py:110)
+  through :class:`~dvf_tpu.transport.codec.JpegCodec`, decoded on the
+  assembler side by ``decode_batch(out=staging)`` straight into the
+  dispatch staging buffer that feeds ``device_put`` — no intermediate
+  stack/copy.
+
+Differences from the Python queue, by design:
+
+- The bound is **bytes**, not frames (``capacity_frames`` is converted
+  using the raw frame size at construction). Drop-oldest semantics are
+  identical: a full ring evicts oldest records until the new one fits
+  (distributor.py:193-203 behavior, enforced in native code).
+- ``pop_up_to`` returns ``(index, payload_bytes, timestamp)`` tuples;
+  the pipeline detects the adapter via :meth:`decode_into` and routes
+  payload decoding into its staging buffer instead of row-copying arrays.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dvf_tpu.transport.codec import JpegCodec
+from dvf_tpu.transport.ring import FrameRing
+
+# Native per-record overhead: RecordHeader (24 B) rounded up to 8-byte
+# alignment, matching ring.cpp's align_up(sizeof(RecordHeader) + len).
+_RECORD_OVERHEAD = 32
+
+
+class RingFrameQueue:
+    """Drop-oldest ingest queue backed by the native shared-memory ring."""
+
+    def __init__(
+        self,
+        frame_shape: Tuple[int, int, int],
+        capacity_frames: int = 10,
+        jpeg: bool = False,
+        jpeg_quality: int = 90,
+        codec_threads: int = 4,
+        shm_name: Optional[str] = None,
+        create: bool = True,
+    ):
+        self.frame_shape = tuple(frame_shape)
+        self.frame_dtype = np.dtype(np.uint8)
+        self._frame_bytes = int(np.prod(self.frame_shape))
+        self.jpeg = jpeg
+        self.codec = JpegCodec(quality=jpeg_quality, threads=codec_threads) if jpeg else None
+        # Sized for capacity_frames RAW frames (a JPEG ring then holds more
+        # — the bound is freshness in bytes, the stronger guarantee). The
+        # per-record cap leaves 2× slack: JPEG is *larger* than raw for
+        # noise-like content (worst case ~1.5×), and an oversized record
+        # must fail loudly at push, never at pop.
+        cap = max(1, capacity_frames) * (self._frame_bytes + _RECORD_OVERHEAD)
+        self.ring = FrameRing(
+            capacity_bytes=cap,
+            shm_name=shm_name,
+            create=create,
+            max_frame_bytes=2 * self._frame_bytes + _RECORD_OVERHEAD,
+        )
+
+    # -- producer side (pipeline._ingest) -------------------------------
+
+    def put(self, item: Tuple[int, np.ndarray, float]) -> Optional[int]:
+        """Enqueue; returns the eviction count if frames were displaced
+        (the pipeline's pacing only checks ``is not None``), else None."""
+        idx, frame, ts = item
+        if isinstance(frame, np.ndarray) and frame.shape != self.frame_shape:
+            raise ValueError(
+                f"ring transport carries fixed {self.frame_shape} frames; "
+                f"source yielded {frame.shape} (pass the source's real "
+                f"geometry when constructing RingFrameQueue)"
+            )
+        if self.jpeg:
+            payload = self.codec.encode(frame)
+        else:
+            payload = frame.tobytes() if isinstance(frame, np.ndarray) else frame
+        evicted = self.ring.push(payload, idx, ts)
+        return evicted if evicted > 0 else None
+
+    # -- consumer side (pipeline._assemble/_dispatch) --------------------
+
+    def pop_up_to(self, n: int) -> List[Tuple[int, bytes, float]]:
+        return [(idx, payload, ts)
+                for payload, idx, ts in self.ring.pop_up_to(n)]
+
+    def decode_into(self, items: List[Tuple[int, bytes, float]],
+                    staging: np.ndarray) -> None:
+        """Decode popped payloads into rows [0, len(items)) of the dispatch
+        staging buffer (the §2b 'decode into staging feeding device_put'
+        path — JPEG batches go through the threaded codec)."""
+        k = len(items)
+        if self.jpeg:
+            self.codec.decode_batch([p for _, p, _ in items], out=staging[:k])
+        else:
+            for row, (_, payload, _) in enumerate(items):
+                staging[row] = np.frombuffer(
+                    payload, np.uint8).reshape(self.frame_shape)
+
+    # -- stats / lifecycle ----------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        if self._closed_counts is not None:
+            return self._closed_counts[0]
+        return self.ring.dropped
+
+    @property
+    def put_total(self) -> int:
+        if self._closed_counts is not None:
+            return self._closed_counts[1]
+        return self.ring.pushed
+
+    def __len__(self) -> int:
+        return 0 if self._closed_counts is not None else len(self.ring)
+
+    _closed_counts: Optional[Tuple[int, int]] = None
+
+    def close(self) -> None:
+        if self._closed_counts is not None:
+            return
+        # Snapshot the native counters first: stats() is routinely read
+        # after the pipeline shuts the transport down, and poking a
+        # destroyed ring is a use-after-free.
+        self._closed_counts = (self.ring.dropped, self.ring.pushed)
+        if self.codec is not None:
+            self.codec.close()
+        self.ring.close()

@@ -57,6 +57,7 @@ class TpuZmqWorker:
         engine: Optional[Engine] = None,
         poll_ms: int = 10,
         delay_s: float = 0.0,
+        transport: str = "list",
     ):
         import zmq
 
@@ -72,7 +73,12 @@ class TpuZmqWorker:
         self.dealer = self.ctx.socket(zmq.DEALER)
         self.dealer.connect(f"tcp://{host}:{distribute_port}")
         self.push = self.ctx.socket(zmq.PUSH)
+        # A PUSH with no live peer blocks send() forever; bound it so a dead
+        # collector drops the batch into run()'s containment (at-most-once,
+        # like every other path here) instead of wedging close().
+        self.push.setsockopt(zmq.SNDTIMEO, 1000)
         self.push.connect(f"tcp://{host}:{collect_port}")
+        self._zmq = zmq
         self.filt = filt
         self.engine = engine or Engine(filt)
         self.codec = JpegCodec(quality=jpeg_quality, threads=codec_threads)
@@ -86,6 +92,24 @@ class TpuZmqWorker:
         self.batches = 0
         self.errors = 0
         self._stop = threading.Event()
+        # transport="ring": arriving frame payloads are staged in the
+        # native C++ ring instead of a Python list — the same hot-path
+        # component the pipeline's --transport ring uses, here between the
+        # socket recv and the batch assembler. Drop-oldest applies if the
+        # app ever outruns assembly (sized for 4 batches of raw frames, so
+        # only under pathological backlog).
+        self._ring = None
+        if transport == "ring":
+            from dvf_tpu.transport.ring import FrameRing
+
+            # 2× raw size per record: JPEG is *larger* than raw for
+            # noise-like content (worst case ~1.5×), and the wire payload
+            # here is whatever the app sent.
+            rec_bytes = 2 * (raw_size * raw_size * 3) + 4096
+            self._ring = FrameRing(
+                capacity_bytes=4 * batch_size * rec_bytes,
+                max_frame_bytes=rec_bytes,
+            )
 
     # ------------------------------------------------------------------
 
@@ -158,8 +182,16 @@ class TpuZmqWorker:
                 # Keep batch_size READYs outstanding so the app's ROUTER can
                 # stream us frames back-to-back (the reference worker holds
                 # exactly one, worker.py:39-46; credits generalize that).
+                # Non-blocking sends: with the app down, credit decay would
+                # otherwise re-enqueue ~100 READYs/s until the DEALER's
+                # SNDHWM fills and send() blocks forever — at which point
+                # stop() can no longer interrupt the loop. On a full buffer
+                # we just retry next iteration.
                 while credits < self.batch_size:
-                    self.dealer.send(b"READY")
+                    try:
+                        self.dealer.send(b"READY", flags=self._zmq.NOBLOCK)
+                    except self._zmq.Again:
+                        break
                     credits += 1
 
                 if self.dealer.poll(self.poll_ms):
@@ -175,7 +207,10 @@ class TpuZmqWorker:
                         except ValueError:
                             self.errors += 1
                         else:
-                            pending.append((idx, parts[1]))
+                            if self._ring is not None:
+                                self._ring.push(parts[1], idx, time.time())
+                            else:
+                                pending.append((idx, parts[1]))
                             if first_recv_t is None:
                                 first_recv_t = time.perf_counter()
                     else:
@@ -196,19 +231,30 @@ class TpuZmqWorker:
                     # overwritten while the worker sits on phantom credits.
                     credits = max(0, credits - 1)
 
-                flush = len(pending) >= self.batch_size or (
-                    pending
+                n_pending = len(self._ring) if self._ring is not None else len(pending)
+                flush = n_pending >= self.batch_size or (
+                    n_pending
                     and first_recv_t is not None
                     and time.perf_counter() - first_recv_t > self.assemble_timeout_s
                 )
                 if not flush:
                     continue
 
+                if self._ring is not None:
+                    pending = [(idx, payload) for payload, idx, _ts
+                               in self._ring.pop_up_to(self.batch_size)]
                 try:
                     self._process_batch(pending, pid)
                 finally:
                     pending = []
-                    first_recv_t = None
+                    # Leftovers beyond one batch (ring mode) must restart
+                    # the flush clock, or a sub-batch remainder strands
+                    # until the next arrival happens to reset it.
+                    first_recv_t = (
+                        time.perf_counter()
+                        if self._ring is not None and len(self._ring)
+                        else None
+                    )
                 if max_frames is not None and self.frames_processed >= max_frames:
                     break
             except Exception as e:  # noqa: BLE001 — per-iteration containment
@@ -221,6 +267,8 @@ class TpuZmqWorker:
 
     def close(self) -> None:
         self._stop.set()
+        if self._ring is not None:
+            self._ring.close()
         self.codec.close()
         self.dealer.close(0)
         self.push.close(0)

@@ -54,3 +54,29 @@ def test_bench_runs_small(capsys, monkeypatch):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert out["unit"] == "fps" and out["value"] > 0
+
+
+def test_serve_ring_transport(capsys):
+    """serve --transport ring: native ring on the hot path end-to-end."""
+    rc = main([
+        "serve", "--filter", "invert", "--source", "synthetic",
+        "--height", "32", "--width", "32", "--frames", "20",
+        "--batch", "4", "--frame-delay", "0", "--queue-size", "64",
+        "--transport", "ring",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 20
+    assert stats["transport"] == "RingFrameQueue"
+
+
+def test_serve_ring_transport_jpeg_wire(capsys):
+    rc = main([
+        "serve", "--filter", "invert", "--source", "synthetic",
+        "--height", "32", "--width", "32", "--frames", "12",
+        "--batch", "4", "--frame-delay", "0", "--queue-size", "64",
+        "--transport", "ring", "--wire", "jpeg",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 12

@@ -39,7 +39,8 @@ def _free_port():
 
 
 @pytest.mark.skipif(not os.path.exists(REF), reason="reference not present")
-def test_reference_distributor_drives_tpu_worker(rng):
+@pytest.mark.parametrize("transport", ["list", "ring"])
+def test_reference_distributor_drives_tpu_worker(rng, transport):
     from dvf_tpu.ops import get_filter
     from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
 
@@ -54,9 +55,14 @@ def test_reference_distributor_drives_tpu_worker(rng):
         distribute_port=p_dist,
         collect_port=p_coll,
         batch_size=4,
-        assemble_timeout_s=0.005,
+        # Wide assembly window: frames arrive ~15 ms apart (feed loop
+        # below), so a 60 ms window deterministically accumulates 2-4
+        # frames per batch — the batching proof can't depend on compile
+        # stalls happening to back frames up.
+        assemble_timeout_s=0.06,
         use_jpeg=False,
         raw_size=16,
+        transport=transport,  # "ring" stages recv'd payloads in the C++ ring
     )
     wt = threading.Thread(target=worker.run, daemon=True)
     wt.start()
@@ -65,14 +71,25 @@ def test_reference_distributor_drives_tpu_worker(rng):
     frames = {}
     got = {}
 
+    display_hits = set()
+
     def poll_display():
         # The reference's draw-loop pair (webcam_app.py:135-137): advance
         # the cursor, fetch whatever frame it points at.
         dist.update_display_frame()
         shown = dist.get_frame_to_display()
         idx = dist.current_display_frame
-        if shown is not None and idx is not None and idx not in got:
-            got[idx] = np.frombuffer(shown, np.uint8).reshape(16, 16, 3)
+        if shown is not None and idx is not None:
+            display_hits.add(idx)
+            if idx not in got:
+                got[idx] = np.frombuffer(shown, np.uint8).reshape(16, 16, 3)
+        # Batched completion makes the display cursor leapfrog intermediate
+        # results (it tracks latest_received), so also sweep the reorder
+        # buffer itself — n=30 < the 50-entry cap (distributor.py:23), so
+        # every collected frame is still in it.
+        for idx, entry in list(dist.received_frames.items()):
+            if idx not in got:
+                got[idx] = np.frombuffer(entry["frame_data"], np.uint8).reshape(16, 16, 3)
 
     try:
         # Feed like a ~60fps camera and poll the display path *while*
@@ -101,6 +118,7 @@ def test_reference_distributor_drives_tpu_worker(rng):
     # The latest-wins slot may legitimately skip frames under load; require
     # real throughput (most frames served) and exact numerics on every one.
     assert len(got) >= n // 2, f"only {len(got)}/{n} frames came back"
+    assert display_hits, "display path never surfaced a frame"
     for idx, out in got.items():
         np.testing.assert_array_equal(out, 255 - frames[idx])
     # The worker really batched (not one frame per roundtrip like the
