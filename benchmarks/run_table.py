@@ -90,29 +90,61 @@ def main(argv=None) -> int:
               f"e2e={e2e.get('value', e2e.get('error'))}", file=sys.stderr,
               flush=True)
 
-    # Pallas vs jnp bilateral: same shape, both impls, pick the winner.
-    # (On a forced-CPU validation run the Pallas kernel runs in interpret
-    # mode — mechanics only, not a perf datapoint.)
-    print("[table] bilateral impl comparison…", file=sys.stderr, flush=True)
-    comparison = {}
-    for impl, fname in (("jnp", "bilateral"), ("pallas", "bilateral_pallas")):
-        kw = ", interpret=True" if (args.cpu and impl == "pallas") else ""
-        code = (
-            "import json, sys\n"
-            "from dvf_tpu.cli import _force_platform\n"
-            "_force_platform()\n"
-            "from dvf_tpu.benchmarks import bench_device_resident\n"
-            "from dvf_tpu.ops import get_filter\n"
-            f"r = bench_device_resident(get_filter({fname!r}{kw}), {iters}, {batch or 8}, 1080, 1920)\n"
-            "print(json.dumps({'fps': round(r['fps'],1), 'ms_per_frame': round(r['ms_per_frame'],4)}))\n"
-        )
-        rc, out, err = _run([sys.executable, "-c", code], env, args.timeout)
-        parsed = _last_json(out)
-        comparison[impl] = parsed if parsed else {
-            "error": f"rc={rc}: " + "\n".join(err.strip().splitlines()[-4:])
+    # Pallas vs jnp, three kernels: bilateral alone, the fused
+    # sobel+bilateral chain (configs[2]), and the flow warp
+    # (gather vs bounded-displacement kernel). (On a forced-CPU validation
+    # run the Pallas kernels run in interpret mode — mechanics only, not a
+    # perf datapoint.)
+    COMPARISONS = {
+        # name → (h, w, batch, [(impl_label, filter_name, cfg_dict)])
+        "bilateral_1080p": (1080, 1920, batch or 8, [
+            ("jnp", "bilateral", {}),
+            ("pallas", "bilateral_pallas", {}),
+        ]),
+        "sobel_bilateral_1080p": (1080, 1920, batch or 8, [
+            ("jnp_chain", "sobel_bilateral", {}),
+            ("pallas_fused", "sobel_bilateral_pallas", {}),
+        ]),
+        "flow_warp_720p": (720, 1280, batch or 4, [
+            ("gather", "flow_warp", {"warp_impl": "gather"}),
+            ("pallas_warp", "flow_warp", {"warp_impl": "pallas"}),
+        ]),
+    }
+    if args.quick:
+        # Quick mode shrinks shapes — rename the keys so tiny-shape numbers
+        # can never be published under full-resolution labels.
+        COMPARISONS = {
+            k.rsplit("_", 1)[0] + "_48x64_quick": (48, 64, b, impls)
+            for k, (_, _, b, impls) in COMPARISONS.items()
         }
-    fps = {k: v.get("fps", 0) for k, v in comparison.items()}
-    comparison["winner"] = max(fps, key=fps.get) if any(fps.values()) else "n/a"
+    comparisons = {}
+    for cname, (h, w, cbatch, impls) in COMPARISONS.items():
+        print(f"[table] impl comparison {cname}…", file=sys.stderr, flush=True)
+        comparison = {}
+        for impl, fname, cfg in impls:
+            cfg = dict(cfg)
+            if args.cpu and fname.endswith("_pallas"):
+                cfg["interpret"] = True
+            kw = "".join(f", {k}={v!r}" for k, v in cfg.items())
+            code = (
+                "import json, sys\n"
+                "from dvf_tpu.cli import _force_platform\n"
+                "_force_platform()\n"
+                "from dvf_tpu.benchmarks import bench_device_resident\n"
+                "from dvf_tpu.ops import get_filter\n"
+                f"r = bench_device_resident(get_filter({fname!r}{kw}), {iters}, {cbatch}, {h}, {w})\n"
+                "print(json.dumps({'fps': round(r['fps'],1), 'ms_per_frame': round(r['ms_per_frame'],4)}))\n"
+            )
+            rc, out, err = _run([sys.executable, "-c", code], env, args.timeout)
+            parsed = _last_json(out)
+            comparison[impl] = parsed if parsed else {
+                "error": f"rc={rc}: " + "\n".join(err.strip().splitlines()[-4:])
+            }
+        fps = {k: v.get("fps", 0) for k, v in comparison.items()}
+        comparison["winner"] = max(fps, key=fps.get) if any(fps.values()) else "n/a"
+        comparisons[cname] = comparison
+    comparison = comparisons.get("bilateral_1080p",
+                                 next(iter(comparisons.values())))  # back-compat
 
     doc = {
         "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
@@ -121,7 +153,8 @@ def main(argv=None) -> int:
         "iters": iters,
         "frames": frames,
         "configs": results,
-        "bilateral_impl_comparison": comparison,
+        "impl_comparisons": comparisons,
+        "bilateral_impl_comparison": comparison,  # back-compat alias
     }
     os.makedirs(args.out_dir, exist_ok=True)
     json_path = os.path.join(args.out_dir, "BENCH_TABLE.json")
@@ -145,17 +178,20 @@ def main(argv=None) -> int:
             f"| {e.get('value', 'ERR')} | {e.get('p50_ms', '—')} "
             f"| {e.get('p99_ms', '—')} |"
         )
-    lines += [
-        "",
-        "## Bilateral implementation (1080p, batch 8)",
-        "",
-        "| impl | fps | ms/frame |",
-        "|---|---|---|",
-    ]
-    for impl in ("jnp", "pallas"):
-        c = comparison[impl]
-        lines.append(f"| {impl} | {c.get('fps', 'ERR')} | {c.get('ms_per_frame', '—')} |")
-    lines.append(f"\nWinner: **{comparison['winner']}**")
+    for cname, comp in comparisons.items():
+        lines += [
+            "",
+            f"## Implementation comparison — {cname}",
+            "",
+            "| impl | fps | ms/frame |",
+            "|---|---|---|",
+        ]
+        for impl, c in comp.items():
+            if impl == "winner":
+                continue
+            lines.append(
+                f"| {impl} | {c.get('fps', 'ERR')} | {c.get('ms_per_frame', '—')} |")
+        lines.append(f"\nWinner: **{comp['winner']}**")
     md_path = os.path.join(args.out_dir, "BENCH_TABLE.md")
     with open(md_path, "w") as f:
         f.write("\n".join(lines) + "\n")

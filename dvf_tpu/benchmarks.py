@@ -106,15 +106,22 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
         t0 = time.perf_counter()
         np.asarray(y)
         d2h.append(time.perf_counter() - t0)
-    tiny = bump(jax.device_put(host[:1, :8]))
-    tiny.block_until_ready()
-    t0 = time.perf_counter()
-    np.asarray(tiny)
-    fixed_s = time.perf_counter() - t0
+    fixed = []
+    for _ in range(reps):
+        tiny = bump(jax.device_put(host[:1, :8]))
+        tiny.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(tiny)
+        fixed.append(time.perf_counter() - t0)
+    # min over reps, and never let the correction exceed 90% of the bulk
+    # time: one hiccup on a flaky link must not produce an absurd d2h_mbps
+    # (and with it a roofline that misattributes link-bound e2e fps to
+    # framework overhead).
+    fixed_s = min(min(fixed), 0.9 * min(d2h))
     mb = host.nbytes / 1e6
     return {
         "h2d_mbps": mb / min(h2d),
-        "d2h_mbps": mb / max(min(d2h) - fixed_s, 1e-9),
+        "d2h_mbps": mb / (min(d2h) - fixed_s),
         "d2h_fixed_ms": fixed_s * 1e3,
         "batch_mb": mb,
     }

@@ -162,10 +162,11 @@ class WebcamSource:
                 if not ok:
                     break
                 if self.target_size:
-                    h, w = frame.shape[:2]
-                    s = self.target_size
-                    top, left = (h - s) // 2, (w - s) // 2
-                    frame = frame[top : top + s, left : left + s]
+                    # center_square also upscales when the camera ignores
+                    # the capture-size request and delivers smaller frames
+                    # — a naive negative-offset crop would emit wrong-shape
+                    # frames and kill fixed-geometry consumers (ring).
+                    frame = center_square(frame, self.target_size)
                 yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), time.time()
         finally:
             cap.release()

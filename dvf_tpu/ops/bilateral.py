@@ -6,10 +6,11 @@ by the Sobel+bilateral 1080p batch=16 north-star config.
 TPU mapping: the d×d window is unrolled at trace time into shifted-view
 elementwise work (25 shifts for d=5) — pure VPU math that XLA fuses into a
 single pass over HBM; no gathers, no data-dependent shapes. The range kernel
-uses Euclidean color distance like cv2.bilateralFilter. A Pallas version that
-tiles the image through VMEM and fuses the Sobel chain lives in
-:mod:`dvf_tpu.ops.pallas_kernels`; this module is the reference jnp path and
-the numerics golden for it.
+uses Euclidean color distance like cv2.bilateralFilter. Two Pallas
+counterparts live in :mod:`dvf_tpu.ops.pallas_kernels`: ``bilateral_pallas``
+(this op alone, tiled through VMEM) and ``sobel_bilateral_pallas`` (the whole
+configs[2] Sobel→bilateral chain fused into one kernel); this module is the
+jnp reference path and the numerics golden for both.
 """
 
 from __future__ import annotations

@@ -256,6 +256,8 @@ def flow_warp(
     win_size: int = 15,
     n_iters: int = 3,
     flow_scale: int = 2,
+    warp_impl: str = "gather",
+    max_disp: int = 4,
 ) -> Filter:
     """Motion-compensate each previous frame onto the current one.
 
@@ -264,7 +266,13 @@ def flow_warp(
     2-frame temporal window of BASELINE.json configs[3] lives on-device.
     ``flow_scale``: flow is estimated at 1/flow_scale resolution and
     upsampled (cost dominated by poly expansion at full res otherwise).
+    ``warp_impl``: "gather" = XLA dynamic-gather bilinear sample;
+    "pallas" = gather-free bounded-displacement kernel
+    (:func:`dvf_tpu.ops.pallas_kernels.warp_bounded_pallas`), which clips
+    flow to ±``max_disp`` px — the table benchmark compares the two.
     """
+    if warp_impl not in ("gather", "pallas"):
+        raise ValueError(f"warp_impl must be 'gather' or 'pallas', got {warp_impl!r}")
 
     def init_state(batch_shape: Sequence[int], dtype: Any):
         _, h, w, c = batch_shape
@@ -285,7 +293,15 @@ def flow_warp(
         flow = farneback_flow(pg, cg, levels=levels, win_size=win_size, n_iters=n_iters)
         if flow_scale > 1:
             flow = jax.image.resize(flow, (bsz, h, w, 2), method="linear") * float(flow_scale)
-        warped = warp_by_flow(prev, flow)
+        if warp_impl == "pallas":
+            from dvf_tpu.ops.pallas_kernels import warp_bounded_pallas
+
+            warped = warp_bounded_pallas(
+                prev, flow, max_disp=max_disp,
+                interpret=jax.default_backend() not in ("tpu",),
+            )
+        else:
+            warped = warp_by_flow(prev, flow)
         # Until the first real previous frame exists, pass the input through.
         out = jnp.where(state["initialized"], warped, batch)
         new_state = {
@@ -295,7 +311,7 @@ def flow_warp(
         return out.astype(batch.dtype), new_state
 
     return Filter(
-        name=f"flow_warp(levels={levels},win={win_size})",
+        name=f"flow_warp(levels={levels},win={win_size},warp={warp_impl})",
         fn=fn,
         init_state=init_state,
     )

@@ -1,11 +1,25 @@
 """Pallas TPU kernels for the hot stencil ops.
 
-XLA already fuses the unrolled shifted-window bilateral
-(:mod:`dvf_tpu.ops.bilateral`) well; this kernel exists for the cases where
-hand control wins: one HBM pass per tile with all (2r+1)² taps, the
-numerator/denominator accumulators, and the exp() range weights held in
-VMEM/registers — no intermediate HBM traffic at 1080p, where the jnp
-version's 25 shifted views can spill.
+Three kernels, each with a jnp golden it must match:
+
+- **bilateral** — XLA already fuses the unrolled shifted-window bilateral
+  (:mod:`dvf_tpu.ops.bilateral`) well; this kernel exists for the cases
+  where hand control wins: one HBM pass per tile with all (2r+1)² taps,
+  the numerator/denominator accumulators, and the exp() range weights held
+  in VMEM/registers — no intermediate HBM traffic at 1080p, where the jnp
+  version's 25 shifted views can spill.
+- **fused sobel+bilateral** — the whole BASELINE configs[2] chain in one
+  VMEM residency: gray → Sobel magnitude → bilateral, no HBM round-trip
+  for the intermediate edge map. Exploits two identities: the chain's
+  bilateral input is grayscale broadcast ×3, so color distance collapses
+  to 3·Δ² and all accumulation is single-channel; and Sobel *magnitude*
+  commutes with reflect-101 padding (the derivative antisymmetrizes under
+  reflection, |·| restores it), so computing Sobel inside the halo'd tile
+  reproduces the unfused chain's borders exactly.
+- **flow bilinear-warp** (:func:`warp_bounded_pallas`) — backward warp as
+  (2R+1)² statically-unrolled shifted-window select-sums instead of the 4
+  dynamic gathers in :func:`dvf_tpu.ops.flow.bilinear_sample`; TPU has no
+  fast vector gather, while bounded-displacement warps are pure VPU work.
 
 Layout choices (see /opt/skills/guides/pallas_guide.md):
 - frames are transposed NHWC→NCHW before the kernel so W (1920 at 1080p)
@@ -17,8 +31,8 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
   time, no data-dependent control flow;
 - accumulation in float32 regardless of I/O dtype.
 
-The jnp implementation is the numerics golden; tests compare the two in
-interpret mode (CPU) and the benchmark CLI compares wall time on device.
+The jnp implementations are the numerics goldens; tests compare in
+interpret mode (CPU) and the benchmark table compares wall time on device.
 """
 
 from __future__ import annotations
@@ -104,7 +118,7 @@ def bilateral_nhwc_pallas(
     out = pl.pallas_call(
         kernel,
         grid=(b, h // th),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
         out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
         scratch_shapes=[
@@ -114,6 +128,221 @@ def bilateral_nhwc_pallas(
         interpret=interpret,
     )(x)
     return jnp.transpose(out, (0, 2, 3, 1))
+
+
+# ---------------------------------------------------------------------------
+# Bounded-displacement bilinear warp (the flow gather, gather-free)
+# ---------------------------------------------------------------------------
+
+
+def _warp_kernel(tile_h: int, R: int, w: int, c: int):
+    Rp = R + 1  # fy=R needs taps floor(R)..floor(R)+1 = R..R+1
+
+    def kernel(img_ref, flow_ref, out_ref, scratch, fscratch, sem_i, sem_f):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        ci = pltpu.make_async_copy(
+            img_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * Rp), :],
+            scratch, sem_i)
+        cf = pltpu.make_async_copy(
+            flow_ref.at[b, :, pl.ds(i * tile_h, tile_h), :],
+            fscratch, sem_f)
+        ci.start()
+        cf.start()
+        ci.wait()
+        cf.wait()
+        img = scratch[...].astype(jnp.float32)     # (c, th+2Rp, w+2Rp)
+        fl = fscratch[...].astype(jnp.float32)     # (2, th, w)
+        fx = jnp.clip(fl[0], -R, R)
+        fy = jnp.clip(fl[1], -R, R)
+        acc = jnp.zeros((c, tile_h, w), jnp.float32)
+        # out(y,x) = Σ_dy Σ_dx relu(1-|fy-dy|)·relu(1-|fx-dx|)·img(y+dy,x+dx)
+        # — exactly bilinear interpolation, because the hat weights are
+        # nonzero only at floor(f) and floor(f)+1. Every shift is a static
+        # slice; no gather anywhere.
+        for dy in range(-R, R + 2):
+            wy = jnp.maximum(0.0, 1.0 - jnp.abs(fy - dy))
+            for dx in range(-R, R + 2):
+                wx = jnp.maximum(0.0, 1.0 - jnp.abs(fx - dx))
+                sh = img[:, Rp + dy: Rp + dy + tile_h, Rp + dx: Rp + dx + w]
+                acc = acc + (wy * wx)[None] * sh
+        out_ref[...] = acc[None].astype(out_ref.dtype)
+
+    return kernel
+
+
+def warp_bounded_pallas(
+    img: jnp.ndarray,
+    flow: jnp.ndarray,
+    max_disp: int = 4,
+    tile_h: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Backward-warp ``img`` (B,H,W,C) by ``flow`` (B,H,W,2; [...,0]=dx)
+    with displacements clipped to ±``max_disp`` px.
+
+    Numerics match :func:`dvf_tpu.ops.flow.warp_by_flow` on the clipped
+    flow (border behavior included: edge padding reproduces the golden's
+    coordinate clamping for any |f| ≤ max_disp). The (2·max_disp+2)² hat-
+    weighted static shifts trade FLOPs for the dynamic gathers TPUs hate —
+    worth it while max_disp stays small (Farneback flows at video rates
+    are a few px).
+    """
+    R = int(max_disp)
+    if R < 1:
+        raise ValueError("max_disp must be >= 1")
+    Rp = R + 1
+    b, h, w, c = img.shape
+    th = tile_h if tile_h is not None else _pick_tile_h(h)
+    if h % th != 0:
+        raise ValueError(f"tile_h {th} must divide H {h}")
+
+    x = jnp.transpose(img, (0, 3, 1, 2))                    # (b,c,h,w)
+    x = jnp.pad(x, ((0, 0), (0, 0), (Rp, Rp), (Rp, Rp)), mode="edge")
+    fl = jnp.transpose(flow, (0, 3, 1, 2))                  # (b,2,h,w)
+
+    kernel = _warp_kernel(th, R, w, c)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h // th),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, h, w), img.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((c, th + 2 * Rp, w + 2 * Rp), jnp.float32),
+            pltpu.VMEM((2, th, w), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(x, fl)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+# ---------------------------------------------------------------------------
+# Fused Sobel + bilateral (BASELINE configs[2] as ONE kernel)
+# ---------------------------------------------------------------------------
+
+_LUMA = (0.299, 0.587, 0.114)  # Rec.601, matches utils.image.rgb_to_gray
+
+
+def _sobel_bilateral_kernel(tile_h: int, r: int, w: int, c: int,
+                            sigma_color: float, sigma_space: float,
+                            magnitude_scale: float):
+    d = 2 * r + 1
+    R = r + 1  # bilateral halo + 1 row/col of Sobel support
+    # Range distance on a 3-channel broadcast-gray image is 3·Δ²gray.
+    inv2sc = 3.0 / (2.0 * sigma_color * sigma_color)
+    spatial = [
+        [math.exp(-(dy * dy + dx * dx) / (2.0 * sigma_space * sigma_space))
+         for dx in range(-r, r + 1)]
+        for dy in range(-r, r + 1)
+    ]
+
+    def kernel(in_ref, out_ref, scratch, sem):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        copy = pltpu.make_async_copy(
+            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * R), :],
+            scratch,
+            sem,
+        )
+        copy.start()
+        copy.wait()
+        x = scratch[...].astype(jnp.float32)      # (c, th+2R, w+2R)
+        gray = _LUMA[0] * x[0] + _LUMA[1] * x[1] + _LUMA[2] * x[2]
+        # Sobel (ksize=3, conv taps [1,2,1]⊗[-1,0,1]) on the full slab:
+        # valid region shrinks by 1 each side → (th+2r, w+2r).
+        sx = gray[:-2, :] + 2.0 * gray[1:-1, :] + gray[2:, :]   # smooth V
+        gx = sx[:, 2:] - sx[:, :-2]                              # deriv H
+        sy = gray[:, :-2] + 2.0 * gray[:, 1:-1] + gray[:, 2:]    # smooth H
+        gy = sy[2:, :] - sy[:-2, :]                              # deriv V
+        mag = jnp.clip(jnp.sqrt(gx * gx + gy * gy) * magnitude_scale, 0.0, 1.0)
+        # Bilateral on the single-channel edge map.
+        center = mag[r: r + tile_h, r: r + w]
+        num = jnp.zeros((tile_h, w), jnp.float32)
+        den = jnp.zeros((tile_h, w), jnp.float32)
+        for dy in range(d):
+            for dx in range(d):
+                sh = mag[dy: dy + tile_h, dx: dx + w]
+                diff = sh - center
+                wgt = spatial[dy][dx] * jnp.exp(-(diff * diff) * inv2sc)
+                num = num + wgt * sh
+                den = den + wgt
+        res = num / den
+        out_ref[...] = jnp.broadcast_to(
+            res[None, None], (1, c, tile_h, w)).astype(out_ref.dtype)
+
+    return kernel
+
+
+def sobel_bilateral_nhwc_pallas(
+    batch: jnp.ndarray,
+    d: int = 5,
+    sigma_color: float = 0.1,
+    sigma_space: float = 2.0,
+    magnitude_scale: float = 1.0,
+    tile_h: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused Sobel→bilateral over float NHWC in [0,1]; numerics match
+    FilterChain(sobel, bilateral) — ops.chains.sobel_bilateral."""
+    if d % 2 != 1:
+        raise ValueError(f"window d must be odd, got {d}")
+    r = d // 2
+    R = r + 1
+    b, h, w, c = batch.shape
+    th = tile_h if tile_h is not None else _pick_tile_h(h)
+    if h % th != 0:
+        raise ValueError(f"tile_h {th} must divide H {h}")
+
+    x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
+    x = jnp.pad(x, ((0, 0), (0, 0), (R, R), (R, R)), mode="reflect")
+
+    kernel = _sobel_bilateral_kernel(th, r, w, c, sigma_color, sigma_space,
+                                     magnitude_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h // th),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((c, th + 2 * R, w + 2 * R), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(x)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+@register_filter("sobel_bilateral_pallas")
+def sobel_bilateral_pallas(
+    d: int = 5,
+    sigma_color: float = 0.1,
+    sigma_space: float = 2.0,
+    magnitude_scale: float = 1.0,
+    tile_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Filter:
+    """Fused Pallas Sobel+bilateral chain (configs[2] in one kernel).
+    ``interpret=None`` → auto: compiled on TPU, interpret mode elsewhere."""
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        interp = interpret
+        if interp is None:
+            interp = jax.default_backend() not in ("tpu",)
+        return sobel_bilateral_nhwc_pallas(
+            batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space,
+            magnitude_scale=magnitude_scale, tile_h=tile_h, interpret=interp,
+        )
+
+    return stateless(
+        f"sobel_bilateral_pallas(d={d})",
+        fn,
+        halo=d // 2 + 1,
+    )
 
 
 @register_filter("bilateral_pallas")

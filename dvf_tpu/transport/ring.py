@@ -116,6 +116,15 @@ class FrameRing:
 
     def push(self, payload: bytes, frame_index: int, timestamp: float) -> int:
         """Returns how many old frames were evicted to make room."""
+        if len(payload) > len(self._buf):
+            # Enforce max_frame_bytes at PUSH: a record bigger than the pop
+            # staging buffer would enqueue fine and then wedge the consumer
+            # forever (pop would raise on the same head record every call).
+            # Oversized input must fail loudly on the producer side.
+            raise ValueError(
+                f"frame of {len(payload)} bytes exceeds max_frame_bytes "
+                f"{len(self._buf)}"
+            )
         n = self._lib.ring_push(self._live_ptr(), payload, len(payload), frame_index, timestamp)
         if n < 0:
             raise ValueError(f"frame of {len(payload)} bytes exceeds ring capacity")

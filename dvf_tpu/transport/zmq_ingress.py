@@ -92,6 +92,7 @@ class TpuZmqWorker:
         self.batches = 0
         self.errors = 0
         self._stop = threading.Event()
+        self._run_lock = threading.Lock()  # held for the whole run() loop
         # transport="ring": arriving frame payloads are staged in the
         # native C++ ring instead of a Python list — the same hot-path
         # component the pipeline's --transport ring uses, here between the
@@ -177,6 +178,10 @@ class TpuZmqWorker:
         pending = []  # (frame_index:int, frame_bytes)
         first_recv_t: Optional[float] = None
 
+        with self._run_lock:
+            self._run_loop(pid, credits, pending, first_recv_t, max_frames)
+
+    def _run_loop(self, pid, credits, pending, first_recv_t, max_frames):
         while not self._stop.is_set():
             try:
                 # Keep batch_size READYs outstanding so the app's ROUTER can
@@ -267,9 +272,23 @@ class TpuZmqWorker:
 
     def close(self) -> None:
         self._stop.set()
-        if self._ring is not None:
-            self._ring.close()
-        self.codec.close()
+        # Wait for run() to actually exit before freeing native resources:
+        # destroying the C++ ring (or the codec pool) under a still-running
+        # serve loop is a use-after-free, not an error. If the loop is
+        # wedged (e.g. mid-compile) we leak rather than segfault.
+        got_lock = self._run_lock.acquire(timeout=10.0)
+        try:
+            if self._ring is not None:
+                if got_lock:
+                    self._ring.close()
+                else:
+                    print("[TpuZmqWorker] close(): run loop still live after "
+                          "10s; leaking ring instead of freeing under it",
+                          file=sys.stderr)
+            self.codec.close()
+        finally:
+            if got_lock:
+                self._run_lock.release()
         self.dealer.close(0)
         self.push.close(0)
         self.ctx.term()
