@@ -68,6 +68,8 @@ def cmd_filters(_args) -> int:
 
 
 def cmd_serve(args) -> int:
+    _force_platform()
+
     import signal
 
     from dvf_tpu.io.display import LiveTap, SideBySideSink
@@ -75,7 +77,20 @@ def cmd_serve(args) -> int:
     from dvf_tpu.io.sources import SyntheticSource, VideoFileSource, WebcamSource
     from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
 
-    filt = _parse_filter_arg(args.filter, args.filter_config)
+    if args.style_checkpoint:
+        # Trained style-transfer weights: rebuild the exact net from the
+        # checkpoint's sidecar config and load params only (no optimizer /
+        # VGG state touches inference).
+        from dvf_tpu.train.checkpoint import load_style_filter
+
+        try:
+            filt = load_style_filter(args.style_checkpoint)
+        except FileNotFoundError as e:
+            # Same clean failure as train --resume on a typo'd path.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        filt = _parse_filter_arg(args.filter, args.filter_config)
     if args.source == "synthetic":
         source = SyntheticSource(
             height=args.height, width=args.width, n_frames=args.frames, rate=args.rate
@@ -161,6 +176,8 @@ def cmd_serve(args) -> int:
 
 
 def cmd_worker(args) -> int:
+    _force_platform()
+
     from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
 
     filt = _parse_filter_arg(args.filter, args.filter_config)
@@ -223,6 +240,33 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def make_style_image(kind: str, size: int):
+    """Deterministic style targets for training. A flat image has trivial
+    Gram statistics (training just desaturates); the textured presets carry
+    strong orientation/color correlations that produce VISIBLE stylization
+    even with the random-init VGG feature extractor."""
+    import numpy as np
+
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    if kind == "gray":
+        img = np.full((size, size, 3), 0.3, np.float32)
+    elif kind == "stripes":
+        # Bold diagonal stripes, alternating warm/cool — strong directional
+        # second-order statistics at every feature scale.
+        phase = np.sin((xx + yy) * (2.0 * np.pi / 12.0))
+        warm = np.stack([0.9 + 0 * phase, 0.4 + 0 * phase, 0.1 + 0 * phase], -1)
+        cool = np.stack([0.1 + 0 * phase, 0.3 + 0 * phase, 0.9 + 0 * phase], -1)
+        img = np.where(phase[..., None] > 0, warm, cool).astype(np.float32)
+    elif kind == "checker":
+        c = (((xx // 8).astype(int) + (yy // 8).astype(int)) % 2).astype(np.float32)
+        img = np.stack([c, 1.0 - c, 0.5 + 0 * c], -1)
+    elif kind == "noise":
+        img = np.random.default_rng(7).random((size, size, 3)).astype(np.float32)
+    else:
+        raise ValueError(f"unknown style preset {kind!r}")
+    return img[None]  # (1, size, size, 3)
+
+
 def cmd_train(args) -> int:
     """Train the style net on synthetic (or video) frames; checkpoint and
     resume. The reference has no training story at all — this covers the
@@ -247,6 +291,8 @@ def cmd_train(args) -> int:
         net=StyleNetConfig(base_channels=args.base_channels, n_residual=args.n_residual),
         vgg=VGGConfig(),
         learning_rate=args.lr,
+        **({"style_weight": args.style_weight}
+           if args.style_weight is not None else {}),
     )
     # Data axis must divide the batch (the train step folds the batch over
     # (data, space)); unused devices idle rather than erroring.
@@ -260,7 +306,7 @@ def cmd_train(args) -> int:
                           n_frames=args.steps * args.batch, rate=0.0)
     frames = iter(src)
 
-    style_img = jnp.full((1, args.size, args.size, 3), 0.3, jnp.float32)
+    style_img = jnp.asarray(make_style_image(args.style, args.size))
     state = init_train_state(jax.random.PRNGKey(args.seed), style_img, config)
     if args.resume:
         if not os.path.isdir(args.resume):
@@ -291,6 +337,13 @@ def cmd_train(args) -> int:
     if args.checkpoint_dir:
         path = os.path.join(args.checkpoint_dir, "final")
         save_checkpoint(path, state)
+        # Sidecar net config so inference (serve --style-checkpoint) can
+        # rebuild the exact architecture without guessing flags.
+        with open(os.path.join(args.checkpoint_dir, "config.json"), "w") as f:
+            json.dump({"base_channels": args.base_channels,
+                       "n_residual": args.n_residual,
+                       "style": args.style, "size": args.size,
+                       "steps": args.steps}, f)
         print(f"checkpointed {path}", file=sys.stderr)
     print(json.dumps({"steps": args.steps, "final_loss": final_loss}))
     return 0
@@ -328,6 +381,9 @@ def main(argv=None) -> int:
                     help="ingest queue: 'ring' routes frames through the "
                          "native C++ shared-memory ring (drop counter shows "
                          "up in stats as dropped_at_ingest)")
+    sp.add_argument("--style-checkpoint", default=None, metavar="DIR",
+                    help="load trained style-transfer weights from a train "
+                         "checkpoint dir (overrides --filter)")
     sp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
                     help="with --transport ring: payload format on the ring "
                          "(jpeg = encode at capture, decode into the device "
@@ -358,6 +414,12 @@ def main(argv=None) -> int:
     tp.add_argument("--checkpoint-dir", default=None)
     tp.add_argument("--checkpoint-every", type=int, default=25)
     tp.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+    tp.add_argument("--style", default="stripes",
+                    choices=("gray", "stripes", "checker", "noise"),
+                    help="style-target preset (textured presets give "
+                         "visible stylization; gray was the old default)")
+    tp.add_argument("--style-weight", type=float, default=None,
+                    help="override StyleTrainConfig.style_weight")
 
     bp = sub.add_parser("bench", help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")

@@ -33,6 +33,57 @@ def save_checkpoint(path: str, state: TrainState) -> str:
     return path
 
 
+def load_params(path: str):
+    """Restore ONLY the net params from a train checkpoint — the inference
+    loader (serve --style-checkpoint): no optimizer/VGG/gram state, no
+    TrainState template, no mesh required. Returns the param pytree ready
+    to pass to ``get_filter("style_transfer", params=...)``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path)
+    if hasattr(restored, "params"):
+        return restored.params
+    return restored["params"]
+
+
+def load_style_filter(ckpt_dir: str):
+    """Rebuild the style_transfer Filter from a train checkpoint directory
+    (the single loader behind ``serve --style-checkpoint`` and the tests).
+
+    Requires the sidecar ``config.json`` the train CLI writes: guessing
+    default architecture on a mismatch would silently skip trained layers
+    (extra residual blocks never run) or crash with an opaque shape error.
+    """
+    import json
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"style checkpoint dir {ckpt_dir!r} does not exist")
+    final = os.path.join(ckpt_dir, "final")
+    if not os.path.isdir(final):
+        raise FileNotFoundError(
+            f"{ckpt_dir!r} has no 'final' checkpoint — pass the directory "
+            f"given to train --checkpoint-dir, not a step subdirectory")
+    cfg_path = os.path.join(ckpt_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(
+            f"{cfg_path} missing — the net architecture cannot be recovered "
+            f"(re-save with the current train CLI, which writes the sidecar)")
+    with open(cfg_path) as f:
+        sc = json.load(f)
+
+    from dvf_tpu.ops import get_filter
+
+    return get_filter(
+        "style_transfer",
+        params=load_params(final),
+        base_channels=sc["base_channels"],
+        n_residual=sc["n_residual"],
+    )
+
+
 def restore_checkpoint(
     path: str,
     template: TrainState,

@@ -93,8 +93,14 @@ def style_loss_fn(
         jnp.mean((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2)
         for a, b in zip(out_feats, content_feats)
     ) / len(out_feats)
+    # Per-layer RELATIVE Gram error: raw Gram MSE scales with 1/(H·W·C)²
+    # and sits orders of magnitude below the content term (measured ~1e-6
+    # vs ~1e-2 at 64², which made the style term invisible at any sane
+    # weight and trained nets that just desaturated). Dividing by the
+    # target Gram's energy makes every layer O(1) and resolution-free.
     style = sum(
         jnp.mean((gram_matrix(f) - g[None]) ** 2)
+        / (jnp.mean(g.astype(jnp.float32) ** 2) + 1e-12)
         for f, g in zip(out_feats, style_grams)
     ) / len(out_feats)
     tv = _tv_loss(out)

@@ -1,0 +1,82 @@
+"""The trained style-transfer demonstration (VERDICT r2 item 7).
+
+A tiny trained checkpoint is committed at checkpoints/style_stripes_64
+(500 steps, stripes preset, normalized Gram loss — see docs/style_demo.png
+for input | stylized | style-target). These tests prove the flagship
+neural filter actually stylizes: structurally different from the input,
+visibly saturated toward the style palette, reproducing the committed
+golden frame, and loadable end-to-end through ``serve --style-checkpoint``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+CKPT = os.path.join(os.path.dirname(__file__), "..", "checkpoints",
+                    "style_stripes_64")
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "style_demo_out.npy")
+
+
+@pytest.fixture(scope="module")
+def stylized():
+    import jax.numpy as jnp
+
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.train.checkpoint import load_style_filter
+
+    filt = load_style_filter(CKPT)
+    frames = [f for f, _ in SyntheticSource(height=64, width=64, n_frames=4)][:4]
+    x = jnp.asarray(np.stack(frames), jnp.float32) / 255.0
+    out, _ = filt.fn(x, filt.init_state(x.shape, np.float32))
+    return np.asarray(x), (np.asarray(jnp.clip(out, 0, 1)) * 255).astype(np.uint8)
+
+
+def test_stylized_differs_structurally_from_input(stylized):
+    x, out = stylized
+    o = out.astype(np.float32) / 255.0
+    corr = np.corrcoef(o.ravel(), x.ravel())[0, 1]
+    assert corr < 0.7, f"output too close to input (corr={corr:.3f})"
+    # Visible stylization: strong chroma (the stripes palette), not the
+    # desaturated gray the un-normalized loss used to produce (sat ~0.03).
+    sat = np.abs(o - o.mean(-1, keepdims=True)).mean()
+    assert sat > 0.10, f"output is desaturated (sat={sat:.3f}) — not stylized"
+
+
+def test_stylized_matches_committed_golden(stylized):
+    _, out = stylized
+    golden = np.load(GOLDEN)
+    diff = np.abs(out[0].astype(int) - golden.astype(int))
+    # Same params + same deterministic input; tolerance covers float
+    # reassociation across jax/XLA builds, not behavior drift.
+    assert diff.mean() < 2.0 and diff.max() <= 30, (
+        f"stylized frame drifted from golden: mean={diff.mean():.2f} "
+        f"max={diff.max()}")
+
+
+def test_serve_loads_style_checkpoint(capsys):
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--style-checkpoint", CKPT,
+        "--source", "synthetic", "--height", "64", "--width", "64",
+        "--frames", "8", "--batch", "4", "--frame-delay", "0",
+        "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 8
+
+
+def test_style_presets_deterministic():
+    from dvf_tpu.cli import make_style_image
+
+    for kind in ("gray", "stripes", "checker", "noise"):
+        a = make_style_image(kind, 32)
+        b = make_style_image(kind, 32)
+        assert a.shape == (1, 32, 32, 3)
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError):
+        make_style_image("nope", 32)
