@@ -85,8 +85,9 @@ def cmd_serve(args) -> int:
 
         try:
             filt = load_style_filter(args.style_checkpoint)
-        except FileNotFoundError as e:
-            # Same clean failure as train --resume on a typo'd path.
+        except (FileNotFoundError, ValueError) as e:
+            # Same clean failure as train --resume on a typo'd path; the
+            # loader maps corrupt/incomplete sidecars to ValueError.
             print(f"error: {e}", file=sys.stderr)
             return 2
     else:
@@ -320,6 +321,18 @@ def cmd_train(args) -> int:
         state = shard_train_state(state, mesh, config)
     step_fn = make_train_step(mesh, config, state_template=state)
 
+    if args.checkpoint_dir:
+        # Sidecar net config so inference (serve --style-checkpoint) can
+        # rebuild the exact architecture without guessing flags. Written
+        # BEFORE the loop (it depends only on argv): a run killed
+        # mid-training must still leave loadable step_* checkpoints.
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        with open(os.path.join(args.checkpoint_dir, "config.json"), "w") as f:
+            json.dump({"base_channels": args.base_channels,
+                       "n_residual": args.n_residual,
+                       "style": args.style, "size": args.size,
+                       "steps": args.steps}, f)
+
     start = int(state.step)
     for i in range(start, args.steps):
         batch_np = np.stack([
@@ -337,13 +350,6 @@ def cmd_train(args) -> int:
     if args.checkpoint_dir:
         path = os.path.join(args.checkpoint_dir, "final")
         save_checkpoint(path, state)
-        # Sidecar net config so inference (serve --style-checkpoint) can
-        # rebuild the exact architecture without guessing flags.
-        with open(os.path.join(args.checkpoint_dir, "config.json"), "w") as f:
-            json.dump({"base_channels": args.base_channels,
-                       "n_residual": args.n_residual,
-                       "style": args.style, "size": args.size,
-                       "steps": args.steps}, f)
         print(f"checkpointed {path}", file=sys.stderr)
     print(json.dumps({"steps": args.steps, "final_loss": final_loss}))
     return 0

@@ -9,16 +9,25 @@ TP psums over ICI within a slice.
 
 Fault model: the reference tolerates worker loss by at-most-once delivery
 and cursor skip (distributor.py:334-338). A JAX SPMD program cannot lose a
-participant mid-program, so elasticity moves up a level: the pipeline
-degrades by dropping frames (ring backpressure) when a host stalls, and a
-host loss is a restart of the mesh program from the last filter state —
-see runtime.pipeline drop semantics and obs metrics for detection.
+participant mid-program, so elasticity moves up a level, implemented by
+:class:`ElasticMeshRunner` — the submit path for multi-host library use
+(single-process pipelines never need it; there is no cross-host collective
+to lose). When a cross-host collective fails with a peer-loss error
+(connection reset / heartbeat timeout — the surviving process keeps a
+working local runtime, verified by the 2-process gloo kill test), the
+runner REBUILDS the step on a local-devices mesh and
+continues from the last host-synced filter state. Frames that were in
+flight on the lost hosts are simply gone — the reference's at-most-once
+"cursor skips the dead worker's frames" semantics, one level up. The
+stall half of the fault model is unchanged: backpressure drops frames at
+ingest (runtime.pipeline / transport ring).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import sys
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -29,7 +38,9 @@ from dvf_tpu.parallel.mesh import (
     Mesh,
     auto_mesh_config,
     batch_pspec,
+    batch_sharding,
     make_mesh,
+    replicated,
 )
 
 
@@ -74,6 +85,115 @@ def global_mesh(config: Optional[MeshConfig] = None, prefer: str = "data") -> Me
     if config is None:
         config = auto_mesh_config(len(devices), prefer=prefer)
     return make_mesh(config, devices=devices)
+
+
+# Connection-level signatures of "a peer process is gone" in collective /
+# coordination errors (gloo on CPU: the observed survivor error is
+# "Gloo all-reduce failed: ... Read error ...: Connection reset by peer";
+# the coordination service reports "heartbeat timeout"). Deliberately
+# NARROW — a bare "Gloo"/"UNAVAILABLE" match would classify size-mismatch
+# and config bugs as peer loss and silently split a healthy cluster into
+# isolated single-host pipelines. Everything non-connection — shape bugs,
+# OOM, compile errors — must NOT be treated as elastic and re-raises.
+_PEER_LOSS_MARKERS = (
+    "Connection reset by peer",
+    "Connection refused",
+    "Connection closed",
+    "Socket closed",
+    "heartbeat timeout",
+    "remote task has failed",
+)
+
+
+def is_peer_loss(exc: BaseException) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in _PEER_LOSS_MARKERS)
+
+
+class ElasticMeshRunner:
+    """Run a per-mesh-built step with host-loss degradation.
+
+    ``step_builder(mesh)`` returns the jitted ``(batch, state) -> (out,
+    state)`` for that mesh — it is called once for the global mesh and
+    again for the local fallback mesh after degradation, so every mesh
+    dependency (shardings, shard_map axes) is rebuilt rather than patched.
+
+    State contract: the carried filter state must be REPLICATED across
+    hosts (temporal windows and broadcast params are; this is
+    ``state_pspecs=None`` engine semantics) — then every host owns a full
+    copy and degradation is lossless: the survivor re-places the last
+    host-synced state on its local mesh and keeps going. ``sync_every``
+    controls how often the host copy refreshes (1 = every batch: the
+    "last filter state" is at most one batch old when a host dies).
+
+    Batches: before degradation each host feeds its LOCAL shard of the
+    global batch (``host_local_batch``); after, the same local shard is
+    the whole batch. In-flight frames on dead hosts are dropped, never
+    retried — the reference's at-most-once semantics
+    (distributor.py:334-338).
+    """
+
+    def __init__(
+        self,
+        step_builder: Callable[[Mesh], Callable],
+        state: Any,
+        config: Optional[MeshConfig] = None,
+        prefer: str = "data",
+        sync_every: int = 1,
+    ):
+        self._builder = step_builder
+        self._prefer = prefer
+        self.mesh = global_mesh(config, prefer=prefer)
+        self._step = step_builder(self.mesh)
+        self.state = jax.device_put(state, replicated(self.mesh))
+        self.state_host = jax.device_get(state)
+        self.sync_every = max(1, sync_every)
+        self.degraded = False
+        self.batches = 0
+        self.dropped_on_loss = 0
+
+    def _degrade(self) -> None:
+        devs = np.array(jax.local_devices())
+        self.mesh = make_mesh(
+            auto_mesh_config(len(devs), prefer=self._prefer), devices=devs
+        )
+        self._step = self._builder(self.mesh)
+        self.state = jax.device_put(self.state_host, replicated(self.mesh))
+        self.degraded = True
+        print(
+            f"[elastic] peer loss: degraded to local mesh "
+            f"({len(devs)} devices), resuming from filter state of batch "
+            f"{self.batches}",
+            file=sys.stderr, flush=True,
+        )
+
+    def submit_local(self, local_batch: np.ndarray):
+        """Contribute this host's frames; returns the (sharded) output.
+
+        On the first peer-loss failure the batch is re-run on the local
+        mesh — the local shard was this host's anyway, so no frame this
+        host owns is lost; the other hosts' frames die with them.
+        """
+        try:
+            if self.degraded:
+                batch = jax.device_put(
+                    local_batch, batch_sharding(self.mesh, local_batch.shape))
+            else:
+                batch = host_local_batch(self.mesh, local_batch)
+            out, self.state = self._step(batch, self.state)
+            # Force completion NOW: with async dispatch a peer loss would
+            # otherwise surface on a later (innocent) call.
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if self.degraded or not is_peer_loss(e):
+                raise
+            self.dropped_on_loss += 1
+            self._degrade()
+            return self.submit_local(local_batch)
+        self.batches += 1
+        if self.batches % self.sync_every == 0:
+            self.state_host = jax.device_get(self.state)
+        return out
 
 
 def host_local_batch(mesh: Mesh, local_batch: np.ndarray) -> jax.Array:

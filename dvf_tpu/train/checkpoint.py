@@ -61,26 +61,38 @@ def load_style_filter(ckpt_dir: str):
     ckpt_dir = os.path.abspath(ckpt_dir)
     if not os.path.isdir(ckpt_dir):
         raise FileNotFoundError(f"style checkpoint dir {ckpt_dir!r} does not exist")
+    # Prefer 'final'; fall back to the newest step_* checkpoint — a run
+    # killed mid-training leaves step dirs but no final, and those must
+    # stay loadable (the sidecar is written before training starts).
     final = os.path.join(ckpt_dir, "final")
     if not os.path.isdir(final):
-        raise FileNotFoundError(
-            f"{ckpt_dir!r} has no 'final' checkpoint — pass the directory "
-            f"given to train --checkpoint-dir, not a step subdirectory")
+        steps = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
+        if not steps:
+            raise FileNotFoundError(
+                f"{ckpt_dir!r} has no 'final' or step_* checkpoint — pass "
+                f"the directory given to train --checkpoint-dir")
+        final = os.path.join(ckpt_dir, steps[-1])
     cfg_path = os.path.join(ckpt_dir, "config.json")
     if not os.path.exists(cfg_path):
         raise FileNotFoundError(
             f"{cfg_path} missing — the net architecture cannot be recovered "
-            f"(re-save with the current train CLI, which writes the sidecar)")
-    with open(cfg_path) as f:
-        sc = json.load(f)
+            f"(the train CLI writes this sidecar at training start)")
+    try:
+        with open(cfg_path) as f:
+            sc = json.load(f)
+        base_channels, n_residual = sc["base_channels"], sc["n_residual"]
+    except (json.JSONDecodeError, KeyError) as e:
+        raise ValueError(
+            f"{cfg_path} is corrupt or missing required keys "
+            f"(base_channels, n_residual): {e}") from e
 
     from dvf_tpu.ops import get_filter
 
     return get_filter(
         "style_transfer",
         params=load_params(final),
-        base_channels=sc["base_channels"],
-        n_residual=sc["n_residual"],
+        base_channels=base_channels,
+        n_residual=n_residual,
     )
 
 

@@ -84,3 +84,104 @@ def test_two_process_distributed_mesh(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"dist-smoke ok pid={pid}" in out
+
+
+ELASTIC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    from dvf_tpu.parallel.distributed import ElasticMeshRunner, init_distributed
+    from dvf_tpu.parallel.mesh import MeshConfig, batch_pspec, replicated
+
+    assert init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    def builder(mesh):
+        bshard = NamedSharding(mesh, batch_pspec(mesh, None))
+        rep = replicated(mesh)
+
+        def step(batch, state):
+            out = 255 - batch
+            # The global sum forces a cross-host all-reduce every batch —
+            # the collective that detects peer loss.
+            new_state = {
+                "count": state["count"] + 1,
+                "total": state["total"] + jnp.sum(batch.astype(jnp.float32)),
+            }
+            return out, new_state
+
+        return jax.jit(step, in_shardings=(bshard, rep), out_shardings=(bshard, rep))
+
+    state0 = {"count": jnp.zeros((), jnp.int32), "total": jnp.zeros((), jnp.float32)}
+    runner = ElasticMeshRunner(builder, state0, MeshConfig(data=2))
+
+    for step_i in range(8):
+        if pid == 1 and step_i == 3:
+            os._exit(42)   # abrupt host death, mid-stream
+        local = np.full((2, 8, 8, 3), pid + step_i, np.uint8)
+        out = runner.submit_local(local)
+        shard_shape = out.sharding.shard_shape(out.shape)
+        print(f"[{pid}] step {step_i} gshape={out.shape} lshape={shard_shape} "
+              f"degraded={runner.degraded}", flush=True)
+
+    if pid == 0:
+        count = int(jax.device_get(runner.state)["count"])
+        assert runner.degraded, "survivor never degraded"
+        assert runner.dropped_on_loss == 1
+        # Filter state carried across the mesh swap: 8 committed batches,
+        # no reset (the failed attempt re-ran on the local mesh).
+        assert count == 8, count
+        print(f"elastic-smoke ok pid=0 count={count} degraded={runner.degraded}",
+              flush=True)
+    # Skip jax.distributed's shutdown barrier: with a dead peer it is
+    # poisoned and aborts the interpreter (observed F-level fatal).
+    sys.stdout.flush()
+    os._exit(0)
+    """
+)
+
+
+def test_survivor_degrades_to_local_mesh_on_peer_death(tmp_path):
+    """Kill one of two gloo processes mid-stream: the survivor must detect
+    the peer-loss collective failure, rebuild on its local mesh, and
+    continue from the carried filter state (VERDICT r2 item 8; reference
+    semantics: dead worker => frames skipped, distributor.py:334-338)."""
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=150)
+        outs.append(out)
+    assert procs[1].returncode == 42, f"victim exited oddly:\n{outs[1][-2000:]}"
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0][-3000:]}"
+    assert "elastic-smoke ok pid=0 count=8 degraded=True" in outs[0]
+    # Before the kill the batch is global (4 frames over 2 hosts); after
+    # degradation it is this host's local 2 frames.
+    assert "step 2 gshape=(4, 8, 8, 3)" in outs[0]
+    assert "step 3 gshape=(2, 8, 8, 3)" in outs[0]
+    assert "step 7 gshape=(2, 8, 8, 3)" in outs[0]
