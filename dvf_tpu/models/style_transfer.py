@@ -100,16 +100,20 @@ def _conv_modes(config: StyleNetConfig) -> Dict[str, str]:
     return modes
 
 
-def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig, row_reduce) -> jnp.ndarray:
-    """Shared forward body; ``row_reduce`` runs on each row-parallel conv's
-    pre-bias output (identity when unsharded, psum('model') under TP)."""
+def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig,
+             row_reduce, trunk_fn=None) -> jnp.ndarray:
+    """Shared forward body for ALL schedules. ``row_reduce`` runs on each
+    row-parallel conv's pre-bias output (identity when unsharded,
+    psum('model') under TP). ``trunk_fn(params, x)`` replaces the default
+    flat residual loop (the PP grouping passes its scan/pipeline here) —
+    one copy of the stem/decoder wiring, however the trunk executes."""
     cd = config.compute_dtype
     modes = _conv_modes(config)
 
     def cv(name, x, stride=1):
         p = params[name]
         y = conv2d_nb(p, x, stride=stride, compute_dtype=cd, reflect=True)
-        if modes[name] == "row":
+        if modes.get(name) == "row":
             y = row_reduce(y)
         return y + p["b"].astype(cd)
 
@@ -120,10 +124,13 @@ def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig, row_red
     x = norm_relu("stem_norm", cv("stem", x))
     x = norm_relu("down1_norm", cv("down1", x, stride=2))
     x = norm_relu("down2_norm", cv("down2", x, stride=2))
-    for i in range(config.n_residual):
-        h = norm_relu(f"res{i}_an", cv(f"res{i}_a", x))
-        h = instance_norm(params[f"res{i}_bn"], cv(f"res{i}_b", h))
-        x = x + h
+    if trunk_fn is not None:
+        x = trunk_fn(params, x)
+    else:
+        for i in range(config.n_residual):
+            h = norm_relu(f"res{i}_an", cv(f"res{i}_a", x))
+            h = instance_norm(params[f"res{i}_bn"], cv(f"res{i}_b", h))
+            x = x + h
     x = upsample_nearest(x, 2)
     x = norm_relu("up1_norm", cv("up1", x))
     x = upsample_nearest(x, 2)
@@ -140,6 +147,93 @@ def tp_inner_apply(config: StyleNetConfig) -> Any:
     return lambda params, batch: _forward(
         params, batch, config, lambda y: lax.psum(y, "model")
     )
+
+
+# ---------------------------------------------------------------------------
+# Layer pipeline parallelism over the residual trunk (SURVEY §2c layer-PP)
+# ---------------------------------------------------------------------------
+
+def to_pp_params(flat: Params, config: StyleNetConfig) -> Params:
+    """Regroup the flat param dict for pipelining: stem/down/up/out stay
+    flat (replicated), the N homogeneous residual blocks stack into a
+    'trunk' pytree with leading dim N — the axis PP shards over stages."""
+    from dvf_tpu.parallel.pp import stack_layer_params
+
+    enc_dec = {k: v for k, v in flat.items() if not k.startswith("res")}
+    blocks = [
+        {"a": flat[f"res{i}_a"], "an": flat[f"res{i}_an"],
+         "b": flat[f"res{i}_b"], "bn": flat[f"res{i}_bn"]}
+        for i in range(config.n_residual)
+    ]
+    return {**enc_dec, "trunk": stack_layer_params(blocks)}
+
+
+def pp_param_pspecs(config: StyleNetConfig = StyleNetConfig()) -> Dict[str, Any]:
+    """PartitionSpecs for the PP grouping: trunk layer-dim on 'model'
+    (each device owns N/S contiguous blocks — the PP memory win), the
+    non-repeated stem/decoder replicated. Built structurally — no params
+    are materialized (cf. param_pspecs)."""
+    conv_r = {"w": P(), "b": P()}
+    norm_r = {"scale": P(), "bias": P()}
+    specs: Dict[str, Any] = {
+        "stem": conv_r, "stem_norm": norm_r,
+        "down1": conv_r, "down1_norm": norm_r,
+        "down2": conv_r, "down2_norm": norm_r,
+        "up1": conv_r, "up1_norm": norm_r,
+        "up2": conv_r, "up2_norm": norm_r,
+        "out": conv_r,
+    }
+    # Stacked leaves: conv w (L,kh,kw,cin,cout) / b (L,c); norm (L,c).
+    conv_s = {"w": P("model", None, None, None, None), "b": P("model", None)}
+    norm_s = {"scale": P("model", None), "bias": P("model", None)}
+    specs["trunk"] = {"a": conv_s, "an": norm_s, "b": conv_s, "bn": norm_s}
+    return specs
+
+
+def _pp_res_block(config: StyleNetConfig):
+    cd = config.compute_dtype
+
+    def cv(p, x):
+        return conv2d_nb(p, x, compute_dtype=cd, reflect=True) + p["b"].astype(cd)
+
+    def res_block(p, x):
+        h = jax.nn.relu(instance_norm(p["an"], cv(p["a"], x)))
+        h = instance_norm(p["bn"], cv(p["b"], h))
+        return x + h
+
+    return res_block
+
+
+def pp_sequential_apply(config: StyleNetConfig) -> Any:
+    """Single-shard apply over PP-grouped params (the un-specialized
+    engine path): the trunk is a plain lax.scan over the stacked blocks —
+    numerically identical to apply_style_net on the flat params."""
+    block = _pp_res_block(config)
+
+    def trunk(params, x):
+        out, _ = lax.scan(lambda c, p: (block(p, c), None), x, params["trunk"])
+        return out
+
+    return lambda params, batch: _forward(
+        params, batch, config, lambda y: y, trunk_fn=trunk)
+
+
+def pp_inner_apply(config: StyleNetConfig, n_microbatches: int = 0) -> Any:
+    """Per-shard apply for ``parallel='pp'`` INSIDE an all-manual
+    shard_map: stem/down and up/out run replicated on every model-rank
+    (they are the non-repeated layers), the residual trunk runs as a
+    GPipe pipeline over 'model' (parallel.pp.pipeline_apply) with the
+    activations hopping stages via ppermute."""
+    from dvf_tpu.parallel.pp import pipeline_apply
+
+    block = _pp_res_block(config)
+
+    def trunk(params, x):
+        return pipeline_apply(block, params["trunk"], x, axis="model",
+                              n_microbatches=n_microbatches)
+
+    return lambda params, batch: _forward(
+        params, batch, config, lambda y: y, trunk_fn=trunk)
 
 
 def param_pspecs(config: StyleNetConfig = StyleNetConfig()) -> Dict[str, Any]:

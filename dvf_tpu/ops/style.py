@@ -24,6 +24,10 @@ from dvf_tpu.models.style_transfer import (
     apply_style_net,
     init_style_net,
     param_pspecs,
+    pp_inner_apply,
+    pp_param_pspecs,
+    pp_sequential_apply,
+    to_pp_params,
     tp_inner_apply,
 )
 from dvf_tpu.ops.registry import register_filter
@@ -35,37 +39,72 @@ def style_transfer(
     base_channels: int = 32,
     n_residual: int = 5,
     seed: int = 0,
+    parallel: str = "tp",
 ) -> Filter:
     """``params=None`` → seeded random init (demo/benchmark weights);
     pass a trained param pytree for real stylization.
 
-    Tensor parallelism: the filter declares ``state_pspecs`` (the Megatron
-    column/row placement of its weight pytree) and a ``specialize`` hook;
-    on a mesh with a model axis > 1 the Engine swaps in a shard_map'd
-    forward with explicit psum reductions (models.style_transfer.
-    tp_inner_apply) — the same all-manual formulation the train step uses
-    (GSPMD-auto conv partitioning is distrusted on this toolchain, see
-    train.style.make_train_step). Inference TP covers BASELINE.json
-    configs[4] when one chip can't hold the net's activation footprint.
+    ``parallel`` picks the model-axis strategy the ``specialize`` hook
+    compiles when the mesh's model axis > 1:
+
+    - ``"tp"`` — Megatron column/row tensor parallelism with explicit
+      psums (models.style_transfer.tp_inner_apply), the same all-manual
+      formulation the train step uses (GSPMD-auto conv partitioning is
+      distrusted on this toolchain, see train.style.make_train_step).
+      Covers configs[4] when one chip can't hold the activation footprint.
+    - ``"pp"`` — layer pipeline parallelism over the residual trunk
+      (models.style_transfer.pp_inner_apply / parallel.pp): each device
+      owns n_residual/S contiguous blocks, activations hop stages via
+      ppermute on a GPipe schedule — SURVEY §2c's optional layer-PP for
+      deep filters (raise n_residual and the trunk dominates). Requires
+      model-axis size to divide n_residual.
     """
+    if parallel not in ("tp", "pp"):
+        raise ValueError(f"parallel must be 'tp' or 'pp', got {parallel!r}")
     config = StyleNetConfig(base_channels=base_channels, n_residual=n_residual)
 
-    def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
-        return apply_style_net(state, batch, config), state
+    if parallel == "pp":
+        _seq_apply = pp_sequential_apply(config)
 
-    def init_state(batch_shape, dtype):
-        if params is not None:
-            return params
-        return init_style_net(jax.random.PRNGKey(seed), config)
+        def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+            return _seq_apply(state, batch), state
 
-    name = f"style_transfer(c={base_channels},r={n_residual})"
+        def init_state(batch_shape, dtype):
+            flat = params if params is not None else init_style_net(
+                jax.random.PRNGKey(seed), config)
+            return to_pp_params(flat, config)
+    else:
+        def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+            return apply_style_net(state, batch, config), state
+
+        def init_state(batch_shape, dtype):
+            if params is not None:
+                return params
+            return init_style_net(jax.random.PRNGKey(seed), config)
+
+    name = f"style_transfer(c={base_channels},r={n_residual},{parallel})"
 
     def specialize(mesh, batch_shape) -> Optional[Filter]:
         axes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        if axes.get("model", 1) <= 1:
+        n_model = axes.get("model", 1)
+        if n_model <= 1:
             return None  # generic body; params replicate over size-1 axis
-        inner = tp_inner_apply(config)
-        specs = param_pspecs(config)
+        if parallel == "pp":
+            if config.n_residual % n_model != 0:
+                import sys
+
+                print(
+                    f"[style_transfer] pp needs model axis ({n_model}) to "
+                    f"divide n_residual ({config.n_residual}); running "
+                    f"unspecialized (replicated params)",
+                    file=sys.stderr,
+                )
+                return None
+            inner = pp_inner_apply(config)
+            specs = pp_param_pspecs(config)
+        else:
+            inner = tp_inner_apply(config)
+            specs = param_pspecs(config)
         # Batch folded over (data, space) on dim 0 — mirrors
         # train.style.train_batch_sharding. The model axis replicates the
         # batch and owns param shards. shard_map requires dim 0 to divide
@@ -81,7 +120,7 @@ def style_transfer(
         else:
             batch_spec = P(None)
 
-        def tp_fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+        def sharded_fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
             sharded = jax.shard_map(
                 inner,
                 mesh=mesh,
@@ -92,8 +131,8 @@ def style_transfer(
             return sharded(state, batch), state
 
         return Filter(
-            name=f"tp({name})",
-            fn=tp_fn,
+            name=f"{parallel}({name})",
+            fn=sharded_fn,
             init_state=init_state,
             compute_dtype=jnp.float32,
             state_pspecs=lambda: specs,
@@ -104,6 +143,12 @@ def style_transfer(
         fn=fn,
         init_state=init_state,
         compute_dtype=jnp.float32,
-        state_pspecs=lambda: param_pspecs(config),
+        # TP specs are safe on any mesh (a size-1 model axis replicates);
+        # PP's trunk specs are NOT — an indivisible model axis must fall
+        # back to full replication, so the base PP filter replicates and
+        # only the specialized filter (which checked divisibility) carries
+        # the stage-sharded specs.
+        state_pspecs=(None if parallel == "pp"
+                      else (lambda: param_pspecs(config))),
         specialize=specialize,
     )
