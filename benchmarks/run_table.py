@@ -93,7 +93,10 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         e2e = bench_config(name, env, args.timeout, iters_c, frames_c,
                            e2e=True, batch=batch)
-        results[name] = {"device": dev, "e2e": e2e}
+        # Record the ACTUAL per-config workload — the global iters/frames
+        # in the doc header do not apply to scaled rows.
+        results[name] = {"device": dev, "e2e": e2e,
+                         "iters": iters_c, "frames": frames_c}
         print(f"[table] {name}: device={dev.get('value', dev.get('error'))} "
               f"e2e={e2e.get('value', e2e.get('error'))}", file=sys.stderr,
               flush=True)

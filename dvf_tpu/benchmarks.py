@@ -128,7 +128,7 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
 
 
 def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
-                  queue_size) -> dict:
+                  queue_size, collect_mode="thread") -> dict:
     import numpy as np
 
     from dvf_tpu.io.sinks import NullSink
@@ -147,6 +147,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
             queue_size=queue_size,
             frame_delay=0,
             max_inflight=max_inflight,
+            collect_mode=collect_mode,
         ),
         engine=engine,
     )
@@ -173,6 +174,7 @@ def bench_e2e_streaming(
     max_inflight: int = 4,
     queue_size: Optional[int] = None,
     rate: float = 0.0,
+    collect_mode: str = "thread",
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
@@ -187,6 +189,7 @@ def bench_e2e_streaming(
         SyntheticSource(height=height, width=width, n_frames=n_frames, rate=rate),
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
+        collect_mode=collect_mode,
     )
 
 
@@ -198,6 +201,7 @@ def bench_e2e_latency(
     width: int,
     target_fps: float,
     max_inflight: int = 2,
+    collect_mode: str = "thread",
 ) -> dict:
     """Latency mode: source throttled to ``target_fps`` (pick ~0.8× the
     measured throughput), ingest queue bounded to one batch, shallow
@@ -212,6 +216,7 @@ def bench_e2e_latency(
                         rate=target_fps),
         batch_size, height, width, max_inflight,
         queue_size=batch_size,
+        collect_mode=collect_mode,
     )
     r["target_fps"] = target_fps
     return r

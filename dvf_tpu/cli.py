@@ -118,6 +118,7 @@ def cmd_serve(args) -> int:
         resilient=not args.fail_fast,
         telemetry_interval_s=0.0 if args.quiet else 5.0,
         device_trace_dir=args.device_trace,
+        collect_mode=args.collect_mode,
     )
 
     queue = None
@@ -219,7 +220,8 @@ def cmd_bench(args) -> int:
     h, w = spec["h"], spec["w"]
 
     if args.e2e:
-        r = bench_e2e_streaming(filt, args.frames, batch, h, w)
+        r = bench_e2e_streaming(filt, args.frames, batch, h, w,
+                                collect_mode=args.collect_mode)
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -227,6 +229,7 @@ def cmd_bench(args) -> int:
             "p50_ms": round(r["p50_ms"], 3),
             "p99_ms": round(r["p99_ms"], 3),
             "frames": r["frames"],
+            "collect_mode": args.collect_mode,
         }
     else:
         r = bench_device_resident(filt, args.iters, batch, h, w)
@@ -387,6 +390,10 @@ def main(argv=None) -> int:
                     help="ingest queue: 'ring' routes frames through the "
                          "native C++ shared-memory ring (drop counter shows "
                          "up in stats as dropped_at_ingest)")
+    sp.add_argument("--collect-mode", choices=("thread", "inline"),
+                    default="thread",
+                    help="'inline': the dispatch thread retires results "
+                         "itself (fewer threads on the GIL)")
     sp.add_argument("--style-checkpoint", default=None, metavar="DIR",
                     help="load trained style-transfer weights from a train "
                          "checkpoint dir (overrides --filter)")
@@ -433,6 +440,11 @@ def main(argv=None) -> int:
     bp.add_argument("--frames", type=int, default=512, help="--e2e mode")
     bp.add_argument("--batch", type=int, default=None)
     bp.add_argument("--e2e", action="store_true")
+    bp.add_argument("--collect-mode", choices=("thread", "inline"),
+                    default="inline",
+                    help="e2e pipeline collect mode — 'inline' matches the "
+                         "headline bench.py harness (both record it in "
+                         "their JSON so cross-harness numbers compare)")
 
     args = ap.parse_args(argv)
     return {

@@ -28,6 +28,7 @@ import dataclasses
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 import numpy as np
@@ -59,6 +60,12 @@ class PipelineConfig:
     #   worker.py:71-76). Off by default so tests/benches fail fast.
     telemetry_interval_s: float = 0.0  # >0: print capture/deliver fps every
     #   N s, like the reference's 5 s prints (webcam_app.py:88-95,152-163)
+    collect_mode: str = "thread"  # "thread": dedicated collect thread
+    #   (default); "inline": the dispatch thread collects the oldest
+    #   in-flight batch itself once the window fills — one consumer thread
+    #   total, less GIL contention (XLA still overlaps compute with host
+    #   staging via async dispatch). Ordering is identical: batches retire
+    #   oldest-first either way.
     device_trace_dir: Optional[str] = None  # capture a jax.profiler device
     #   trace for the whole run into this dir — Perfetto-compatible, views
     #   alongside the host-side frame-lifecycle trace (obs.trace) in one UI
@@ -85,6 +92,10 @@ class Pipeline:
         self.source = source
         self.sink = sink
         self.config = config or PipelineConfig()
+        if self.config.collect_mode not in ("thread", "inline"):
+            raise ValueError(
+                f"collect_mode must be 'thread' or 'inline', got "
+                f"{self.config.collect_mode!r}")
         self.engine = engine or Engine(filt)
         self.tracer = Tracer(enabled=self.config.trace)
         # Injectable ingest queue: default is the Python drop-oldest queue;
@@ -105,6 +116,7 @@ class Pipeline:
         self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._staging: Optional[list] = None
+        self._on_idle = None  # inline collect: drain-ready hook (_assemble)
         self._inflight: "DropOldestQueue" = DropOldestQueue(maxsize=1_000_000)
         self._inflight_sem = threading.Semaphore(self.config.max_inflight)
         self._eof = threading.Event()
@@ -213,6 +225,12 @@ class Pipeline:
             if got:
                 items.extend(got)
             else:
+                if self._on_idle is not None:
+                    # Inline collect mode: deliver any batch the device
+                    # already finished while we wait for frames — a slow
+                    # source must not hold completed results hostage to
+                    # the in-flight window filling up.
+                    self._on_idle()
                 time.sleep(0.0005)
         if not items and (self._eof.is_set() or self._abort.is_set()):
             return None
@@ -236,8 +254,36 @@ class Pipeline:
             ]
         return self._staging[slot % len(self._staging)]
 
+    def _drain_ready(self, pending: "deque") -> bool:
+        """Inline collect: retire the oldest batch when the window is full,
+        plus any already-completed results (oldest-first — retiring out of
+        order would break the staging-reuse guarantee and serve no purpose,
+        the reorder buffer waits on the oldest anyway). Returns False only
+        when an error escaped containment."""
+        while pending:
+            if len(pending) < self.config.max_inflight:
+                try:
+                    ready = pending[0][2].is_ready()
+                except AttributeError:  # non-jax result (tests/fakes)
+                    break
+                except Exception:  # noqa: BLE001 — poisoned async result:
+                    # retire it NOW so _collect_one's np.asarray surfaces
+                    # the error through the normal containment path (a
+                    # raise from here would bypass resilient mode and kill
+                    # the stream on one bad batch).
+                    ready = True
+                if not ready:
+                    break
+            if not self._collect_one(*pending.popleft(), release=False):
+                return False
+        return True
+
     def _dispatch(self) -> None:
         seq = 0
+        inline = self.config.collect_mode == "inline"
+        pending: "deque" = deque()  # inline mode's in-flight window
+        if inline:
+            self._on_idle = lambda: self._drain_ready(pending)
         try:
             while not self._abort.is_set():
                 items = self._assemble()
@@ -247,13 +293,24 @@ class Pipeline:
                     continue
                 b = self.config.batch_size
                 valid = len(items)
-                # Bounded in-flight depth; poll so a dead collect thread
-                # (which stops releasing permits) can't wedge dispatch.
-                # Acquired BEFORE touching the staging buffer — the permit
-                # is what makes buffer reuse safe (see _staging_for).
-                while not self._inflight_sem.acquire(timeout=0.1):
-                    if self._abort.is_set():
+                if inline:
+                    # Single-consumer mode: collect in-flight batches HERE
+                    # — no collect thread, no semaphore, one thread fewer
+                    # fighting for the GIL. Retire the oldest when the
+                    # window is full (the deque bound keeps staging reuse
+                    # safe: pool is max_inflight + 1) plus anything the
+                    # device already finished.
+                    if not self._drain_ready(pending):
                         return
+                else:
+                    # Bounded in-flight depth; poll so a dead collect
+                    # thread (which stops releasing permits) can't wedge
+                    # dispatch. Acquired BEFORE touching the staging
+                    # buffer — the permit is what makes buffer reuse safe
+                    # (see _staging_for).
+                    while not self._inflight_sem.acquire(timeout=0.1):
+                        if self._abort.is_set():
+                            return
                 try:
                     decode = getattr(self.queue, "decode_into", None)
                     if decode is not None:
@@ -285,43 +342,59 @@ class Pipeline:
                     except AttributeError:
                         pass
                 except Exception as e:  # noqa: BLE001 — drop this batch
-                    self._inflight_sem.release()
+                    if not inline:
+                        self._inflight_sem.release()
                     if not self._contain(e, "dispatch"):
                         return
                     continue
                 seq += 1
                 meta = [(idx, ts) for idx, _, ts in items]
-                self._inflight.put((meta, valid, result, t0))
+                if inline:
+                    pending.append((meta, valid, result, t0))
+                else:
+                    self._inflight.put((meta, valid, result, t0))
+            # Inline mode: drain the window (graceful stop / end of
+            # stream). Hard abort drops it, matching the collect thread.
+            while pending and not self._abort.is_set():
+                if not self._collect_one(*pending.popleft(), release=False):
+                    return
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
         finally:
             self._dispatch_done.set()
 
+    def _collect_one(self, meta, valid, result, t0, release=True) -> bool:
+        """Materialize one batch into the reorder buffer + sink; returns
+        False only when an error escaped containment."""
+        try:
+            out = np.asarray(result)  # blocks until the device is done
+        except Exception as e:  # noqa: BLE001 — device error: drop batch
+            if release:
+                self._inflight_sem.release()
+            return self._contain(e, "collect")
+        if release:
+            self._inflight_sem.release()
+        t1 = time.time()
+        self.tracer.complete(
+            "batch_complete", t0, t1, TRACK_DEVICE,
+            frames=[i for i, _ in meta],
+        )
+        for row, (idx, ts) in enumerate(meta[:valid]):
+            self.reorder.complete(idx, (out[row], ts))
+        self._deliver()
+        return True
+
     def _collect(self) -> None:
         try:
             while not self._abort.is_set():
                 try:
-                    meta, valid, result, t0 = self._inflight.get(timeout=0.05)
+                    item = self._inflight.get(timeout=0.05)
                 except TimeoutError:
                     if self._dispatch_done.is_set() and len(self._inflight) == 0:
                         break
                     continue
-                try:
-                    out = np.asarray(result)  # blocks until the device is done
-                except Exception as e:  # noqa: BLE001 — device error: drop batch
-                    self._inflight_sem.release()
-                    if not self._contain(e, "collect"):
-                        return
-                    continue
-                self._inflight_sem.release()
-                t1 = time.time()
-                self.tracer.complete(
-                    "batch_complete", t0, t1, TRACK_DEVICE,
-                    frames=[i for i, _ in meta],
-                )
-                for row, (idx, ts) in enumerate(meta[:valid]):
-                    self.reorder.complete(idx, (out[row], ts))
-                self._deliver()
+                if not self._collect_one(*item):
+                    return
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
 
@@ -354,8 +427,10 @@ class Pipeline:
         threads = [
             threading.Thread(target=self._ingest, name="dvf-ingest", daemon=True),
             threading.Thread(target=self._dispatch, name="dvf-dispatch", daemon=True),
-            threading.Thread(target=self._collect, name="dvf-collect", daemon=True),
         ]
+        if self.config.collect_mode != "inline":
+            threads.append(
+                threading.Thread(target=self._collect, name="dvf-collect", daemon=True))
         try:
             for t in threads:
                 t.start()

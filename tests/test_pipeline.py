@@ -261,3 +261,62 @@ class TestRingTransportPipeline:
         # Delivery stays ordered even with drops (gaps allowed).
         # (CapturingSink wasn't used here; order is covered above.)
         assert stats["delivered"] + stats["dropped_at_ingest"] <= stats["total_frames_produced"]
+
+
+class TestInlineCollectMode:
+    """collect_mode='inline': the dispatch thread retires results itself."""
+
+    def test_exact_ordered_delivery(self):
+        src_frames = {}
+        for i, (f, _) in enumerate(SyntheticSource(height=24, width=32, n_frames=30)):
+            if f is None:
+                break
+            src_frames[i] = f
+        delivered = {}
+
+        class CapturingSink(NullSink):
+            def emit(self, index, frame, ts):
+                super().emit(index, frame, ts)
+                delivered[index] = frame.copy()
+
+        pipe = Pipeline(
+            SyntheticSource(height=24, width=32, n_frames=30),
+            get_filter("invert"),
+            CapturingSink(),
+            PipelineConfig(batch_size=4, queue_size=100, frame_delay=0,
+                           collect_mode="inline"),
+        )
+        stats = pipe.run()
+        assert stats["delivered"] == 30
+        assert sorted(delivered) == list(range(30))
+        for i, frame in delivered.items():
+            np.testing.assert_array_equal(frame, 255 - src_frames[i])
+
+    def test_slow_source_latency_not_held_hostage(self):
+        """Completed batches must be delivered while waiting for frames,
+        not parked until the in-flight window fills: 8 batches at 60 fps
+        means without the idle drain each batch waits max_inflight batch
+        periods (~260 ms) before retiring; with it, transit is roughly one
+        assembly period (~70 ms). The bound sits between the two so this
+        fails if the _on_idle hook is ever lost."""
+        pipe = Pipeline(
+            SyntheticSource(height=24, width=32, n_frames=32, rate=60.0),
+            get_filter("invert"),
+            NullSink(),
+            PipelineConfig(batch_size=4, queue_size=16, frame_delay=0,
+                           max_inflight=4, collect_mode="inline"),
+        )
+        stats = pipe.run()
+        assert stats["delivered"] == 32
+        assert stats["p50_ms"] < 150.0, stats["p50_ms"]
+
+    def test_bad_collect_mode_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="collect_mode"):
+            Pipeline(
+                SyntheticSource(height=8, width=8, n_frames=2),
+                get_filter("invert"),
+                NullSink(),
+                PipelineConfig(collect_mode="bogus"),
+            )
