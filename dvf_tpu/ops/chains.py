@@ -36,3 +36,28 @@ def chain(specs=()) -> Filter:
             name, cfg = spec
             members.append(get_filter(name, **cfg))
     return FilterChain(*members)
+
+
+@register_filter("cartoon")
+def cartoon(d: int = 5, sigma_color: float = 0.15, sigma_space: float = 3.0,
+            levels: int = 6, edge_scale: float = 2.0) -> Filter:
+    """Cartoon effect: bilateral smoothing + posterized colors, darkened
+    along Sobel edges — a three-op fusion XLA compiles to ONE device
+    program (the reference would need three chained worker pools)."""
+    from dvf_tpu.api.filter import stateless
+    from dvf_tpu.ops.bilateral import bilateral_nhwc
+    from dvf_tpu.ops.conv import sobel_gradients
+    from dvf_tpu.utils.image import rgb_to_gray
+
+    import jax.numpy as jnp
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        smooth = bilateral_nhwc(batch, d=d, sigma_color=sigma_color,
+                                sigma_space=sigma_space)
+        n = float(levels - 1)
+        quant = jnp.round(jnp.clip(smooth, 0.0, 1.0) * n) / n
+        gx, gy = sobel_gradients(rgb_to_gray(batch))
+        edge = jnp.clip(jnp.sqrt(gx * gx + gy * gy) * edge_scale, 0.0, 1.0)
+        return (quant * (1.0 - edge)).astype(batch.dtype)
+
+    return stateless(f"cartoon(d={d},levels={levels})", fn, halo=d // 2)

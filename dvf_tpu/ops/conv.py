@@ -137,3 +137,31 @@ def sharpen(amount: float = 1.0, ksize: int = 5, sigma: float = 1.0) -> Filter:
         return jnp.clip(batch + amount * (batch - blurred), 0.0, 1.0)
 
     return stateless(f"sharpen(a={amount})", fn, halo=ksize // 2)
+
+
+@register_filter("emboss")
+def emboss(strength: float = 1.0) -> Filter:
+    """Classic 3x3 emboss (directional relief) on luma, +0.5 gray offset.
+
+    Non-separable kernel — lowered as one depthwise conv; reflect-101
+    borders like every other stencil here.
+    """
+    kern = np.array(
+        [[-2.0, -1.0, 0.0],
+         [-1.0, 1.0, 1.0],
+         [0.0, 1.0, 2.0]],
+        dtype=np.float32,
+    ) * strength
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        gray = rgb_to_gray(batch)
+        x = jnp.pad(gray, ((0, 0), (1, 1), (1, 1), (0, 0)), mode="reflect")
+        k4 = jnp.asarray(kern).reshape(3, 3, 1, 1)
+        y = lax.conv_general_dilated(
+            x, k4, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=_DN, feature_group_count=1,
+        )
+        out = jnp.clip(y + 0.5, 0.0, 1.0)
+        return jnp.broadcast_to(out, batch.shape).astype(batch.dtype)
+
+    return stateless(f"emboss(s={strength})", fn, halo=1)

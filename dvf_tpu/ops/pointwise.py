@@ -85,3 +85,16 @@ def sepia() -> Filter:
         return jnp.clip(out, 0.0, 1.0)
 
     return stateless("sepia", fn, halo=0)
+
+
+@register_filter("posterize")
+def posterize(levels: int = 4) -> Filter:
+    """Quantize each channel to ``levels`` evenly-spaced values."""
+    if levels < 2:
+        raise ValueError("levels must be >= 2")
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        n = float(levels - 1)
+        return jnp.round(jnp.clip(batch, 0.0, 1.0) * n) / n
+
+    return stateless(f"posterize({levels})", fn, halo=0)

@@ -140,3 +140,60 @@ class TestPointwiseExtras:
         f = to_float(jnp.asarray(frame_u8))
         back = to_uint8(f)
         np.testing.assert_array_equal(np.asarray(back), frame_u8)
+
+
+class TestPosterize:
+    def test_matches_formula(self, batch_f32):
+        filt = get_filter("posterize", levels=4)
+        out, _ = filt.fn(jnp.asarray(batch_f32), None)
+        want = np.round(np.clip(batch_f32, 0, 1) * 3) / 3
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
+
+    def test_level_count(self, batch_f32):
+        filt = get_filter("posterize", levels=3)
+        out, _ = filt.fn(jnp.asarray(batch_f32), None)
+        assert len(np.unique(np.asarray(out))) <= 3
+
+    def test_rejects_bad_levels(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            get_filter("posterize", levels=1)
+
+
+class TestEmboss:
+    def test_matches_numpy_correlation(self, frame_u8):
+        from dvf_tpu.utils.image import rgb_to_gray as _gray_jnp
+
+        filt = get_filter("emboss")
+        f32 = frame_u8.astype(np.float32) / 255.0
+        out = apply_one(filt.fn, f32)
+        # Reference: direct correlation on luma with reflect-101 borders.
+        kern = np.array([[-2, -1, 0], [-1, 1, 1], [0, 1, 2]], np.float32)
+        gray = np.asarray(_gray_jnp(jnp.asarray(f32), keepdims=False))
+        pad = np.pad(gray, 1, mode="reflect")
+        want = np.zeros_like(gray)
+        for dy in range(3):
+            for dx in range(3):
+                want += kern[dy, dx] * pad[dy:dy + gray.shape[0], dx:dx + gray.shape[1]]
+        want = np.clip(want + 0.5, 0, 1)
+        np.testing.assert_allclose(out[..., 0], want, atol=1e-5)
+        # Broadcast to 3 identical channels.
+        assert np.array_equal(out[..., 0], out[..., 1])
+
+
+class TestCartoon:
+    def test_structure(self, frame_u8):
+        """Cartoon output: fewer distinct colors than input away from
+        edges, darkened along strong edges."""
+        filt = get_filter("cartoon", levels=4)
+        f32 = frame_u8.astype(np.float32) / 255.0
+        out = apply_one(filt.fn, f32)
+        assert out.shape == f32.shape
+        assert out.min() >= 0.0 and out.max() <= 1.0
+        # Edge darkening: mean output <= mean of the posterized smooth
+        # (multiplying by (1-edge) can only darken).
+        smooth_only = apply_one(
+            get_filter("bilateral", d=5, sigma_color=0.15, sigma_space=3.0).fn, f32)
+        quant = np.round(np.clip(smooth_only, 0, 1) * 3) / 3
+        assert out.mean() <= quant.mean() + 1e-6
