@@ -128,7 +128,8 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
 
 
 def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
-                  queue_size, collect_mode="thread") -> dict:
+                  queue_size, collect_mode="thread", transport="python",
+                  wire="raw") -> dict:
     import numpy as np
 
     from dvf_tpu.io.sinks import NullSink
@@ -138,6 +139,13 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
     engine = Engine(filt)
     engine.compile((batch_size, height, width, 3), np.uint8)
     sink = NullSink()
+    queue = None
+    if transport == "ring":
+        from dvf_tpu.transport.ring_queue import RingFrameQueue
+
+        queue = RingFrameQueue((height, width, 3),
+                               capacity_frames=queue_size,
+                               jpeg=(wire == "jpeg"))
     pipe = Pipeline(
         source,
         filt,
@@ -150,6 +158,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
             collect_mode=collect_mode,
         ),
         engine=engine,
+        queue=queue,
     )
     t0 = time.perf_counter()
     stats = pipe.run()
@@ -175,12 +184,17 @@ def bench_e2e_streaming(
     queue_size: Optional[int] = None,
     rate: float = 0.0,
     collect_mode: str = "thread",
+    transport: str = "python",
+    wire: str = "raw",
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
-    The p50/p99 this returns are congestion numbers (queue depth), kept
-    for backward compatibility — use :func:`bench_e2e_latency` for the
-    latency claim.
+    ``transport="ring"`` routes ingest through the native C++ ring
+    (``wire="jpeg"`` additionally JPEG-encodes at capture and decodes into
+    the dispatch staging buffer — the measured cost of the reference's
+    use_jpeg path, SURVEY §7 hard part 3). The p50/p99 this returns are
+    congestion numbers (queue depth), kept for backward compatibility —
+    use :func:`bench_e2e_latency` for the latency claim.
     """
     from dvf_tpu.io.sources import SyntheticSource
 
@@ -189,7 +203,7 @@ def bench_e2e_streaming(
         SyntheticSource(height=height, width=width, n_frames=n_frames, rate=rate),
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
-        collect_mode=collect_mode,
+        collect_mode=collect_mode, transport=transport, wire=wire,
     )
 
 

@@ -221,7 +221,8 @@ def cmd_bench(args) -> int:
 
     if args.e2e:
         r = bench_e2e_streaming(filt, args.frames, batch, h, w,
-                                collect_mode=args.collect_mode)
+                                collect_mode=args.collect_mode,
+                                transport=args.transport, wire=args.wire)
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -230,6 +231,8 @@ def cmd_bench(args) -> int:
             "p99_ms": round(r["p99_ms"], 3),
             "frames": r["frames"],
             "collect_mode": args.collect_mode,
+            "transport": args.transport,
+            "wire": args.wire,
         }
     else:
         r = bench_device_resident(filt, args.iters, batch, h, w)
@@ -445,6 +448,11 @@ def main(argv=None) -> int:
                     help="e2e pipeline collect mode — 'inline' matches the "
                          "headline bench.py harness (both record it in "
                          "their JSON so cross-harness numbers compare)")
+    bp.add_argument("--transport", choices=("python", "ring"), default="python",
+                    help="--e2e ingest transport (ring = native C++ ring)")
+    bp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
+                    help="--e2e ring payload format (jpeg measures the "
+                         "codec-on-the-hot-path cost)")
 
     args = ap.parse_args(argv)
     return {

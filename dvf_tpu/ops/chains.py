@@ -51,6 +51,9 @@ def cartoon(d: int = 5, sigma_color: float = 0.15, sigma_space: float = 3.0,
 
     import jax.numpy as jnp
 
+    if levels < 2:
+        raise ValueError("levels must be >= 2")  # levels=1 → 0/0 = NaN frames
+
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         smooth = bilateral_nhwc(batch, d=d, sigma_color=sigma_color,
                                 sigma_space=sigma_space)
@@ -60,4 +63,9 @@ def cartoon(d: int = 5, sigma_color: float = 0.15, sigma_space: float = 3.0,
         edge = jnp.clip(jnp.sqrt(gx * gx + gy * gy) * edge_scale, 0.0, 1.0)
         return (quant * (1.0 - edge)).astype(batch.dtype)
 
-    return stateless(f"cartoon(d={d},levels={levels})", fn, halo=d // 2)
+    # Halo: bilateral (d//2) and Sobel (1) both read the ORIGINAL batch,
+    # so the requirement is their max, and never 0 (d=1 must not demote
+    # this to pointwise under spatial sharding — the Sobel term would read
+    # shard-local borders).
+    return stateless(f"cartoon(d={d},levels={levels})", fn,
+                     halo=max(d // 2, 1))

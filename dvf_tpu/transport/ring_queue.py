@@ -19,6 +19,16 @@ Two wire formats, mirroring the reference's ``use_jpeg`` switch
   dispatch staging buffer that feeds ``device_put`` — no intermediate
   stack/copy.
 
+When to use which (measured, 1080p invert e2e on CPU, inline collect):
+in-process Python queue 139 fps (frames pass as zero-copy views);
+ring/raw 75 fps (one serialize + one deserialize memcpy per frame buys
+cross-process shm capability and byte-bounded freshness); ring/jpeg
+16 fps (the ~60 ms/frame 1080p encode in the capture thread dominates —
+the codec-throughput wall SURVEY §7 hard part 3 predicts; JPEG pays off
+when the wire is a network, not shm, or at the reference's 512² geometry
+where encode is ~5-10 ms). `dvf_tpu bench --e2e --transport/--wire`
+reproduces these numbers on any backend.
+
 Differences from the Python queue, by design:
 
 - The bound is **bytes**, not frames (``capacity_frames`` is converted

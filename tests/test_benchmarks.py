@@ -46,3 +46,11 @@ def test_e2e_latency_mode_is_rate_controlled():
     assert r["dropped"] == 0
     assert r["frames"] == 16
     assert 0 < r["p50_ms"] < 1000.0
+
+
+def test_e2e_streaming_ring_transport_variants():
+    """bench plumbing for --transport ring / --wire jpeg (tiny shapes)."""
+    for wire in ("raw", "jpeg"):
+        r = bench_e2e_streaming(get_filter("invert"), 16, 4, 24, 32,
+                                transport="ring", wire=wire)
+        assert r["frames"] == 16, (wire, r)

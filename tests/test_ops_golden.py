@@ -197,3 +197,13 @@ class TestCartoon:
             get_filter("bilateral", d=5, sigma_color=0.15, sigma_space=3.0).fn, f32)
         quant = np.round(np.clip(smooth_only, 0, 1) * 3) / 3
         assert out.mean() <= quant.mean() + 1e-6
+
+
+def test_cartoon_rejects_bad_levels():
+    with pytest.raises(ValueError):
+        get_filter("cartoon", levels=1)
+
+
+def test_cartoon_halo_never_pointwise():
+    assert get_filter("cartoon", d=1).halo == 1  # Sobel term needs it
+    assert get_filter("cartoon", d=5).halo == 2
