@@ -83,6 +83,10 @@ def main(argv=None) -> int:
                          "batches bound the assemble wait)")
     ap.add_argument("--e2e-budget-s", type=float, default=60.0,
                     help="target wall time for each e2e phase")
+    ap.add_argument("--init-timeout", type=float, default=150.0,
+                    help="give up on backend init after this many seconds "
+                         "(healthy init is <5 s; a hung tunnel never "
+                         "recovers within one bench window)")
     ap.add_argument("--collect-mode", choices=("thread", "inline"),
                     default="inline",
                     help="pipeline collect mode for the e2e phases; inline "
@@ -110,8 +114,33 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
+    # Init watchdog: a healthy backend initializes in <5 s (measured 0.1 s
+    # on this tunnel); one that hasn't come up after --init-timeout never
+    # will this window. The init call is uncancellable, so probe it from a
+    # worker thread and hard-exit on timeout — rc=3 tells the parent to
+    # fall back NOW instead of burning the whole bench budget.
+    got: dict = {}
+
+    def _init():
+        try:
+            got["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — init can throw UNAVAILABLE
+            got["error"] = e
+
+    t = threading.Thread(target=_init, daemon=True)
     with _heartbeat_during("backend init"):
-        devices = jax.devices()
+        t.start()
+        t.join(args.init_timeout)
+    if "devices" not in got:
+        if "error" in got:
+            _log(f"backend init failed: {got['error']!r}")
+        else:
+            _log(f"backend init exceeded {args.init_timeout:.0f}s — "
+                 f"tunnel is down, exiting for fast fallback")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(3)
+    devices = got["devices"]
     backend = jax.default_backend()
     _log(f"backend={backend} n_devices={len(devices)} device0={devices[0]}")
 

@@ -161,7 +161,13 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
         queue=queue,
     )
     t0 = time.perf_counter()
-    stats = pipe.run()
+    try:
+        stats = pipe.run()
+    finally:
+        # run() closes the queue on the happy path only; an erroring run
+        # must not leak the native ring / codec thread pool.
+        if queue is not None:
+            queue.close()
     wall = time.perf_counter() - t0
     pct = sink.latency_percentiles()
     return {

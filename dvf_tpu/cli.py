@@ -235,6 +235,11 @@ def cmd_bench(args) -> int:
             "wire": args.wire,
         }
     else:
+        if args.transport != "python" or args.wire != "raw":
+            print("error: --transport/--wire only apply to --e2e runs "
+                  "(device-resident mode never touches the ingest path)",
+                  file=sys.stderr)
+            return 2
         r = bench_device_resident(filt, args.iters, batch, h, w)
         out = {
             "metric": f"{args.config}_device_fps",
