@@ -67,6 +67,44 @@ def cmd_filters(_args) -> int:
     return 0
 
 
+def _resolve_source(args, allow_shm: bool = True):
+    """Build the frame source named by ``args.source`` and return
+    ``(source, frame_shape)`` — ONE place owns the per-source geometry
+    (synthetic: --height/--width; webcam/file: --target-size square), so
+    the camera producer, the serve consumer, and the ring transport can
+    never disagree about it within an invocation."""
+    from dvf_tpu.io.sources import (
+        ShmRingSource,
+        SyntheticSource,
+        VideoFileSource,
+        WebcamSource,
+    )
+
+    if args.source == "synthetic":
+        return (
+            SyntheticSource(height=args.height, width=args.width,
+                            n_frames=args.frames, rate=args.rate),
+            (args.height, args.width, 3),
+        )
+    if args.source.startswith("shm:"):
+        if not allow_shm:
+            raise SystemExit("error: the camera producer cannot read from "
+                             "an shm ring (that's serve's side)")
+        shape = (args.height, args.width, 3)
+        return ShmRingSource(args.source[4:], frame_shape=shape), shape
+    if args.source == "webcam":
+        return (WebcamSource(target_size=args.target_size),
+                (args.target_size, args.target_size, 3))
+    # Ring consumers need fixed geometry; file sources get it from
+    # --target-size whenever any fixed-geometry consumer is in play.
+    force_crop = getattr(args, "transport", "python") == "ring" or not allow_shm
+    return (
+        VideoFileSource(args.source, rate=args.rate,
+                        target_size=args.target_size if force_crop else None),
+        (args.target_size, args.target_size, 3),
+    )
+
+
 def cmd_serve(args) -> int:
     _force_platform()
 
@@ -74,7 +112,6 @@ def cmd_serve(args) -> int:
 
     from dvf_tpu.io.display import LiveTap, SideBySideSink
     from dvf_tpu.io.sinks import NullSink
-    from dvf_tpu.io.sources import SyntheticSource, VideoFileSource, WebcamSource
     from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
 
     if args.style_checkpoint:
@@ -92,20 +129,7 @@ def cmd_serve(args) -> int:
             return 2
     else:
         filt = _parse_filter_arg(args.filter, args.filter_config)
-    if args.source == "synthetic":
-        source = SyntheticSource(
-            height=args.height, width=args.width, n_frames=args.frames, rate=args.rate
-        )
-    elif args.source == "webcam":
-        source = WebcamSource(target_size=args.target_size)
-    else:
-        # Ring transport carries fixed-geometry payloads, so file sources
-        # must be cropped to the same --target-size square the ring queue
-        # below is constructed with (native geometry otherwise).
-        source = VideoFileSource(
-            args.source, rate=args.rate,
-            target_size=args.target_size if args.transport == "ring" else None,
-        )
+    source, frame_shape = _resolve_source(args)
 
     # Live serving is resilient (one bad frame never kills the stream,
     # worker.py:71-76 semantics) with the reference's 5 s telemetry prints
@@ -125,16 +149,10 @@ def cmd_serve(args) -> int:
     if args.transport == "ring":
         from dvf_tpu.transport.ring_queue import RingFrameQueue
 
-        # The ring carries fixed-geometry payloads; every source above is
-        # constructed to a known frame shape (synthetic: --height/--width;
-        # webcam and file: --target-size center crop — file sources get
-        # target_size forced above exactly for this).
-        if args.source == "synthetic":
-            shape = (args.height, args.width, 3)
-        else:
-            shape = (args.target_size, args.target_size, 3)
+        # Same geometry the source was resolved with — _resolve_source is
+        # the single owner of per-source frame shape.
         queue = RingFrameQueue(
-            frame_shape=shape,
+            frame_shape=frame_shape,
             capacity_frames=args.queue_size,
             jpeg=(args.wire == "jpeg"),
         )
@@ -204,6 +222,62 @@ def cmd_worker(args) -> int:
         pass
     finally:
         worker.close()
+    return 0
+
+
+def cmd_camera(args) -> int:
+    """Producer half of the cross-process shm path: capture (or
+    synthesize) frames in THIS process and push them into a POSIX
+    shared-memory ring that a `serve --source shm:NAME` process consumes —
+    the reference's app→worker process boundary (distributor.py:27-35)
+    with the C++ ring instead of ZMQ sockets."""
+    import time as _time
+
+    from dvf_tpu.transport.ring import FrameRing
+
+    source, frame_shape = _resolve_source(args, allow_shm=False)
+    frame_bytes = frame_shape[0] * frame_shape[1] * frame_shape[2]
+    print(f"[camera] pushing {frame_shape} frames into shm ring "
+          f"{args.shm!r} — consume with: serve --source shm:{args.shm} "
+          f"--height {frame_shape[0]} --width {frame_shape[1]}",
+          file=sys.stderr)
+
+    ring = FrameRing(
+        capacity_bytes=max(1, args.queue_size) * (frame_bytes + 64),
+        shm_name=args.shm,
+        create=True,
+        max_frame_bytes=frame_bytes + 64,
+    )
+    pushed = 0
+    try:
+        for idx, (frame, ts) in enumerate(iter(source)):
+            if frame is None:
+                break
+            evicted = ring.push(frame.tobytes(), idx, ts)
+            pushed += 1
+            if evicted:
+                # Consumer is behind: freshness beats completeness (the
+                # ring evicted oldest), pace like the pipeline's ingest.
+                _time.sleep(0.0002)
+        ring.push(b"\x00", pushed, _time.time())  # EOF sentinel
+        # Before the creator unlinks: wait for a consumer to attach AND
+        # drain. A serve process cold-starting jax can take >5 s to
+        # attach; unlinking on a drain-only check would destroy a short
+        # capture before anyone saw it.
+        deadline = _time.time() + args.linger_s
+        while _time.time() < deadline:
+            if ring.popped > 0 and len(ring) == 0:
+                break
+            _time.sleep(0.01)
+    except KeyboardInterrupt:
+        try:
+            ring.push(b"\x00", pushed, _time.time())
+        except Exception:
+            pass
+    finally:
+        stats = {"pushed": pushed, "dropped": ring.dropped}
+        ring.close()
+    print(json.dumps(stats))
     return 0
 
 
@@ -375,7 +449,10 @@ def main(argv=None) -> int:
     sp = sub.add_parser("serve", help="run the pipeline")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
-    sp.add_argument("--source", default="synthetic", help="synthetic|webcam|<video path>")
+    sp.add_argument("--source", default="synthetic",
+                    help="synthetic|webcam|shm:<name>|<video path> "
+                         "(shm: consume a `dvf_tpu camera --shm <name>` "
+                         "producer process)")
     sp.add_argument("--height", type=int, default=720)
     sp.add_argument("--width", type=int, default=1280)
     sp.add_argument("--frames", type=int, default=300)
@@ -409,6 +486,25 @@ def main(argv=None) -> int:
                     help="with --transport ring: payload format on the ring "
                          "(jpeg = encode at capture, decode into the device "
                          "staging buffer — the reference's use_jpeg path)")
+
+    cp = sub.add_parser(
+        "camera",
+        help="push frames into a shared-memory ring for a serve process")
+    cp.add_argument("--shm", required=True, help="shm ring name")
+    cp.add_argument("--source", default="synthetic",
+                    help="synthetic|webcam|<video path>")
+    cp.add_argument("--height", type=int, default=720)
+    cp.add_argument("--width", type=int, default=1280)
+    cp.add_argument("--frames", type=int, default=300)
+    cp.add_argument("--rate", type=float, default=30.0,
+                    help="synthetic/file fps; 0 = unthrottled")
+    cp.add_argument("--target-size", type=int, default=512)
+    cp.add_argument("--queue-size", type=int, default=10,
+                    help="ring capacity in frames (drop-oldest beyond)")
+    cp.add_argument("--linger-s", type=float, default=20.0,
+                    help="after the last frame, wait up to this long for a "
+                         "consumer to attach and drain before unlinking "
+                         "the shm ring (serve cold-start can take ~10 s)")
 
     wp = sub.add_parser("worker", help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")
@@ -462,7 +558,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     return {
         "filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker,
-        "bench": cmd_bench, "train": cmd_train,
+        "bench": cmd_bench, "train": cmd_train, "camera": cmd_camera,
     }[args.cmd](args)
 
 

@@ -171,3 +171,86 @@ class WebcamSource:
         finally:
             cap.release()
         yield None, time.time()
+
+
+class ShmRingSource:
+    """Consume frames that a SEPARATE PROCESS pushes into a POSIX
+    shared-memory ring (`python -m dvf_tpu camera --shm NAME` is the
+    producer) — the §2b 'camera process → framework process' path, with
+    the C++ ring as the process boundary instead of the reference's ZMQ
+    sockets. Drop-oldest freshness is enforced inside the ring by the
+    producer's push.
+
+    Wire format: raw uint8 frames of ``frame_shape``; a 1-byte payload is
+    the end-of-stream sentinel (a real frame is always H·W·3 > 1 bytes).
+    ``attach_timeout_s`` bounds waiting for the producer to create the
+    ring; ``idle_timeout_s`` (None = forever) bounds waiting for the next
+    frame once attached.
+    """
+
+    def __init__(
+        self,
+        shm_name: str,
+        frame_shape: Tuple[int, int, int],
+        attach_timeout_s: float = 10.0,
+        idle_timeout_s: Optional[float] = 30.0,
+        poll_s: float = 0.002,
+    ):
+        self.shm_name = shm_name
+        self.frame_shape = tuple(frame_shape)
+        self.attach_timeout_s = attach_timeout_s
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_s = poll_s
+
+    def __iter__(self) -> Iterator[Frame]:
+        from dvf_tpu.transport.ring import FrameRing
+
+        frame_bytes = int(np.prod(self.frame_shape))
+        deadline = time.perf_counter() + self.attach_timeout_s
+        ring = None
+        while ring is None:
+            try:
+                # Pop buffer sized well beyond the expected frame so a
+                # geometry mismatch surfaces as the explanatory ValueError
+                # below, not as a 'raise max_frame_bytes' buffer error.
+                ring = FrameRing(shm_name=self.shm_name, create=False,
+                                 max_frame_bytes=max(4 * frame_bytes, 8 << 20))
+            except OSError:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError(
+                        f"no producer created shm ring {self.shm_name!r} "
+                        f"within {self.attach_timeout_s:.0f}s")
+                time.sleep(0.05)
+        try:
+            idle_since = time.perf_counter()
+            while True:
+                rec = ring.pop()
+                if rec is None:
+                    if (self.idle_timeout_s is not None
+                            and time.perf_counter() - idle_since > self.idle_timeout_s):
+                        break  # producer stalled/died: end the stream
+                    time.sleep(self.poll_s)
+                    continue
+                idle_since = time.perf_counter()
+                payload, idx, ts = rec
+                if len(payload) <= 1:
+                    break  # EOF sentinel
+                expected = int(np.prod(self.frame_shape))
+                if len(payload) != expected:
+                    # The two processes disagree about geometry — fail with
+                    # the fix, not a reshape traceback. Square producers
+                    # (webcam/file push --target-size²) are recognizable
+                    # from the byte count.
+                    s = int(round((len(payload) / 3) ** 0.5))
+                    hint = (f" (producer frames look like a --target-size "
+                            f"{s} square — pass --height {s} --width {s})"
+                            if s * s * 3 == len(payload) else "")
+                    raise ValueError(
+                        f"shm producer pushed {len(payload)}-byte frames; "
+                        f"this consumer expects {self.frame_shape} = "
+                        f"{expected} bytes{hint}")
+                yield (np.frombuffer(payload, np.uint8)
+                       .reshape(self.frame_shape), ts)
+        finally:
+            ring.close()
+        yield None, time.time()

@@ -109,7 +109,12 @@ Ring* make_ring(void* base, uint64_t total, bool init, bool owns_shm, const char
     r->ctl->popped.store(0, std::memory_order_relaxed);
     r->ctl->capacity = total - align_up(sizeof(Control));
     r->ctl->magic = kMagic;
-  } else if (r->ctl->magic != kMagic) {
+  } else if (r->ctl->magic != kMagic ||
+             r->ctl->capacity > total - align_up(sizeof(Control))) {
+    // Reject segments whose recorded capacity exceeds the mapped size —
+    // a stale/mid-recreation segment would otherwise drive ring_read/
+    // ring_write past the mapping (SIGBUS), since the attach path takes
+    // geometry from the segment itself.
     delete r;
     return nullptr;
   }
@@ -128,7 +133,10 @@ Ring* ring_create(uint64_t capacity_bytes) {
   return make_ring(base, total, /*init=*/true, /*owns_shm=*/false, nullptr);
 }
 
-// Cross-process ring backed by POSIX shared memory. create=1 initializes.
+// Cross-process ring backed by POSIX shared memory. create=1 initializes
+// with the given capacity; create=0 ATTACHES and takes the geometry from
+// the segment itself (capacity_bytes is ignored — the creator decided it;
+// requiring the attacher to guess would reject any mismatch).
 Ring* ring_create_shm(const char* name, uint64_t capacity_bytes, int create) {
   uint64_t total = align_up(sizeof(Control)) + align_up(capacity_bytes);
   int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
@@ -141,10 +149,12 @@ Ring* ring_create_shm(const char* name, uint64_t capacity_bytes, int create) {
   }
   if (!create) {
     struct stat st;
-    if (fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < total) {
+    if (fstat(fd, &st) != 0 ||
+        static_cast<uint64_t>(st.st_size) < align_up(sizeof(Control))) {
       close(fd);
       return nullptr;
     }
+    total = static_cast<uint64_t>(st.st_size);
   }
   void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
@@ -243,6 +253,7 @@ uint64_t ring_approx_len(Ring* r) {
 
 uint64_t ring_dropped(Ring* r) { return r->ctl->dropped.load(std::memory_order_relaxed); }
 uint64_t ring_pushed(Ring* r) { return r->ctl->pushed.load(std::memory_order_relaxed); }
+uint64_t ring_popped(Ring* r) { return r->ctl->popped.load(std::memory_order_relaxed); }
 uint64_t ring_capacity(Ring* r) { return r->ctl->capacity; }
 
 void ring_destroy(Ring* r) {

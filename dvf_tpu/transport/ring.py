@@ -76,7 +76,8 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_double),
         ]
-        for name in ("ring_approx_len", "ring_dropped", "ring_pushed", "ring_capacity"):
+        for name in ("ring_approx_len", "ring_dropped", "ring_pushed",
+                     "ring_popped", "ring_capacity"):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_uint64
             fn.argtypes = [ctypes.c_void_p]
@@ -171,6 +172,12 @@ class FrameRing:
     @property
     def pushed(self) -> int:
         return int(self._lib.ring_pushed(self._live_ptr()))
+
+    @property
+    def popped(self) -> int:
+        """Total records consumed — a cross-process 'has anyone attached
+        and started draining' signal for producers."""
+        return int(self._lib.ring_popped(self._live_ptr()))
 
     @property
     def capacity(self) -> int:

@@ -80,3 +80,45 @@ def test_serve_ring_transport_jpeg_wire(capsys):
     assert rc == 0
     stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert stats["delivered"] == 12
+
+
+def test_camera_to_serve_over_shm(tmp_path):
+    """Two REAL processes: `camera` pushes synthetic frames into a POSIX
+    shm ring, `serve --source shm:NAME` consumes, filters, delivers — the
+    reference's app→worker process boundary over the C++ ring."""
+    import os
+    import subprocess
+    import sys
+    import uuid
+
+    name = f"/dvf_test_{uuid.uuid4().hex[:8]}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["DVF_FORCE_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+
+    producer = subprocess.Popen(
+        [sys.executable, "-m", "dvf_tpu", "camera", "--shm", name,
+         "--source", "synthetic", "--height", "32", "--width", "32",
+         "--frames", "24", "--rate", "120", "--queue-size", "64"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    consumer = subprocess.Popen(
+        [sys.executable, "-m", "dvf_tpu", "serve", "--source", f"shm:{name}",
+         "--filter", "invert", "--height", "32", "--width", "32",
+         "--batch", "4", "--frame-delay", "0", "--queue-size", "64",
+         "--quiet"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    pout, _ = producer.communicate(timeout=120)
+    cout, _ = consumer.communicate(timeout=120)
+    assert producer.returncode == 0, pout[-2000:]
+    assert consumer.returncode == 0, cout[-2000:]
+    pstats = json.loads(pout.strip().splitlines()[-1])
+    cstats = json.loads(cout.strip().splitlines()[-1])
+    assert pstats["pushed"] == 24
+    # At-most-once across the process boundary: everything the ring didn't
+    # evict must be delivered, in order (ordering asserted by the reorder
+    # invariants; here we check conservation).
+    assert cstats["delivered"] + pstats["dropped"] >= 24 - cstats["dropped_at_ingest"]
+    assert cstats["delivered"] > 0
