@@ -354,3 +354,81 @@ def flow_vis(levels: int = 3, win_size: int = 15, n_iters: int = 3, max_mag: flo
         return out.astype(batch.dtype), new_state
 
     return Filter(name="flow_vis", fn=fn, init_state=init_state)
+
+
+@register_filter("ema_smooth")
+def ema_smooth(alpha: float = 0.35) -> Filter:
+    """Temporal exponential smoothing — motion-trail / denoise.
+
+    y_i = alpha·x_i + (1-alpha)·y_{i-1}, chained across batches through
+    device-resident state (the second temporal-window filter after
+    flow_warp; being pointwise (halo=0) AND stateful it exercises the
+    engine's GSPMD H-sharding path for stateful filters).
+
+    Two deliberate design points:
+
+    - **Bit-identical consecutive frames are no-ops** (A=1, B=0 in the
+      recurrence). A repeated frame carries no new information, and this
+      is what makes the filter EXACTLY pad-safe: the runtime pads short
+      batches by repeating the last valid frame, and with repeat→no-op
+      the carried state is literally independent of the pad count — the
+      Filter.pad_safe contract ('state depends only on the most recent
+      valid frame') holds as an identity, not an approximation.
+    - The recurrence runs as a ``lax.associative_scan`` over the batch
+      dim (first-order linear recurrences compose associatively:
+      ``(A,B)∘(A',B') = (A·A', A'·B + B')``), so the batch dimension
+      stays parallel — a sequential ``lax.scan`` carry would serialize
+      across the data-sharded mesh axis and idle every shard but one.
+    """
+    if not 0.0 < alpha <= 1.0:
+        raise ValueError("alpha must be in (0, 1]")
+
+    def init_state(batch_shape: Sequence[int], dtype: Any):
+        _, h, w, c = batch_shape
+        return {
+            "ema": jnp.zeros((h, w, c), dtype=dtype),
+            "prev": jnp.zeros((h, w, c), dtype=dtype),
+            "initialized": jnp.zeros((), dtype=jnp.bool_),
+        }
+
+    def fn(batch: jnp.ndarray, state) -> Tuple[jnp.ndarray, Any]:
+        a = jnp.asarray(alpha, batch.dtype)
+        # First-ever frame: seed the EMA with it instead of fading in
+        # from black.
+        seed = jnp.where(state["initialized"], state["ema"], batch[0])
+        # Per-frame transform y_i = A_i·y_{i-1} + B_i, with repeats
+        # (x_i == x_{i-1} bit-exact) as identity transforms. The carried
+        # "prev" frame extends repeat detection across the batch boundary,
+        # so the semantics are independent of how the stream was
+        # partitioned into batches.
+        same0 = jnp.logical_and(
+            state["initialized"],
+            jnp.all(batch[0] == state["prev"]),
+        )[None]
+        same = jnp.concatenate([
+            same0,
+            jnp.all(batch[1:] == batch[:-1], axis=(1, 2, 3)),
+        ])[:, None, None, None]
+        A = jnp.where(same, 1.0, 1.0 - a).astype(batch.dtype)
+        B = jnp.where(same, 0.0, a * batch).astype(batch.dtype)
+
+        def combine(left, right):
+            al, bl = left
+            ar, br = right
+            return al * ar, ar * bl + br
+
+        Ac, Bc = lax.associative_scan(combine, (A, B), axis=0)
+        ys = Ac * seed[None] + Bc
+        new_state = {
+            "ema": ys[-1],
+            "prev": batch[-1],
+            "initialized": jnp.ones((), dtype=jnp.bool_),
+        }
+        return ys.astype(batch.dtype), new_state
+
+    return Filter(
+        name=f"ema_smooth(a={alpha})",
+        fn=fn,
+        init_state=init_state,
+        halo=0,
+    )
