@@ -92,9 +92,13 @@ class SideBySideSink:
             sh = max(1, int(round(live.shape[0] * scale)))
             sw = max(1, int(round(live.shape[1] * scale)))
             if (sh, sw) != live.shape[:2]:
-                ri = (np.arange(sh) * live.shape[0] / sh).astype(np.intp)
-                ci = (np.arange(sw) * live.shape[1] / sw).astype(np.intp)
-                live = live[ri][:, ci]  # nearest-neighbor; no cv2 dependency
+                # Centered nearest-neighbor (sample at pixel centers, not
+                # top-left corners — corner sampling never reads the last
+                # row/col when downscaling); no cv2 dependency.
+                ri = ((np.arange(sh) + 0.5) * live.shape[0] / sh).astype(np.intp)
+                ci = ((np.arange(sw) + 0.5) * live.shape[1] / sw).astype(np.intp)
+                live = live[np.minimum(ri, live.shape[0] - 1)][
+                    :, np.minimum(ci, live.shape[1] - 1)]
             boxed = np.zeros_like(processed)
             y0, x0 = (h - sh) // 2, (w - sw) // 2
             boxed[y0:y0 + sh, x0:x0 + sw] = live

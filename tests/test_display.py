@@ -36,13 +36,39 @@ def test_side_by_side_composition_headless():
 
 
 def test_side_by_side_letterboxes_mismatched_live():
+    """Smaller live frame scales up (aspect-preserving) to fill the pane."""
     tap = LiveTap([])
-    tap.latest = np.full((4, 3, 3), 7, np.uint8)
+    live = np.zeros((4, 3, 3), np.uint8)
+    live[0, 0] = 200  # marker at top-left
+    live[3, 2] = 100  # marker at bottom-right
+    tap.latest = live
     sink = SideBySideSink(tap, headless=True)
     processed = np.zeros((8, 6, 3), np.uint8)
     sink.emit(0, processed, time.time())
-    assert sink.last_pane.shape == (8, 12, 3)
-    np.testing.assert_array_equal(sink.last_pane[:4, :3], tap.latest)
+    pane = sink.last_pane
+    assert pane.shape == (8, 12, 3)
+    # 4x3 scales exactly 2x into the 8x6 pane: markers land scaled, not
+    # corner-cropped.
+    assert pane[0, 0, 0] == 200 and pane[1, 1, 0] == 200
+    assert pane[7, 5, 0] == 100 and pane[6, 4, 0] == 100
+
+
+def test_side_by_side_downscales_larger_live_not_crop():
+    """A live feed LARGER than the processed pane must be scaled down to
+    fit (showing the whole frame), never corner-cropped (ADVICE r2)."""
+    tap = LiveTap([])
+    live = np.zeros((16, 12, 3), np.uint8)
+    live[15, 11] = 250  # bottom-right content — a crop would lose this
+    tap.latest = live
+    sink = SideBySideSink(tap, headless=True)
+    processed = np.zeros((8, 6, 3), np.uint8)
+    sink.emit(0, processed, time.time())
+    pane = sink.last_pane
+    assert pane.shape == (8, 12, 3)
+    left = pane[:, :6]
+    # The bottom-right marker survives somewhere in the scaled pane.
+    assert left.max() == 250
+    assert left[7, 5, 0] == 250
 
 
 def test_esc_invokes_stop_callback(monkeypatch):
