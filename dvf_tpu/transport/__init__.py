@@ -7,12 +7,14 @@ This package replaces the reference's transport layer (SURVEY.md §2d):
   threads or processes and the batch assembler — no socket on the local
   hot path;
 - the TurboJPEG codec role (webcam_app.py:24,110,140; inverter.py:32,44)
-  lives in :mod:`dvf_tpu.transport.codec` (threaded, feeding uint8 NHWC
-  staging buffers — JPEG stays host-side; the TPU sees dense arrays);
+  lives in :mod:`dvf_tpu.transport.codec`: a C++ libjpeg-turbo shim
+  (``jpeg_shim.cpp``) that decodes zero-copy into the uint8 NHWC staging
+  array handed to device_put, with a threaded cv2 fallback — JPEG stays
+  host-side; the TPU sees dense arrays;
 - :mod:`dvf_tpu.transport.zmq_ingress` speaks the reference's exact wire
   protocol so the unmodified reference app can front this framework as if
   it were a pool of workers (the north-star ``--backend`` switch).
 """
 
 from dvf_tpu.transport.ring import FrameRing  # noqa: F401
-from dvf_tpu.transport.codec import JpegCodec  # noqa: F401
+from dvf_tpu.transport.codec import JpegCodec, NativeJpegCodec, make_codec  # noqa: F401

@@ -1,0 +1,52 @@
+"""Shared build-and-load for the in-repo C++ shims (ring.cpp, jpeg_shim.cpp).
+
+One scheme for every native piece: compile with g++ on first use (no
+pybind11 in this environment; ctypes keeps the binding dependency-free)
+and cache the .so next to the source. Staleness is decided by a CONTENT
+HASH of the source stored in a sidecar file — not mtimes, which are
+arbitrary after a fresh clone and would let a stale (or tampered)
+artifact load silently. The .so is never committed (.gitignore); it is
+always the product of the reviewed source on this machine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Sequence, Type
+
+_BUILD_LOCK = threading.Lock()
+
+
+def load_native(
+    src: str,
+    lib: str,
+    extra_flags: Sequence[str] = (),
+    cdll_cls: Type[ctypes.CDLL] = ctypes.CDLL,
+) -> ctypes.CDLL:
+    """Build ``src`` -> ``lib`` if the cached .so is missing/stale, load it.
+
+    ``cdll_cls`` picks the GIL policy per library: ``ctypes.PyDLL`` holds
+    the GIL across calls (right for sub-microsecond ops like the ring,
+    where per-call GIL handoff costs 1000x), ``ctypes.CDLL`` releases it
+    (right for millisecond ops like JPEG codec work that a thread pool
+    should truly parallelize).
+    """
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    sidecar = lib + ".srchash"
+    with _BUILD_LOCK:
+        stale = not (os.path.exists(lib) and os.path.exists(sidecar))
+        if not stale:
+            with open(sidecar) as f:
+                stale = f.read().strip() != digest
+        if stale:
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+                   "-o", lib, *extra_flags]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            with open(sidecar, "w") as f:
+                f.write(digest)
+        return cdll_cls(lib)

@@ -1,17 +1,30 @@
-"""JPEG codec shim — the TurboJPEG role from the reference.
+"""JPEG codec shims — the TurboJPEG role from the reference.
 
 The reference encodes/decodes on both endpoints via PyTurboJPEG
 (webcam_app.py:24,110,140; inverter.py:32,44) to cut wire bytes. Here the
-codec stays host-side (the TPU only ever sees dense uint8 NHWC arrays) and
-is parallelized with a thread pool: cv2's imencode/imdecode release the
-GIL inside libjpeg, so N worker threads give near-linear speedup —
-SURVEY.md §7 hard part 3 (host JPEG throughput outpacing the device) is a
-thread-count knob, and batch decode lands directly into one preallocated
-NHWC staging array ready for device_put.
+codec stays host-side (the TPU only ever sees dense uint8 NHWC arrays).
+Two implementations, one interface:
+
+- :class:`NativeJpegCodec` — the SURVEY.md §2b C++ shim proper:
+  ``jpeg_shim.cpp`` over libjpeg-turbo, bound with ``ctypes.CDLL`` so the
+  GIL is released for the milliseconds each frame spends in C. Decode
+  writes scanlines DIRECTLY into rows of the caller's preallocated NHWC
+  staging array (the buffer handed to device_put) — zero intermediate
+  allocations, no separate BGR→RGB pass.
+- :class:`JpegCodec` — cv2-backed fallback (imencode/imdecode also
+  release the GIL inside libjpeg), kept for environments without a C++
+  toolchain; batch decode copies into the staging array.
+
+Both parallelize with a thread pool; SURVEY.md §7 hard part 3 (host JPEG
+throughput outpacing the device) is a thread-count knob. Use
+:func:`make_codec` to get the native one with automatic fallback.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
@@ -69,3 +82,159 @@ class JpegCodec:
 
     def close(self) -> None:
         self.pool.shutdown(wait=False)
+
+
+# -- native (jpeg_shim.cpp) ---------------------------------------------
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SHIM_SRC = os.path.join(_DIR, "jpeg_shim.cpp")
+_SHIM_LIB = os.path.join(_DIR, "_jpeg_shim.so")
+_shim_lock = threading.Lock()
+_shim: Optional[ctypes.CDLL] = None
+_shim_error: Optional[str] = None
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _load_shim() -> ctypes.CDLL:
+    """Build+load jpeg_shim.cpp (content-hash cached). Raises on failure;
+    the failure is sticky so every caller gets the same fast answer."""
+    global _shim, _shim_error
+    if _shim is not None:
+        return _shim
+    if _shim_error is not None:
+        raise RuntimeError(_shim_error)
+    with _shim_lock:
+        if _shim is not None:
+            return _shim
+        if _shim_error is not None:  # lost the race to a failed builder
+            raise RuntimeError(_shim_error)
+        from dvf_tpu.transport._native import load_native
+
+        try:
+            # CDLL (GIL released): each call is milliseconds of libjpeg
+            # work that the thread pool should truly run in parallel.
+            lib = load_native(_SHIM_SRC, _SHIM_LIB, extra_flags=["-ljpeg"])
+        except Exception as e:  # toolchain or libjpeg missing
+            _shim_error = f"jpeg_shim build failed: {e}"
+            raise RuntimeError(_shim_error) from e
+        lib.dvf_jpeg_probe.restype = ctypes.c_int
+        lib.dvf_jpeg_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_ulong,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.dvf_jpeg_decode.restype = ctypes.c_int
+        lib.dvf_jpeg_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_ulong, _u8p, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.dvf_jpeg_encode.restype = ctypes.c_long
+        lib.dvf_jpeg_encode.argtypes = [
+            _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, _u8p,
+            ctypes.c_ulong,
+        ]
+        _shim = lib
+    return _shim
+
+
+class NativeJpegCodec:
+    """C++ libjpeg-turbo codec (SURVEY.md §2b): zero-copy decode into the
+    device-transfer staging array. Same interface as :class:`JpegCodec`."""
+
+    def __init__(self, quality: int = 90, threads: int = 4):
+        self._lib = _load_shim()
+        self.quality = int(quality)
+        self.pool = ThreadPoolExecutor(max_workers=threads, thread_name_prefix="dvf-jpeg")
+        self._tls = threading.local()  # per-thread encode scratch
+
+    # -- single frame ---------------------------------------------------
+
+    def encode(self, frame_rgb: np.ndarray) -> bytes:
+        frame_rgb = np.ascontiguousarray(frame_rgb, dtype=np.uint8)
+        h, w = frame_rgb.shape[:2]
+        cap = h * w * 3 + 4096  # raw size + header slack: never reallocs
+        scratch = getattr(self._tls, "scratch", None)
+        if scratch is None or len(scratch) < cap:
+            scratch = (ctypes.c_uint8 * cap)()
+            self._tls.scratch = scratch
+        n = self._lib.dvf_jpeg_encode(
+            frame_rgb.ctypes.data_as(_u8p), h, w, self.quality, scratch, len(scratch)
+        )
+        if n < 0:
+            # Shim reports -needed: a pathological high-entropy frame beat
+            # the raw-size+slack estimate. Grow once and retry.
+            scratch = (ctypes.c_uint8 * (-int(n)))()
+            self._tls.scratch = scratch
+            n = self._lib.dvf_jpeg_encode(
+                frame_rgb.ctypes.data_as(_u8p), h, w, self.quality, scratch, len(scratch)
+            )
+        if n <= 0:
+            raise ValueError(f"JPEG encode failed (rc={n})")
+        return bytes(memoryview(scratch)[: int(n)])
+
+    def decode_into(self, data: bytes, out: np.ndarray) -> None:
+        """Decode straight into ``out`` (H, W, 3) uint8, typically one row
+        of the staging batch. Raises on dims mismatch — the wire contract
+        is fixed-geometry frames (reference inverter.py:34 hardcodes its
+        raw geometry the same way)."""
+        if out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]:
+            # The C shim writes h*w*3 contiguous bytes from the base
+            # pointer — a strided view would be silently corrupted.
+            raise ValueError("decode_into needs a C-contiguous uint8 buffer")
+        h, w = out.shape[:2]
+        gh, gw = ctypes.c_int(), ctypes.c_int()
+        rc = self._lib.dvf_jpeg_decode(
+            data, len(data), out.ctypes.data_as(_u8p), h, w,
+            ctypes.byref(gh), ctypes.byref(gw),
+        )
+        if rc == 1:
+            raise ValueError(
+                f"JPEG is {gh.value}x{gw.value}, staging row is {h}x{w}"
+            )
+        if rc != 0:
+            raise ValueError("JPEG decode failed (corrupt stream)")
+
+    def decode(self, data: bytes) -> np.ndarray:
+        h, w = ctypes.c_int(), ctypes.c_int()
+        if self._lib.dvf_jpeg_probe(data, len(data), ctypes.byref(h), ctypes.byref(w)) != 0:
+            raise ValueError("JPEG decode failed (bad header)")
+        out = np.empty((h.value, w.value, 3), np.uint8)
+        self.decode_into(data, out)
+        return out
+
+    # -- batched (thread-parallel, GIL released per C call) -------------
+
+    def encode_batch(self, frames: Sequence[np.ndarray]) -> List[bytes]:
+        return list(self.pool.map(self.encode, frames))
+
+    def decode_batch(
+        self, blobs: Sequence[bytes], out: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """Decode into a stacked (N, H, W, 3) uint8 array. With ``out``
+        (the staging buffer handed to device_put) every frame is written
+        in place by the C shim — the zero-copy path."""
+        if out is None:
+            h, w = ctypes.c_int(), ctypes.c_int()
+            first = blobs[0]
+            if self._lib.dvf_jpeg_probe(first, len(first), ctypes.byref(h), ctypes.byref(w)) != 0:
+                raise ValueError("JPEG decode failed (bad header)")
+            out = np.empty((len(blobs), h.value, w.value, 3), np.uint8)
+        list(self.pool.map(self.decode_into, blobs, [out[i] for i in range(len(blobs))]))
+        return out
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False)
+
+
+def make_codec(quality: int = 90, threads: int = 4):
+    """The production constructor: native C++ codec, falling back to the
+    cv2-threaded one (with a one-line notice) if the shim can't build."""
+    try:
+        return NativeJpegCodec(quality=quality, threads=threads)
+    except (RuntimeError, OSError) as e:
+        import sys
+
+        print(f"[dvf] native jpeg shim unavailable ({e}); using cv2 codec",
+              file=sys.stderr)
+        return JpegCodec(quality=quality, threads=threads)

@@ -1,0 +1,171 @@
+// Native JPEG codec shim over libjpeg-turbo — the TurboJPEG role from the
+// reference (webcam_app.py:24,110,140; inverter.py:32,44), as SURVEY.md §2b
+// specifies: decode lands DIRECTLY in the caller's preallocated NHWC uint8
+// staging buffer (the array handed to jax.device_put), no intermediate
+// allocation, no BGR->RGB copy pass. Encode writes into a caller-provided
+// byte buffer sized so libjpeg never reallocates in practice.
+//
+// Thread model: every entry point uses only stack-local libjpeg state, so
+// calls are safe from any number of threads concurrently. The Python side
+// binds with ctypes.CDLL (GIL released per call) and runs a thread pool —
+// a 1080p decode is milliseconds of C work, exactly what the GIL should
+// not serialize.
+//
+// Error model: libjpeg's default error handler calls exit(); we override
+// error_exit with setjmp/longjmp and return negative codes instead.
+//
+// Built at import time by codec.py with `g++ -O3 -shared -fPIC -ljpeg`
+// (same content-hash cache scheme as ring.py — see _native.py).
+
+#include <cstddef>
+#include <cstdio>  // jpeglib.h uses size_t/FILE without including them
+
+#include <jpeglib.h>
+
+#include <csetjmp>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrMgr* e = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+// Suppress libjpeg's stderr warnings (corrupt-but-recoverable streams);
+// hard errors still longjmp out via on_error.
+void no_output(j_common_ptr) {}
+
+void install(jpeg_decompress_struct* cinfo, ErrMgr* err) {
+  cinfo->err = jpeg_std_error(&err->pub);
+  err->pub.error_exit = on_error;
+  err->pub.output_message = no_output;
+}
+
+void install(jpeg_compress_struct* cinfo, ErrMgr* err) {
+  cinfo->err = jpeg_std_error(&err->pub);
+  err->pub.error_exit = on_error;
+  err->pub.output_message = no_output;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Read dims without decoding. Returns 0 ok, -1 on parse error.
+int dvf_jpeg_probe(const unsigned char* blob, unsigned long len, int* h,
+                   int* w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, blob, len);
+  jpeg_read_header(&cinfo, TRUE);
+  *h = static_cast<int>(cinfo.image_height);
+  *w = static_cast<int>(cinfo.image_width);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode RGB8 straight into out (out_h*out_w*3, C-contiguous).
+// Returns 0 on success. If the JPEG's dims differ from (out_h, out_w),
+// nothing is written, actual dims go to *got_h/*got_w, and 1 is returned
+// (caller decides: reject, or re-stage at the real size). -1 = bad stream.
+int dvf_jpeg_decode(const unsigned char* blob, unsigned long len,
+                    unsigned char* out, int out_h, int out_w, int* got_h,
+                    int* got_w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, blob, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *got_h = static_cast<int>(cinfo.output_height);
+  *got_w = static_cast<int>(cinfo.output_width);
+  if (*got_h != out_h || *got_w != out_w ||
+      cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);  // implies abort of the decompress
+    return 1;
+  }
+  const unsigned long stride = static_cast<unsigned long>(out_w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Encode h*w*3 RGB8 into out (capacity out_cap). Returns bytes written
+// (>0), -needed if out_cap was too small, or 0 on encode error.
+// out_cap >= h*w*3 + 4096 guarantees the in-place path (JPEG never
+// exceeds raw size plus header slack at any quality).
+long dvf_jpeg_encode(const unsigned char* rgb, int h, int w, int quality,
+                     unsigned char* out, unsigned long out_cap) {
+  jpeg_compress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  // jpeg_mem_dest stores these ADDRESSES and writes the final (ptr, size)
+  // through them inside jpeg_finish_compress — they must stay live for
+  // the whole function. The longjmp error path never reads them (so no
+  // volatile needed); it returns without freeing, accepting libjpeg's
+  // known mem-dest leak on the (raw-pixel encode, ~never) error path.
+  unsigned char* buf = out;
+  unsigned long sz = out_cap;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    return 0;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &buf, &sz);
+  cinfo.image_width = static_cast<JDIMENSION>(w);
+  cinfo.image_height = static_cast<JDIMENSION>(h);
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  const unsigned long stride = static_cast<unsigned long>(w) * 3;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row =
+        const_cast<unsigned char*>(rgb) + cinfo.next_scanline * stride;
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  // jpeg_mem_dest may have swapped in a bigger malloc'd buffer; recover
+  // the final (buf, sz) pair it published.
+  unsigned char* fin = buf;
+  unsigned long fsz = sz;
+  long written;
+  if (fin == out) {
+    written = static_cast<long>(fsz);
+  } else if (fsz <= out_cap) {
+    memcpy(out, fin, fsz);
+    free(fin);
+    written = static_cast<long>(fsz);
+  } else {
+    free(fin);
+    written = -static_cast<long>(fsz);
+  }
+  jpeg_destroy_compress(&cinfo);
+  return written;
+}
+
+}  // extern "C"

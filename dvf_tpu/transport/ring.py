@@ -1,67 +1,39 @@
 """ctypes binding for the native frame ring (ring.cpp).
 
-Compiles the shared library on first use with g++ (no pybind11 in this
-environment; ctypes keeps the binding dependency-free) and caches the .so
-next to the source. Staleness is decided by a CONTENT HASH of ring.cpp
-stored in a sidecar file — not mtimes, which are arbitrary after a fresh
-clone and would let a stale (or tampered) artifact load silently. The .so
-is never committed (.gitignore); it is always the product of the reviewed
-source on this machine.
+Build/caching scheme lives in :mod:`dvf_tpu.transport._native` (content-
+hash cached .so, shared with the JPEG shim).
 """
 
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
+
+from dvf_tpu.transport._native import load_native
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ring.cpp")
 _LIB = os.path.join(_DIR, "_ring.so")
-_HASH = _LIB + ".srchash"
-_BUILD_LOCK = threading.Lock()
+_LOAD_LOCK = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
-
-
-def _src_digest() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
-
-
-def _build(digest: str) -> None:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-    with open(_HASH, "w") as f:
-        f.write(digest)
-
-
-def _stale(digest: str) -> bool:
-    if not os.path.exists(_LIB) or not os.path.exists(_HASH):
-        return True
-    with open(_HASH) as f:
-        return f.read().strip() != digest
 
 
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    with _BUILD_LOCK:
+    with _LOAD_LOCK:
         if _lib is not None:
             return _lib
-        digest = _src_digest()
-        if _stale(digest):
-            _build(digest)
         # PyDLL: keep the GIL across calls. Every ring op is sub-microsecond;
         # releasing/reacquiring the GIL per call (CDLL) causes a handoff
         # convoy (~5 ms each, the interpreter switch interval) as producer
         # and consumer threads ping-pong — measured 1000x slowdown. Holding
         # the GIL for a memcpy of one frame header/payload is the cheaper
         # trade by far; cross-process users don't share a GIL at all.
-        lib = ctypes.PyDLL(_LIB)
+        lib = load_native(_SRC, _LIB, cdll_cls=ctypes.PyDLL)
         lib.ring_create.restype = ctypes.c_void_p
         lib.ring_create.argtypes = [ctypes.c_uint64]
         lib.ring_create_shm.restype = ctypes.c_void_p

@@ -46,7 +46,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from dvf_tpu.transport.codec import JpegCodec
+from dvf_tpu.transport.codec import make_codec
 from dvf_tpu.transport.ring import FrameRing
 
 # Native per-record overhead: RecordHeader (24 B) rounded up to 8-byte
@@ -71,7 +71,7 @@ class RingFrameQueue:
         self.frame_dtype = np.dtype(np.uint8)
         self._frame_bytes = int(np.prod(self.frame_shape))
         self.jpeg = jpeg
-        self.codec = JpegCodec(quality=jpeg_quality, threads=codec_threads) if jpeg else None
+        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads) if jpeg else None
         # Sized for capacity_frames RAW frames (a JPEG ring then holds more
         # — the bound is freshness in bytes, the stronger guarantee). The
         # per-record cap leaves 2× slack: JPEG is *larger* than raw for

@@ -31,7 +31,7 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.runtime.engine import Engine
-from dvf_tpu.transport.codec import JpegCodec
+from dvf_tpu.transport.codec import make_codec
 
 
 class TpuZmqWorker:
@@ -81,7 +81,7 @@ class TpuZmqWorker:
         self._zmq = zmq
         self.filt = filt
         self.engine = engine or Engine(filt)
-        self.codec = JpegCodec(quality=jpeg_quality, threads=codec_threads)
+        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
         self.batch_size = batch_size
         self.assemble_timeout_s = assemble_timeout_s
         self.use_jpeg = use_jpeg
