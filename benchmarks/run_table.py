@@ -38,6 +38,7 @@ TABLE = [
     ("sobel_bilateral_1080p", 0.35),
     ("flow_720p", 0.15),
     ("style_720p", 0.05),
+    ("sr2x_540p", 0.2),
 ]
 
 

@@ -60,14 +60,35 @@ def bench_device_resident(
     h2d_s = time.perf_counter() - t0
     h2d_mbps = host_batch.nbytes / 1e6 / h2d_s if h2d_s > 0 else float("inf")
 
-    batch = engine.run_device_resident(batch)
-    _ = np.asarray(checksum(batch))
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        batch = engine.run_device_resident(batch)
-    _ = np.asarray(checksum(batch))
-    wall = time.perf_counter() - t0
+    out = engine.run_device_resident(batch)
+    _ = np.asarray(checksum(out))
+    geometry_preserving = out.shape == batch.shape
+    if geometry_preserving:
+        # The engine DONATED the input — `batch` was consumed by the
+        # warmup above; continue the chain from `out`. (Geometry-changing
+        # filters don't donate the batch, so theirs stays live.)
+        batch = out
+        # Dependent chain: each output IS the next input, so async dispatch
+        # can't overlap away real work.
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            batch = engine.run_device_resident(batch)
+        _ = np.asarray(checksum(batch))
+        wall = time.perf_counter() - t0
+    else:
+        # Geometry-changing filter (super_resolution): feeding the output
+        # back would recompile with doubled H/W every iteration. Keep the
+        # cross-iteration data dependency instead by folding a scalar of
+        # the previous output into the fixed-shape input — same
+        # no-overlap guarantee, stable signature.
+        fold = jax.jit(
+            lambda x, y: x + (jnp.sum(y.astype(jnp.float32)) * 0).astype(x.dtype)
+        )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = engine.run_device_resident(fold(batch, out))
+        _ = np.asarray(checksum(out))
+        wall = time.perf_counter() - t0
 
     frames = iters * batch_size
     return {

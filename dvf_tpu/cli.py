@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -36,6 +37,8 @@ BENCH_CONFIGS = {
         filter=("style_transfer", {"base_channels": 32, "n_residual": 5}),
         h=720, w=1280, batch=8,
     ),
+    # 540p -> 1080p subpixel upscale; all conv FLOPs at the LOW resolution.
+    "sr2x_540p": dict(filter=("super_resolution", {"scale": 2}), h=540, w=960, batch=8),
 }
 
 
@@ -114,14 +117,20 @@ def cmd_serve(args) -> int:
     from dvf_tpu.io.sinks import NullSink
     from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
 
-    if args.style_checkpoint:
-        # Trained style-transfer weights: rebuild the exact net from the
-        # checkpoint's sidecar config and load params only (no optimizer /
-        # VGG state touches inference).
-        from dvf_tpu.train.checkpoint import load_style_filter
+    if args.style_checkpoint and args.sr_checkpoint:
+        print("error: --style-checkpoint and --sr-checkpoint are mutually "
+              "exclusive (each loads a different filter family)", file=sys.stderr)
+        return 2
+    if args.style_checkpoint or args.sr_checkpoint:
+        # Trained weights: rebuild the exact net from the checkpoint's
+        # sidecar config and load params only (no optimizer / VGG state
+        # touches inference).
+        from dvf_tpu.train.checkpoint import load_sr_filter, load_style_filter
 
         try:
-            filt = load_style_filter(args.style_checkpoint)
+            filt = (load_style_filter(args.style_checkpoint)
+                    if args.style_checkpoint
+                    else load_sr_filter(args.sr_checkpoint))
         except (FileNotFoundError, ValueError) as e:
             # Same clean failure as train --resume on a typo'd path; the
             # loader maps corrupt/incomplete sidecars to ValueError.
@@ -418,26 +427,113 @@ def cmd_train(args) -> int:
                        "style": args.style, "size": args.size,
                        "steps": args.steps}, f)
 
+    return _run_train_loop(
+        args, mesh, state, step_fn, train_batch_sharding(mesh), frames,
+        save_checkpoint,
+        log_line=lambda m: f"loss={float(m['loss']):.5f}",
+        final_json=lambda m: {
+            "steps": args.steps,
+            "final_loss": float(m["loss"]) if m else float("nan"),
+        },
+    )
+
+
+def _run_train_loop(args, mesh, state, step_fn, batch_sharding, frames,
+                    save_checkpoint, log_line, final_json):
+    """The training driver both families share: stack-a-batch → sharded
+    step → periodic log → periodic checkpoint → final checkpoint + JSON.
+    Family-specific pieces come in as functions (``log_line(metrics)``,
+    ``final_json(metrics)``); resume/state/step_fn setup stays with the
+    caller, which knows its own restore machinery."""
+    import jax
+    import numpy as np
+
     start = int(state.step)
+    metrics = {}
     for i in range(start, args.steps):
         batch_np = np.stack([
             next(frames)[0] for _ in range(args.batch)
         ]).astype(np.float32) / 255.0
-        batch = jax.device_put(batch_np, train_batch_sharding(mesh))
+        batch = jax.device_put(batch_np, batch_sharding)
         state, metrics = step_fn(state, batch)
         if (i + 1) % args.log_every == 0:
-            print(f"step {i + 1}: loss={float(metrics['loss']):.5f}", file=sys.stderr)
+            print(f"step {i + 1}: {log_line(metrics)}", file=sys.stderr)
         if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
             path = os.path.join(args.checkpoint_dir, f"step_{i + 1:06d}")
             save_checkpoint(path, state)
             print(f"checkpointed {path}", file=sys.stderr)
-    final_loss = float(metrics["loss"]) if args.steps > start else float("nan")
     if args.checkpoint_dir:
         path = os.path.join(args.checkpoint_dir, "final")
         save_checkpoint(path, state)
         print(f"checkpointed {path}", file=sys.stderr)
-    print(json.dumps({"steps": args.steps, "final_loss": final_loss}))
+    print(json.dumps(final_json(metrics)))
     return 0
+
+
+def cmd_train_sr(args) -> int:
+    """Train the ESPCN SR net self-supervised on synthetic frames (each HR
+    frame area-downscaled ×r on device makes its own LR input — no
+    dataset, matching the zero-egress environment)."""
+    import math
+    import os
+
+    _force_platform()
+
+    import jax
+    import numpy as np
+
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.models.espcn import EspcnConfig
+    from dvf_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dvf_tpu.train.checkpoint import restore_sr_checkpoint, save_checkpoint
+    from dvf_tpu.train.sr import (
+        SrTrainConfig,
+        init_train_state,
+        make_train_step,
+        shard_train_state,
+        train_batch_sharding,
+    )
+
+    if args.size % args.scale:
+        print(f"error: --size {args.size} must be divisible by --scale {args.scale}",
+              file=sys.stderr)
+        return 2
+    config = SrTrainConfig(net=EspcnConfig(scale=args.scale), learning_rate=args.lr)
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=math.gcd(args.batch, n_dev)))
+    src = SyntheticSource(height=args.size, width=args.size,
+                          n_frames=args.steps * args.batch, rate=0.0)
+    frames = iter(src)
+
+    state = init_train_state(jax.random.PRNGKey(args.seed), config)
+    if args.resume:
+        if not os.path.isdir(args.resume):
+            print(f"error: --resume path {args.resume!r} does not exist",
+                  file=sys.stderr)
+            return 2
+        state = restore_sr_checkpoint(args.resume, state, mesh=mesh, config=config)
+        print(f"resumed from {args.resume} at step {int(state.step)}", file=sys.stderr)
+    else:
+        state = shard_train_state(state, mesh, config)
+    step_fn = make_train_step(mesh, config, state_template=state)
+
+    if args.checkpoint_dir:
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        with open(os.path.join(args.checkpoint_dir, "config.json"), "w") as f:
+            json.dump({"scale": args.scale, "size": args.size,
+                       "steps": args.steps}, f)
+
+    return _run_train_loop(
+        args, mesh, state, step_fn, train_batch_sharding(mesh), frames,
+        save_checkpoint,
+        log_line=lambda m: (f"loss={float(m['loss']):.5f} "
+                            f"psnr={float(m['psnr']):.2f}dB"),
+        final_json=lambda m: {
+            "steps": args.steps,
+            "final_loss": float(m["loss"]) if m else float("nan"),
+            "final_psnr_db": float(m["psnr"]) if m else float("nan"),
+        },
+    )
 
 
 def main(argv=None) -> int:
@@ -482,6 +578,9 @@ def main(argv=None) -> int:
     sp.add_argument("--style-checkpoint", default=None, metavar="DIR",
                     help="load trained style-transfer weights from a train "
                          "checkpoint dir (overrides --filter)")
+    sp.add_argument("--sr-checkpoint", default=None, metavar="DIR",
+                    help="load trained super-resolution weights from a "
+                         "train-sr checkpoint dir (overrides --filter)")
     sp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
                     help="with --transport ring: payload format on the ring "
                          "(jpeg = encode at capture, decode into the device "
@@ -538,6 +637,22 @@ def main(argv=None) -> int:
     tp.add_argument("--style-weight", type=float, default=None,
                     help="override StyleTrainConfig.style_weight")
 
+    tsp = sub.add_parser(
+        "train-sr",
+        help="train the super-resolution net (self-supervised, "
+             "checkpoint/resume)")
+    tsp.add_argument("--steps", type=int, default=50)
+    tsp.add_argument("--batch", type=int, default=4)
+    tsp.add_argument("--size", type=int, default=64,
+                     help="square HR frame size (must be divisible by --scale)")
+    tsp.add_argument("--scale", type=int, default=2)
+    tsp.add_argument("--lr", type=float, default=1e-3)
+    tsp.add_argument("--seed", type=int, default=0)
+    tsp.add_argument("--log-every", type=int, default=10)
+    tsp.add_argument("--checkpoint-dir", default=None)
+    tsp.add_argument("--checkpoint-every", type=int, default=25)
+    tsp.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+
     bp = sub.add_parser("bench", help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")
     bp.add_argument("--iters", type=int, default=200)
@@ -558,7 +673,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     return {
         "filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker,
-        "bench": cmd_bench, "train": cmd_train, "camera": cmd_camera,
+        "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
+        "camera": cmd_camera,
     }[args.cmd](args)
 
 

@@ -1,11 +1,11 @@
 """Neural filter models.
 
 The reference has no neural models — its one op is ``cv2.bitwise_not``
-(inverter.py:41). The model family here exists for BASELINE.json configs[4]
-("fast neural style-transfer (small VGG encoder), 720p, batch=8"): a
-Johnson-style feed-forward transformer net as the flagship filter, and a
-small VGG encoder providing perceptual (content + style/Gram) features for
-training.
+(inverter.py:41). Two families ship here: a Johnson-style feed-forward
+transformer net (the flagship filter, BASELINE.json configs[4], with a
+small VGG encoder providing perceptual features for training) and an
+ESPCN sub-pixel super-resolution net (enhancement family; all FLOPs at
+low resolution — built for the MXU).
 
 Models are plain functional JAX: ``init(rng, ...) -> params`` pytrees and
 ``apply(params, batch) -> batch`` functions, with explicit
@@ -18,5 +18,10 @@ from dvf_tpu.models.style_transfer import (  # noqa: F401
     init_style_net,
     apply_style_net,
     param_pspecs,
+)
+from dvf_tpu.models.espcn import (  # noqa: F401
+    EspcnConfig,
+    apply_espcn,
+    init_espcn,
 )
 from dvf_tpu.models.vgg import VGGConfig, init_vgg, vgg_features  # noqa: F401

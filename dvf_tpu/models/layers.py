@@ -81,6 +81,23 @@ def upsample_nearest(x: jnp.ndarray, factor: int = 2) -> jnp.ndarray:
     return x.reshape(b, h * factor, w * factor, c)
 
 
+def depth_to_space(x: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Subpixel rearrange (B, H, W, C·r²) → (B, H·r, W·r, C), DCR order:
+    ``y[b, h*r+i, w*r+j, c] = x[b, h, w, (i*r + j)*C + c]``.
+
+    The ESPCN upscale head: the conv producing C·r² channels is a dense
+    MXU matmul; this rearrange is pure reshape/transpose — zero FLOPs, and
+    XLA folds it into the surrounding layout changes.
+    """
+    b, h, w, crr = x.shape
+    c = crr // (factor * factor)
+    if c * factor * factor != crr:
+        raise ValueError(f"channels {crr} not divisible by r²={factor * factor}")
+    x = x.reshape(b, h, w, factor, factor, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # b, h, i, w, j, c
+    return x.reshape(b, h * factor, w * factor, c)
+
+
 def gram_matrix(feats: jnp.ndarray) -> jnp.ndarray:
     """Batched Gram matrix of NHWC features: (B, C, C) / (H*W*C)."""
     b, h, w, c = feats.shape

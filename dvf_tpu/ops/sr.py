@@ -1,0 +1,99 @@
+"""Super-resolution filter op — the second neural entry in the registry.
+
+Wraps :mod:`dvf_tpu.models.espcn` as a registered stateful filter: like
+``style_transfer``, the network params ARE the filter state (device-
+resident across batches, never baked into the program as constants).
+
+This is the one registered filter whose OUTPUT GEOMETRY differs from its
+input ((H, W) → (H·r, W·r)): the runtime carries whatever the jitted step
+returns, the reorder/sink path is geometry-agnostic, and the display sink
+letterboxes — so SR slots into the same serve pipeline as every other op.
+
+Reference counterpart: none — the reference's only op is invert
+(inverter.py:41).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.models.espcn import (
+    EspcnConfig,
+    apply_espcn,
+    init_espcn,
+    param_pspecs,
+    tp_inner_apply,
+)
+from dvf_tpu.ops.registry import register_filter
+
+
+@register_filter("super_resolution")
+def super_resolution(
+    params: Optional[Any] = None,
+    scale: int = 2,
+    seed: int = 0,
+) -> Filter:
+    """``params=None`` → seeded random init (benchmark weights); pass a
+    trained param pytree for real upscaling. ``specialize`` swaps in the
+    Megatron-TP shard_map body when the mesh has a model axis > 1 (same
+    scheme as ``style_transfer``; see models.espcn.param_pspecs)."""
+    config = EspcnConfig(scale=scale)
+
+    def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+        return apply_espcn(state, batch, config), state
+
+    def init_state(batch_shape, dtype):
+        if params is not None:
+            return params
+        return init_espcn(jax.random.PRNGKey(seed), config)
+
+    name = f"super_resolution(x{scale})"
+
+    def specialize(mesh, batch_shape) -> Optional[Filter]:
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if axes.get("model", 1) <= 1:
+            return None  # generic body; params replicate over size-1 axis
+        inner = tp_inner_apply(config)
+        specs = param_pspecs(config)
+        # Fold batch over (data, space) when divisible, degrading like
+        # ops.style does — shard_map needs exact divisibility on dim 0.
+        b = batch_shape[0]
+        d, s = axes.get("data", 1), axes.get("space", 1)
+        if b % (d * s) == 0:
+            batch_spec = P(("data", "space"))
+        elif b % d == 0:
+            batch_spec = P("data")
+        else:
+            batch_spec = P(None)
+
+        def sharded_fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
+            sharded = jax.shard_map(
+                inner,
+                mesh=mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=batch_spec,
+                check_vma=False,
+            )
+            return sharded(state, batch), state
+
+        return Filter(
+            name=f"tp({name})",
+            fn=sharded_fn,
+            init_state=init_state,
+            compute_dtype=jnp.float32,
+            state_pspecs=lambda: specs,
+        )
+
+    return Filter(
+        name=name,
+        fn=fn,
+        init_state=init_state,
+        compute_dtype=jnp.float32,
+        state_pspecs=lambda: param_pspecs(config),
+        specialize=specialize,
+    )

@@ -126,11 +126,20 @@ class Engine:
         # filters shard their weight pytree over 'model' — tensor
         # parallelism), else replicate (temporal state is small).
         state_shardings = self._state_shardings() if filt.stateful else None
+        # Donate the input batch only when the output can actually reuse
+        # its buffer — a geometry-changing filter (super_resolution) can't,
+        # and XLA would warn "donated buffers were not usable" every run.
+        out_aval = jax.eval_shape(
+            step,
+            jax.ShapeDtypeStruct(tuple(batch_shape), np.dtype(in_dtype)),
+            self._state,  # built just before _build_step in compile()
+        )[0]
+        donate = (0, 1) if out_aval.shape == tuple(batch_shape) else (1,)
         return jax.jit(
             step,
             in_shardings=(self._sharding, state_shardings),
             out_shardings=(self._sharding, state_shardings),
-            donate_argnums=(0, 1),
+            donate_argnums=donate,
         )
 
     def _state_shardings(self):

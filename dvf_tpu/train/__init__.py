@@ -1,4 +1,4 @@
-"""Training subsystem (style-transfer perceptual training).
+"""Training subsystem (style-transfer perceptual + SR self-supervised).
 
 The reference is inference-only; training exists here because the flagship
 neural filter (style transfer, BASELINE.json configs[4]) needs trained
@@ -13,4 +13,8 @@ from dvf_tpu.train.style import (  # noqa: F401
     init_train_state,
     make_train_step,
     style_loss_fn,
+)
+from dvf_tpu.train.sr import (  # noqa: F401
+    SrTrainConfig,
+    SrTrainState,
 )

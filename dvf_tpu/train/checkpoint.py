@@ -1,28 +1,30 @@
-"""Checkpoint / resume for style-transfer training (orbax-backed).
+"""Checkpoint / resume for the training families (orbax-backed).
 
 The reference has nothing persistent (SURVEY.md §5.4 — its pipeline is
-stateless per frame); the framework's training loop does: net params, adam
-moments, frozen VGG weights, target Grams, step counter. Orbax writes the
-whole TrainState pytree; restore takes the abstract template (from
-``init_train_state``) so dtypes/shapes — and on restore-onto-a-mesh, the
-shardings — come back exactly.
+stateless per frame); the framework's training loops do: net params, adam
+moments, (for style) frozen VGG weights and target Grams, step counter.
+Orbax writes the whole TrainState pytree; restore takes the abstract
+template (from ``init_train_state``) so dtypes/shapes — and on
+restore-onto-a-mesh, the shardings — come back exactly.
 
 Checkpoints are standard orbax directories: resumable across processes and
-readable by any orbax tool.
+readable by any orbax tool. Both families share one directory layout
+('final' preferred, newest 'step_*' fallback, 'config.json' architecture
+sidecar) via the `_resolve_*` helpers, so layout fixes land once.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 
 from dvf_tpu.train.style import StyleTrainConfig, TrainState, shard_train_state
 
 
-def save_checkpoint(path: str, state: TrainState) -> str:
-    """Write ``state`` to ``path`` (an empty/new directory). Blocking."""
+def save_checkpoint(path: str, state) -> str:
+    """Write a TrainState pytree (either family) to ``path``. Blocking."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -35,9 +37,9 @@ def save_checkpoint(path: str, state: TrainState) -> str:
 
 def load_params(path: str):
     """Restore ONLY the net params from a train checkpoint — the inference
-    loader (serve --style-checkpoint): no optimizer/VGG/gram state, no
-    TrainState template, no mesh required. Returns the param pytree ready
-    to pass to ``get_filter("style_transfer", params=...)``."""
+    loaders: no optimizer/VGG/gram state, no TrainState template, no mesh
+    required. Returns the param pytree ready for ``get_filter(...,
+    params=...)``."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -48,31 +50,34 @@ def load_params(path: str):
     return restored["params"]
 
 
-def load_style_filter(ckpt_dir: str):
-    """Rebuild the style_transfer Filter from a train checkpoint directory
-    (the single loader behind ``serve --style-checkpoint`` and the tests).
+# ------------------------------------------------- shared layout helpers
 
-    Requires the sidecar ``config.json`` the train CLI writes: guessing
-    default architecture on a mismatch would silently skip trained layers
-    (extra residual blocks never run) or crash with an opaque shape error.
-    """
-    import json
-
+def _resolve_checkpoint_dir(ckpt_dir: str, family: str, train_cmd: str) -> str:
+    """Map a train --checkpoint-dir to the concrete checkpoint to load:
+    prefer 'final'; fall back to the newest step_* — a run killed
+    mid-training leaves step dirs but no final, and those must stay
+    loadable (the sidecar is written before training starts)."""
     ckpt_dir = os.path.abspath(ckpt_dir)
     if not os.path.isdir(ckpt_dir):
-        raise FileNotFoundError(f"style checkpoint dir {ckpt_dir!r} does not exist")
-    # Prefer 'final'; fall back to the newest step_* checkpoint — a run
-    # killed mid-training leaves step dirs but no final, and those must
-    # stay loadable (the sidecar is written before training starts).
+        raise FileNotFoundError(f"{family} checkpoint dir {ckpt_dir!r} does not exist")
     final = os.path.join(ckpt_dir, "final")
     if not os.path.isdir(final):
         steps = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
         if not steps:
             raise FileNotFoundError(
                 f"{ckpt_dir!r} has no 'final' or step_* checkpoint — pass "
-                f"the directory given to train --checkpoint-dir")
+                f"the directory given to {train_cmd} --checkpoint-dir")
         final = os.path.join(ckpt_dir, steps[-1])
-    cfg_path = os.path.join(ckpt_dir, "config.json")
+    return final
+
+
+def _read_sidecar(ckpt_dir: str, required: Sequence[str]) -> dict:
+    """Load the config.json architecture sidecar the train CLIs write.
+    Required: guessing default architecture on a mismatch would silently
+    skip trained layers or crash with an opaque shape error."""
+    import json
+
+    cfg_path = os.path.join(os.path.abspath(ckpt_dir), "config.json")
     if not os.path.exists(cfg_path):
         raise FileNotFoundError(
             f"{cfg_path} missing — the net architecture cannot be recovered "
@@ -80,19 +85,47 @@ def load_style_filter(ckpt_dir: str):
     try:
         with open(cfg_path) as f:
             sc = json.load(f)
-        base_channels, n_residual = sc["base_channels"], sc["n_residual"]
+        missing = [k for k in required if k not in sc]
+        if missing:
+            raise KeyError(", ".join(missing))
     except (json.JSONDecodeError, KeyError) as e:
         raise ValueError(
             f"{cfg_path} is corrupt or missing required keys "
-            f"(base_channels, n_residual): {e}") from e
+            f"({', '.join(required)}): {e}") from e
+    return sc
+
+
+def _restore_state(path: str, template, state_cls, fields: Sequence[str]):
+    """Orbax-restore onto ``template`` and coerce dict/obj results back to
+    the family's TrainState dataclass."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=jax.device_get(template))
+    if isinstance(restored, state_cls):
+        return restored
+    return state_cls(**{
+        f: getattr(restored, f) if hasattr(restored, f) else restored[f]
+        for f in fields
+    })
+
+
+# --------------------------------------------------------- style family
+
+def load_style_filter(ckpt_dir: str):
+    """Rebuild the style_transfer Filter from a train checkpoint directory
+    (the single loader behind ``serve --style-checkpoint`` and the tests)."""
+    final = _resolve_checkpoint_dir(ckpt_dir, "style", "train")
+    sc = _read_sidecar(ckpt_dir, ("base_channels", "n_residual"))
 
     from dvf_tpu.ops import get_filter
 
     return get_filter(
         "style_transfer",
         params=load_params(final),
-        base_channels=base_channels,
-        n_residual=n_residual,
+        base_channels=sc["base_channels"],
+        n_residual=sc["n_residual"],
     )
 
 
@@ -102,21 +135,42 @@ def restore_checkpoint(
     mesh=None,
     config: Optional[StyleTrainConfig] = None,
 ) -> TrainState:
-    """Load a TrainState from ``path``.
+    """Load a style TrainState from ``path``.
 
     ``template`` (e.g. a fresh ``init_train_state``) supplies the pytree
     structure. With ``mesh`` + ``config`` the restored state is placed
     straight onto the mesh per ``state_pspecs`` (resume-on-slice).
     """
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        restored = ckptr.restore(path, item=jax.device_get(template))
-    state = TrainState(**{
-        f: getattr(restored, f) if hasattr(restored, f) else restored[f]
-        for f in ("params", "opt_state", "vgg_params", "style_grams", "step")
-    }) if not isinstance(restored, TrainState) else restored
+    state = _restore_state(
+        path, template, TrainState,
+        ("params", "opt_state", "vgg_params", "style_grams", "step"),
+    )
     if mesh is not None:
         state = shard_train_state(state, mesh, config or StyleTrainConfig())
+    return state
+
+
+# ----------------------------------------------------- SR (ESPCN) family
+
+def load_sr_filter(ckpt_dir: str):
+    """Rebuild the super_resolution Filter from a train-sr checkpoint dir
+    (behind ``serve --sr-checkpoint``)."""
+    final = _resolve_checkpoint_dir(ckpt_dir, "sr", "train-sr")
+    sc = _read_sidecar(ckpt_dir, ("scale",))
+
+    from dvf_tpu.ops import get_filter
+
+    return get_filter("super_resolution", params=load_params(final), scale=sc["scale"])
+
+
+def restore_sr_checkpoint(path: str, template, mesh=None, config=None):
+    """SR counterpart of :func:`restore_checkpoint` (template = a fresh
+    ``train.sr.init_train_state``)."""
+    from dvf_tpu.train.sr import SrTrainConfig, SrTrainState
+    from dvf_tpu.train.sr import shard_train_state as shard_sr
+
+    state = _restore_state(path, template, SrTrainState,
+                           ("params", "opt_state", "step"))
+    if mesh is not None:
+        state = shard_sr(state, mesh, config or SrTrainConfig())
     return state

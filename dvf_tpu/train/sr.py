@@ -1,0 +1,171 @@
+"""Self-supervised training for the ESPCN super-resolution net.
+
+No external dataset needed (zero-egress environment, and the reference
+ships none): the video stream itself supervises — each HR frame is
+area-downscaled ×r on device to make the LR input, and the net learns to
+reconstruct the original. Loss is Charbonnier (smooth L1), the standard
+SR choice: L2 over-penalizes outliers and trains blurry nets.
+
+Sharding mirrors train.style exactly — ONE all-manual ``jax.shard_map``
+over the mesh: batch folded over ('data', 'space'), Megatron TP over
+'model' with the single psum inside the forward
+(models.espcn.tp_inner_apply), grads pmean'd over the data axes, adam on
+locally-owned slices. See train.style.make_train_step for the rationale
+(incl. the XLA bugs ruling out GSPMD-auto here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dvf_tpu.models.espcn import (
+    EspcnConfig,
+    apply_espcn,
+    init_espcn,
+    param_pspecs,
+    tp_inner_apply,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SrTrainConfig:
+    net: EspcnConfig = EspcnConfig()
+    learning_rate: float = 1e-3
+    charbonnier_eps: float = 1e-3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SrTrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def downscale_area(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    """Area (box) ×r downscale — the supervision pair generator. A pure
+    reshape+mean, so it fuses into the train step; H and W must be
+    divisible by r (the train loop crops to guarantee it)."""
+    b, h, w, c = x.shape
+    if h % r or w % r:
+        raise ValueError(f"({h}, {w}) not divisible by scale {r}")
+    xf = x.astype(jnp.float32)
+    return xf.reshape(b, h // r, r, w // r, r, c).mean(axis=(2, 4)).astype(x.dtype)
+
+
+def sr_loss_fn(
+    params: Any,
+    hr_batch: jnp.ndarray,
+    config: SrTrainConfig,
+    apply_fn=None,
+) -> Tuple[jnp.ndarray, dict]:
+    """``apply_fn`` defaults to the single-shard forward; make_train_step
+    passes the per-shard TP version (called inside shard_map)."""
+    apply_fn = apply_fn or (lambda p, b: apply_espcn(p, b, config.net))
+    lr_batch = downscale_area(hr_batch, config.net.scale)
+    out = apply_fn(params, lr_batch)
+    diff = out.astype(jnp.float32) - hr_batch.astype(jnp.float32)
+    loss = jnp.mean(jnp.sqrt(diff * diff + config.charbonnier_eps**2))
+    # MSE (not PSNR) goes in the metrics: under data parallelism metrics
+    # are pmean'd across shards, and mean-of-MSEs is the global MSE
+    # (equal shard sizes) while mean-of-PSNRs is Jensen-biased high. The
+    # train step derives PSNR once, after the pmean.
+    mse = jnp.mean(diff * diff)
+    return loss, {"loss": loss, "mse": mse}
+
+
+def make_optimizer(config: SrTrainConfig) -> optax.GradientTransformation:
+    return optax.adam(config.learning_rate)
+
+
+def init_train_state(rng: jax.Array, config: SrTrainConfig = SrTrainConfig()) -> SrTrainState:
+    params = init_espcn(rng, config.net)
+    return SrTrainState(
+        params=params,
+        opt_state=make_optimizer(config).init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def state_pspecs(state: SrTrainState, config: SrTrainConfig) -> SrTrainState:
+    """Spec tree mirroring an SrTrainState; adam moments inherit each
+    param leaf's TP spec (same path-resolution rule as train.style)."""
+    p_specs = param_pspecs(config.net)
+
+    def opt_spec(path, _leaf):
+        keys = [k.key for k in path if isinstance(k, jax.tree_util.DictKey)]
+        node: Any = p_specs
+        for k in keys:
+            if not isinstance(node, dict) or k not in node:
+                return P()
+            node = node[k]
+        return node if isinstance(node, P) else P()
+
+    return SrTrainState(
+        params=p_specs,
+        opt_state=jax.tree_util.tree_map_with_path(opt_spec, state.opt_state),
+        step=P(),
+    )
+
+
+def shard_train_state(state: SrTrainState, mesh: Mesh, config: SrTrainConfig) -> SrTrainState:
+    specs = state_pspecs(state, config)
+    put = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))  # noqa: E731
+    return SrTrainState(
+        params=jax.tree.map(put, state.params, specs.params),
+        opt_state=jax.tree.map(put, state.opt_state, specs.opt_state),
+        step=put(state.step, specs.step),
+    )
+
+
+def train_batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(("data", "space")))
+
+
+def make_train_step(
+    mesh: Mesh,
+    config: SrTrainConfig = SrTrainConfig(),
+    state_template: SrTrainState = None,
+    donate: bool = True,
+) -> Callable[[SrTrainState, jnp.ndarray], Tuple[SrTrainState, dict]]:
+    """Jitted mesh-sharded step: ``(state, hr_batch) -> (state, metrics)``
+    with hr_batch sharded per :func:`train_batch_sharding`."""
+    if state_template is None:
+        raise ValueError("make_train_step needs a state_template SrTrainState")
+    optimizer = make_optimizer(config)
+    apply_fn = tp_inner_apply(config.net)
+    specs = state_pspecs(state_template, config)
+    dp_axes = ("data", "space")
+
+    def local_step(state: SrTrainState, batch: jnp.ndarray):
+        grads, metrics = jax.grad(sr_loss_fn, has_aux=True)(
+            state.params, batch, config, apply_fn,
+        )
+        grads = lax.pmean(grads, dp_axes)
+        metrics = lax.pmean(metrics, dp_axes)
+        metrics["psnr"] = -10.0 * jnp.log10(metrics.pop("mse") + 1e-12)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        return (
+            SrTrainState(
+                params=optax.apply_updates(state.params, updates),
+                opt_state=opt_state,
+                step=state.step + 1,
+            ),
+            metrics,
+        )
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(specs, P(dp_axes)),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

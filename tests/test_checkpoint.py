@@ -91,3 +91,32 @@ def test_cli_train_checkpoint_resume(tmp_path, capsys):
         "--resume", os.path.join(ckpt, "fnal"),
     ])
     assert rc == 2
+
+
+def test_cli_train_sr_checkpoint_resume(tmp_path, capsys):
+    """train-sr end-to-end through the CLI: checkpoint, resume continues
+    from the saved step, serve loads the trained weights."""
+    import json
+
+    from dvf_tpu.cli import main
+    from dvf_tpu.train.checkpoint import load_sr_filter
+
+    ck = str(tmp_path / "sr")
+    assert main(["train-sr", "--steps", "6", "--batch", "2", "--size", "32",
+                 "--checkpoint-dir", ck, "--checkpoint-every", "3",
+                 "--log-every", "100"]) == 0
+    out1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out1["steps"] == 6 and np.isfinite(out1["final_loss"])
+
+    # Resume from final: continues at step 6, not from scratch.
+    assert main(["train-sr", "--steps", "8", "--batch", "2", "--size", "32",
+                 "--checkpoint-dir", ck, "--resume", ck + "/final",
+                 "--log-every", "100"]) == 0
+    captured = capsys.readouterr()
+    assert "resumed" in captured.err and "step 6" in captured.err
+
+    filt = load_sr_filter(ck)
+    assert filt.stateful
+    state = filt.init_state((1, 32, 32, 3), jnp.float32)
+    y, _ = filt.fn(jnp.full((1, 32, 32, 3), 0.5), state)
+    assert y.shape == (1, 64, 64, 3)

@@ -86,3 +86,61 @@ def test_entry_compiles():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     assert out.shape == args[1].shape
+
+
+# ---------------------------------------------------------- SR training
+
+def test_sr_train_step_reduces_loss_and_improves_psnr():
+    from dvf_tpu.train.sr import (
+        SrTrainConfig, init_train_state as sr_init, make_train_step as sr_step_fn,
+        shard_train_state as sr_shard,
+    )
+
+    cfg = SrTrainConfig()
+    mesh = make_mesh(MeshConfig())
+    state = sr_shard(sr_init(jax.random.PRNGKey(0), cfg), mesh, cfg)
+    step = sr_step_fn(mesh, cfg, state_template=state)
+    hr = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    hist = []
+    for _ in range(6):
+        state, metrics = step(state, hr)
+        hist.append((float(metrics["loss"]), float(metrics["psnr"])))
+    assert hist[-1][0] < hist[0][0]
+    assert hist[-1][1] > hist[0][1]
+    assert int(state.step) == 6
+
+
+def test_sr_train_step_sharded_matches_replicated():
+    from dvf_tpu.train.sr import (
+        SrTrainConfig, init_train_state as sr_init, make_train_step as sr_step_fn,
+        shard_train_state as sr_shard, train_batch_sharding as sr_batch_sharding,
+    )
+
+    cfg = SrTrainConfig()
+    hr = jax.random.uniform(jax.random.PRNGKey(2), (4, 32, 32, 3))
+
+    def run(mesh_config):
+        mesh = make_mesh(mesh_config)
+        state = sr_shard(sr_init(jax.random.PRNGKey(0), cfg), mesh, cfg)
+        step = sr_step_fn(mesh, cfg, state_template=state, donate=False)
+        b = jax.device_put(hr, sr_batch_sharding(mesh))
+        state, metrics = step(state, b)
+        return float(metrics["loss"]), jax.tree.map(np.asarray, state.params)
+
+    loss_1, params_1 = run(MeshConfig())
+    loss_8, params_8 = run(MeshConfig(data=2, space=2, model=2))
+    assert abs(loss_1 - loss_8) < 5e-3 * max(1.0, abs(loss_1))
+    for a, b in zip(jax.tree_util.tree_leaves(params_1),
+                    jax.tree_util.tree_leaves(params_8)):
+        np.testing.assert_allclose(a, b, atol=5e-3)
+
+
+def test_sr_downscale_area_exact():
+    from dvf_tpu.train.sr import downscale_area
+
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y = downscale_area(x, 2)
+    np.testing.assert_allclose(
+        np.asarray(y[0, :, :, 0]), [[2.5, 4.5], [10.5, 12.5]])
+    with pytest.raises(ValueError, match="divisible"):
+        downscale_area(jnp.zeros((1, 5, 4, 1)), 2)
