@@ -48,7 +48,7 @@ import os
 import sys
 import time
 
-from benchtools import last_json_line, run_cmd as _run, tail as _tail
+from benchtools import JAX_CACHE_DIR, last_json_line, run_cmd as _run, tail as _tail
 
 
 def _log(msg: str) -> None:
@@ -88,7 +88,7 @@ def main(argv=None) -> int:
     fallback = False
 
     env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dvf_jaxcache")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
 
     result = None
     if not args.cpu:

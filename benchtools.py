@@ -8,6 +8,7 @@ all device work happens in timeout-bounded children.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 from typing import Optional, Tuple
 
@@ -43,3 +44,8 @@ def last_json_line(out: str) -> Optional[dict]:
 def tail(s: str, n: int = 12) -> str:
     lines = [ln for ln in s.strip().splitlines() if ln.strip()]
     return "\n".join(lines[-n:])
+
+
+# Mirror of dvf_tpu.bench_child.JAX_CACHE_DIR (same env override) for the
+# scripts that must never import the package (bench.py's jax-free parent).
+JAX_CACHE_DIR = os.environ.get("DVF_JAX_CACHE_DIR", "/tmp/dvf_jaxcache")

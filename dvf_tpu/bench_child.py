@@ -32,6 +32,11 @@ import sys
 import threading
 import time
 
+# Single source (package-side) for the persistent XLA compile-cache
+# location; override with DVF_JAX_CACHE_DIR. benchtools.JAX_CACHE_DIR
+# mirrors this for the jax-free repo-root scripts via the same env var.
+JAX_CACHE_DIR = os.environ.get("DVF_JAX_CACHE_DIR", "/tmp/dvf_jaxcache")
+
 
 def _log(msg: str) -> None:
     print(f"[bench-child +{time.perf_counter() - _T0:.1f}s] {msg}",
@@ -106,8 +111,7 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = args.platform
     # Compile cache: a rerun (or the CPU fallback after a TPU bench that got
     # past compiling) skips compiles entirely.
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          os.path.join("/tmp", "dvf_jaxcache"))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
     _log("importing jax")
     import jax
 

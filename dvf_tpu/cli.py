@@ -48,10 +48,20 @@ def _force_platform() -> None:
     (possibly unreachable) TPU platform (see dvf_tpu.bench_child)."""
     import os
 
+    # Persistent compile cache: a retried or timeout-killed bench config
+    # skips its compiles on the next attempt — on the TPU-tunnel bench
+    # host, compiles are a large share of the per-config budget.
+    from dvf_tpu.bench_child import JAX_CACHE_DIR
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
+    import jax
+
+    # Explicit config.update too: if something (sitecustomize) imported
+    # jax before us, the env default may already have been snapshotted.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
     platform = os.environ.get("DVF_FORCE_PLATFORM")
     if platform:
-        import jax
-
         jax.config.update("jax_platforms", platform)
 
 
