@@ -121,6 +121,12 @@ def main(argv=None) -> int:
             ("gather", "flow_warp", {"warp_impl": "gather"}),
             ("pallas_warp", "flow_warp", {"warp_impl": "pallas"}),
         ]),
+        # Separable-conv lowering: shifted-FMA vs XLA depthwise conv
+        # (ops.conv._shifted_sep_conv rationale; ~13× on CPU).
+        "gauss9_1080p": (1080, 1920, batch or 8, [
+            ("shift", "gaussian_blur", {"ksize": 9, "impl": "shift"}),
+            ("depthwise", "gaussian_blur", {"ksize": 9, "impl": "depthwise"}),
+        ]),
     }
     if args.quick:
         # Quick mode shrinks shapes — rename the keys so tiny-shape numbers

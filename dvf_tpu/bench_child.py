@@ -115,6 +115,12 @@ def main(argv=None) -> int:
     _log("importing jax")
     import jax
 
+    # config.update as well: `python -m dvf_tpu.bench_child` imports jax
+    # via the package __init__ BEFORE main() runs, so the env default
+    # above may already be snapshotted (same hazard cli._force_platform
+    # documents).
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 

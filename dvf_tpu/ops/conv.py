@@ -5,11 +5,18 @@ and the Sobel half of configs[2]. The reference has no conv ops — its only op
 is invert (inverter.py:41) — so these are capability extensions specified by
 the north-star configs.
 
-TPU mapping: depthwise ``lax.conv_general_dilated`` in NHWC with
-``feature_group_count=C``; separability keeps the arithmetic O(k) per pixel
-instead of O(k²), and XLA fuses the two 1-D passes' surrounding elementwise
-work. Borders use reflect-101 padding (``jnp.pad(mode="reflect")``), matching
-cv2's default ``BORDER_REFLECT_101`` so golden tests can compare exactly.
+TPU mapping: the default lowering is stencil-as-shifted-FMAs
+(``_shifted_sep_conv``) — k static shifted slices of one padded buffer,
+multiply-added per axis. A C=3 depthwise conv can't fill the MXU's
+128-wide reduction and XLA's depthwise path is slow on TPU and CPU alike;
+the shift formulation is pure VPU elementwise work XLA fuses into one
+pass per axis (measured ~13× on the CPU backend at 1080p k=9; TPU
+comparison in benchmarks/BENCH_TABLE.md). The depthwise
+``lax.conv_general_dilated`` form is kept for A/B benchmarking
+(``impl="depthwise"``). Separability keeps arithmetic O(k) per pixel
+either way. Borders use reflect-101 padding (``jnp.pad(mode="reflect")``),
+matching cv2's default ``BORDER_REFLECT_101`` so golden tests compare
+exactly.
 """
 
 from __future__ import annotations
@@ -70,27 +77,64 @@ def _depthwise_sep_conv(batch: jnp.ndarray, kh: jnp.ndarray, kw: jnp.ndarray) ->
     return x
 
 
-def sep_conv2d(batch: jnp.ndarray, kh: jnp.ndarray, kw: jnp.ndarray) -> jnp.ndarray:
-    """Public separable-conv helper (used by flow and tests)."""
-    return _depthwise_sep_conv(batch, kh, kw)
+def _shifted_sep_conv(batch: jnp.ndarray, kh: jnp.ndarray, kw: jnp.ndarray) -> jnp.ndarray:
+    """Separable conv as k static shifted-slice FMAs per axis.
+
+    A C=3 depthwise conv can never fill the MXU's 128-wide reduction, and
+    XLA's depthwise lowering is the slow path on both TPU and CPU. The
+    stencil-as-shifts formulation is pure elementwise multiply-adds over
+    views of one padded buffer — VPU work that XLA fuses into a single
+    pass per axis. Numerically identical accumulation order to a 1-D conv
+    (taps accumulated in index order), so cv2 golden tests are unaffected.
+    """
+    rh, rw = kh.shape[0] // 2, kw.shape[0] // 2
+    x = jnp.pad(batch, ((0, 0), (rh, rh), (rw, rw), (0, 0)), mode="reflect")
+    h = batch.shape[1]
+    acc = kh[0].astype(x.dtype) * x[:, : h, :, :]
+    for i in range(1, kh.shape[0]):
+        acc = acc + kh[i].astype(x.dtype) * x[:, i : i + h, :, :]
+    w = batch.shape[2]
+    out = kw[0].astype(x.dtype) * acc[:, :, : w, :]
+    for j in range(1, kw.shape[0]):
+        out = out + kw[j].astype(x.dtype) * acc[:, :, j : j + w, :]
+    return out
+
+
+def sep_conv2d(
+    batch: jnp.ndarray,
+    kh: jnp.ndarray,
+    kw: jnp.ndarray,
+    impl: str = "shift",
+) -> jnp.ndarray:
+    """Public separable-conv helper (used by flow and tests).
+
+    ``impl``: "shift" (default — stencil-as-shifted-FMAs, the fast path
+    for 3-channel images on TPU and CPU) or "depthwise" (XLA conv op,
+    kept for A/B benchmarking; see benchmarks/run_table.py).
+    """
+    if impl == "shift":
+        return _shifted_sep_conv(batch, kh, kw)
+    if impl == "depthwise":
+        return _depthwise_sep_conv(batch, kh, kw)
+    raise ValueError(f"impl must be 'shift' or 'depthwise', got {impl!r}")
 
 
 @register_filter("gaussian_blur")
-def gaussian_blur(ksize: int = 9, sigma: float = 0.0) -> Filter:
+def gaussian_blur(ksize: int = 9, sigma: float = 0.0, impl: str = "shift") -> Filter:
     kern = gaussian_kernel_1d(ksize, sigma)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
-        return _depthwise_sep_conv(batch, kern, kern)
+        return sep_conv2d(batch, kern, kern, impl=impl)
 
     return stateless(f"gaussian_blur(k={ksize},s={sigma})", fn, halo=ksize // 2)
 
 
 @register_filter("box_blur")
-def box_blur(ksize: int = 3) -> Filter:
-    kern = jnp.full((ksize,), 1.0 / ksize, dtype=jnp.float32)
+def box_blur(ksize: int = 3, impl: str = "shift") -> Filter:
+    kern = np.full((ksize,), 1.0 / ksize, dtype=np.float32)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
-        return _depthwise_sep_conv(batch, kern, kern)
+        return sep_conv2d(batch, kern, kern, impl=impl)
 
     return stateless(f"box_blur(k={ksize})", fn, halo=ksize // 2)
 
@@ -106,8 +150,8 @@ _SOBEL_S = np.array([1.0, 2.0, 1.0], dtype=np.float32)
 
 def sobel_gradients(batch: jnp.ndarray):
     """Per-channel Sobel dx, dy (cv2.Sobel ksize=3, reflect-101 borders)."""
-    gx = _depthwise_sep_conv(batch, _SOBEL_S, _SOBEL_D)
-    gy = _depthwise_sep_conv(batch, _SOBEL_D, _SOBEL_S)
+    gx = _shifted_sep_conv(batch, _SOBEL_S, _SOBEL_D)
+    gy = _shifted_sep_conv(batch, _SOBEL_D, _SOBEL_S)
     return gx, gy
 
 
@@ -133,7 +177,7 @@ def sharpen(amount: float = 1.0, ksize: int = 5, sigma: float = 1.0) -> Filter:
     kern = gaussian_kernel_1d(ksize, sigma)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
-        blurred = _depthwise_sep_conv(batch, kern, kern)
+        blurred = _shifted_sep_conv(batch, kern, kern)
         return jnp.clip(batch + amount * (batch - blurred), 0.0, 1.0)
 
     return stateless(f"sharpen(a={amount})", fn, halo=ksize // 2)

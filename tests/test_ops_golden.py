@@ -207,3 +207,21 @@ def test_cartoon_rejects_bad_levels():
 def test_cartoon_halo_never_pointwise():
     assert get_filter("cartoon", d=1).halo == 1  # Sobel term needs it
     assert get_filter("cartoon", d=5).halo == 2
+
+
+def test_sep_conv_impls_agree():
+    """The shifted-FMA lowering (default) and the XLA depthwise-conv
+    lowering are the same mathematical operator — any divergence means a
+    shift/border bug in one of them."""
+    import jax
+
+    from dvf_tpu.ops.conv import gaussian_kernel_1d, sep_conv2d
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.random((2, 37, 53, 3), np.float32))
+    for ksize in (3, 5, 9):
+        k = gaussian_kernel_1d(ksize, 0.0)
+        a = jax.jit(lambda b: sep_conv2d(b, k, k, impl="shift"))(x)
+        d = jax.jit(lambda b: sep_conv2d(b, k, k, impl="depthwise"))(x)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(d),
+                                   atol=1e-5, rtol=1e-5)
