@@ -122,10 +122,12 @@ def main(argv=None) -> int:
             ("pallas_warp", "flow_warp", {"warp_impl": "pallas"}),
         ]),
         # Separable-conv lowering: shifted-FMA vs XLA depthwise conv
-        # (ops.conv._shifted_sep_conv rationale; ~13× on CPU).
+        # (ops.conv._shifted_sep_conv rationale; ~13× on CPU) vs the fused
+        # one-VMEM-residency Pallas kernel.
         "gauss9_1080p": (1080, 1920, batch or 8, [
             ("shift", "gaussian_blur", {"ksize": 9, "impl": "shift"}),
             ("depthwise", "gaussian_blur", {"ksize": 9, "impl": "depthwise"}),
+            ("pallas_fused", "gaussian_blur_pallas", {"ksize": 9}),
         ]),
     }
     if args.quick:

@@ -49,6 +49,13 @@ from dvf_tpu.api.filter import Filter, stateless
 from dvf_tpu.ops.registry import register_filter
 
 
+def _auto_interpret(interpret):
+    """None → compiled on TPU, interpret mode elsewhere (CPU tests)."""
+    if interpret is None:
+        return jax.default_backend() not in ("tpu",)
+    return interpret
+
+
 def _pick_tile_h(h: int, target: int = 16) -> int:
     """Largest divisor of h that is <= target (grid must tile H exactly)."""
     for th in range(min(target, h), 0, -1):
@@ -221,6 +228,98 @@ def warp_bounded_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Fused separable blur (both 1-D passes in one VMEM residency)
+# ---------------------------------------------------------------------------
+
+
+def _sep_blur_kernel(tile_h: int, rh: int, rw: int, w: int, kh_taps, kw_taps):
+    def kernel(in_ref, out_ref, scratch, sem):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        copy = pltpu.make_async_copy(
+            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * rh), :],
+            scratch,
+            sem,
+        )
+        copy.start()
+        copy.wait()
+        x = scratch[...].astype(jnp.float32)       # (c, th+2rh, w+2rw)
+        # H pass on the slab, W extent kept: (c, th, w+2rw).
+        acc = kh_taps[0] * x[:, 0:tile_h, :]
+        for t in range(1, len(kh_taps)):
+            acc = acc + kh_taps[t] * x[:, t : t + tile_h, :]
+        # W pass: (c, th, w).
+        out = kw_taps[0] * acc[:, :, 0:w]
+        for t in range(1, len(kw_taps)):
+            out = out + kw_taps[t] * acc[:, :, t : t + w]
+        out_ref[...] = out[None].astype(out_ref.dtype)
+
+    return kernel
+
+
+def sep_blur_nhwc_pallas(
+    batch: jnp.ndarray,
+    kh,
+    kw,
+    tile_h: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Separable conv over float NHWC with both 1-D passes fused into one
+    VMEM residency per tile — the intermediate (H-blurred) slab never
+    touches HBM, unlike the two-pass jnp lowerings in ops.conv. Numerics
+    match ``sep_conv2d`` (same reflect-101 borders, same tap order)."""
+    import numpy as np
+
+    kh_taps = [float(v) for v in np.asarray(kh)]
+    kw_taps = [float(v) for v in np.asarray(kw)]
+    rh, rw = len(kh_taps) // 2, len(kw_taps) // 2
+    b, h, w, c = batch.shape
+    th = tile_h if tile_h is not None else _pick_tile_h(h)
+    if h % th != 0:
+        raise ValueError(f"tile_h {th} must divide H {h}")
+
+    x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
+    x = jnp.pad(x, ((0, 0), (0, 0), (rh, rh), (rw, rw)), mode="reflect")
+
+    kernel = _sep_blur_kernel(th, rh, rw, w, kh_taps, kw_taps)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h // th),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((c, th + 2 * rh, w + 2 * rw), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(x)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+@register_filter("gaussian_blur_pallas")
+def gaussian_blur_pallas(
+    ksize: int = 9,
+    sigma: float = 0.0,
+    tile_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Filter:
+    """Pallas-backed separable Gaussian (A/B partner of ``gaussian_blur``;
+    run_table records the per-backend winner). ``interpret=None`` → auto:
+    compiled on TPU, interpret mode elsewhere."""
+    from dvf_tpu.ops.conv import gaussian_kernel_1d
+
+    kern = gaussian_kernel_1d(ksize, sigma)
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        return sep_blur_nhwc_pallas(batch, kern, kern, tile_h=tile_h,
+                                    interpret=_auto_interpret(interpret))
+
+    return stateless(f"gaussian_blur_pallas(k={ksize},s={sigma})", fn,
+                     halo=ksize // 2)
+
+
+# ---------------------------------------------------------------------------
 # Fused Sobel + bilateral (BASELINE configs[2] as ONE kernel)
 # ---------------------------------------------------------------------------
 
@@ -330,12 +429,10 @@ def sobel_bilateral_pallas(
     ``interpret=None`` → auto: compiled on TPU, interpret mode elsewhere."""
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
-        interp = interpret
-        if interp is None:
-            interp = jax.default_backend() not in ("tpu",)
         return sobel_bilateral_nhwc_pallas(
             batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space,
-            magnitude_scale=magnitude_scale, tile_h=tile_h, interpret=interp,
+            magnitude_scale=magnitude_scale, tile_h=tile_h,
+            interpret=_auto_interpret(interpret),
         )
 
     return stateless(
@@ -357,12 +454,9 @@ def bilateral_pallas(
     interpret mode elsewhere (CPU tests)."""
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
-        interp = interpret
-        if interp is None:
-            interp = jax.default_backend() not in ("tpu",)
         return bilateral_nhwc_pallas(
             batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space,
-            tile_h=tile_h, interpret=interp,
+            tile_h=tile_h, interpret=_auto_interpret(interpret),
         )
 
     return stateless(
