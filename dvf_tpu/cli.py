@@ -492,7 +492,6 @@ def cmd_train_sr(args) -> int:
     import jax
     import numpy as np
 
-    from dvf_tpu.io.sources import SyntheticSource
     from dvf_tpu.models.espcn import EspcnConfig
     from dvf_tpu.parallel.mesh import MeshConfig, make_mesh
     from dvf_tpu.train.checkpoint import restore_sr_checkpoint, save_checkpoint
@@ -501,6 +500,7 @@ def cmd_train_sr(args) -> int:
         init_train_state,
         make_train_step,
         shard_train_state,
+        synthesize_structured_batch,
         train_batch_sharding,
     )
 
@@ -511,9 +511,20 @@ def cmd_train_sr(args) -> int:
     config = SrTrainConfig(net=EspcnConfig(scale=args.scale), learning_rate=args.lr)
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshConfig(data=math.gcd(args.batch, n_dev)))
-    src = SyntheticSource(height=args.size, width=args.size,
-                          n_frames=args.steps * args.batch, rate=0.0)
-    frames = iter(src)
+    # Randomized structured frames: edge-rich content drawn fresh per
+    # frame (train.sr.synthesize_structured_batch) — iid noise is
+    # information-destroyed by downscaling and unlearnable, and a fixed
+    # frame cycle (SyntheticSource) gets memorized instead of teaching
+    # edge reconstruction (measured -0.2 dB vs nearest on unseen frames).
+    def _frame_gen():
+        import numpy as _np
+
+        rng = _np.random.default_rng(args.seed + 1)
+        while True:
+            for f in synthesize_structured_batch(rng, args.batch, args.size):
+                yield f, 0.0
+
+    frames = _frame_gen()
 
     state = init_train_state(jax.random.PRNGKey(args.seed), config)
     if args.resume:

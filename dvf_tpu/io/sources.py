@@ -36,6 +36,7 @@ class SyntheticSource:
         rate: float = 0.0,
         seed: int = 0,
         motion: bool = True,
+        texture: str = "noise",
     ):
         self.height, self.width, self.channels = height, width, channels
         self.n_frames = n_frames
@@ -48,9 +49,30 @@ class SyntheticSource:
         # unthrottled 1080p source doing a fresh 6 MB np.roll copy per frame
         # burns ~1 GB/s of host bandwidth + GIL inside the ingest thread and
         # becomes the pipeline bottleneck it exists to measure around.
-        base = rng.integers(0, 255, size=(height, width, channels), dtype=np.uint8)
-        ramp = np.linspace(0, 255, width, dtype=np.uint8)[None, :, None]
-        self._base = (base // 2 + ramp // 2).astype(np.uint8)
+        #
+        # ``texture``: "noise" (default — iid noise + ramp; maximally
+        # incompressible, the bench workload) or "structured" (gratings,
+        # rings, and hard-edged blocks; spatially coherent content with
+        # real edges — what super-resolution training needs, since iid
+        # noise is information-destroyed by downscaling and unlearnable).
+        if texture == "structured":
+            yy, xx = np.mgrid[0:height, 0:width].astype(np.float32)
+            rad = np.hypot(yy - height / 2.0, xx - width / 2.0)
+            ch = [
+                127.5 + 127.5 * np.sin(2 * np.pi * xx / 17.0),        # grating
+                127.5 + 127.5 * np.sin(rad / 5.0),                    # rings
+                ((xx // 11).astype(int) + (yy // 11).astype(int)) % 2 * 255.0,  # checker
+            ]
+            base = np.stack([ch[i % 3] for i in range(channels)], axis=-1)
+            # hard-edged diagonal blocks for step edges in every channel
+            block = (((xx + yy) // 23).astype(int) % 3 == 0)[..., None] * 60.0
+            self._base = np.clip(base * 0.75 + block, 0, 255).astype(np.uint8)
+        elif texture == "noise":
+            base = rng.integers(0, 255, size=(height, width, channels), dtype=np.uint8)
+            ramp = np.linspace(0, 255, width, dtype=np.uint8)[None, :, None]
+            self._base = (base // 2 + ramp // 2).astype(np.uint8)
+        else:
+            raise ValueError(f"texture must be 'noise' or 'structured', got {texture!r}")
         n_cycle = min(16, n_frames) if motion else 1
         self._cycle = [
             np.roll(self._base, (i * 2) % self.width, axis=1) for i in range(n_cycle)

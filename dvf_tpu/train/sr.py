@@ -49,6 +49,45 @@ class SrTrainState:
     step: jnp.ndarray
 
 
+def synthesize_structured_batch(rng: "np.random.Generator", batch: int,
+                                size: int) -> "np.ndarray":
+    """Randomized structured HR frames for self-supervised SR training.
+
+    Each frame draws fresh grating frequencies/orientations, ring centers,
+    and checker scales — a *distribution* of edge-rich content, so the net
+    must learn edge reconstruction instead of memorizing a fixed frame
+    cycle (training on SyntheticSource's 16-frame round-robin overfits:
+    measured −0.2 dB vs nearest on unseen frames, vs several dB gained
+    when trained on this generator). Values uint8, shape (B, size, size, 3).
+    """
+    import numpy as np
+
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    out = np.empty((batch, size, size, 3), np.uint8)
+    for b in range(batch):
+        chans = []
+        for _ in range(3):
+            kind = rng.integers(0, 3)
+            if kind == 0:  # oriented grating
+                freq = rng.uniform(6.0, 32.0)
+                ang = rng.uniform(0.0, np.pi)
+                ph = rng.uniform(0.0, 2 * np.pi)
+                u = xx * np.cos(ang) + yy * np.sin(ang)
+                ch = 127.5 + 127.5 * np.sin(2 * np.pi * u / freq + ph)
+            elif kind == 1:  # rings around a random center
+                cy, cx = rng.uniform(0, size, 2)
+                rad = np.hypot(yy - cy, xx - cx)
+                ch = 127.5 + 127.5 * np.sin(rad / rng.uniform(2.0, 8.0))
+            else:  # hard-edged checker, random scale + offset
+                s = rng.integers(5, 21)
+                oy, ox = rng.integers(0, s, 2)
+                ch = (((xx + ox) // s).astype(int)
+                      + ((yy + oy) // s).astype(int)) % 2 * 255.0
+            chans.append(ch)
+        out[b] = np.clip(np.stack(chans, -1), 0, 255).astype(np.uint8)
+    return out
+
+
 def downscale_area(x: jnp.ndarray, r: int) -> jnp.ndarray:
     """Area (box) ×r downscale — the supervision pair generator. A pure
     reshape+mean, so it fuses into the train step; H and W must be

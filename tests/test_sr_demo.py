@@ -1,0 +1,94 @@
+"""The trained super-resolution demonstration (second neural family).
+
+A tiny trained checkpoint is committed at checkpoints/sr2x_64 (6.2k steps,
+self-supervised downscale→reconstruct on randomized structured frames — see
+docs/sr_demo.png for nearest | SR | ground-truth). These tests prove the
+SR filter actually super-resolves: clearly better than the nearest-
+neighbor baseline on held-out frames, reproducing the committed golden,
+and loadable end-to-end through ``serve --sr-checkpoint``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+CKPT = os.path.join(os.path.dirname(__file__), "..", "checkpoints", "sr2x_64")
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "sr_demo_out.npy")
+
+
+@pytest.fixture(scope="module")
+def sr_eval():
+    import jax.numpy as jnp
+
+    from dvf_tpu.models.layers import upsample_nearest
+    from dvf_tpu.train.checkpoint import load_sr_filter
+    from dvf_tpu.train.sr import downscale_area, synthesize_structured_batch
+
+    filt = load_sr_filter(CKPT)
+    # GENUINELY held out: fresh draws from a seed the train CLI never uses
+    # (it derives its stream from args.seed + 1 = 1), at 80x80 — a
+    # geometry the 64x64 training never saw. A net that memorized the
+    # training distribution's samples cannot score here; only learned
+    # edge reconstruction can.
+    rng = np.random.default_rng(12345)
+    hr = jnp.asarray(synthesize_structured_batch(rng, 8, 80), jnp.float32) / 255.0
+    lr = downscale_area(hr, 2)
+    out, _ = filt.fn(lr, filt.init_state(lr.shape, np.float32))
+    out = jnp.clip(out, 0.0, 1.0)
+    near = upsample_nearest(lr, 2)
+    return (np.asarray(hr), np.asarray(out), np.asarray(near))
+
+
+def _psnr(a, b):
+    return -10.0 * np.log10(float(np.mean((a - b) ** 2)) + 1e-12)
+
+
+def test_sr_beats_nearest_baseline(sr_eval):
+    hr, out, near = sr_eval
+    p_sr, p_near = _psnr(out, hr), _psnr(near, hr)
+    # Measured +4.6 dB on this held-out set with the committed 6.2k-step
+    # checkpoint; 2.5 dB margin is far above float drift while requiring
+    # real generalization — a memorizing or broken net lands at/below
+    # the nearest baseline here.
+    assert p_sr > p_near + 2.5, (
+        f"SR ({p_sr:.2f} dB) does not clearly beat nearest ({p_near:.2f} dB)")
+
+
+def test_sr_matches_committed_golden(sr_eval):
+    _, out, _ = sr_eval
+    got = (out[0] * 255).astype(np.uint8)
+    golden = np.load(GOLDEN)
+    diff = np.abs(got.astype(int) - golden.astype(int))
+    assert diff.mean() < 2.0 and diff.max() <= 30, (
+        f"SR frame drifted from golden: mean={diff.mean():.2f} max={diff.max()}")
+
+
+def test_serve_loads_sr_checkpoint(capsys):
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--sr-checkpoint", CKPT,
+        "--source", "synthetic", "--height", "64", "--width", "64",
+        "--frames", "8", "--batch", "4", "--frame-delay", "0",
+        "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 8
+
+
+def test_structured_texture_deterministic_and_distinct():
+    from dvf_tpu.io.sources import SyntheticSource
+
+    a = SyntheticSource(height=32, width=32, n_frames=4, texture="structured")
+    b = SyntheticSource(height=32, width=32, n_frames=4, texture="structured")
+    fa = [f for f, _ in a]
+    fb = [f for f, _ in b]
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(x, y)
+    noise = next(iter(SyntheticSource(height=32, width=32, n_frames=1)))[0]
+    assert not np.array_equal(fa[0], noise)
+    with pytest.raises(ValueError, match="texture"):
+        SyntheticSource(height=8, width=8, texture="fractal")
