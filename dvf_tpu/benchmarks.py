@@ -38,6 +38,7 @@ def bench_device_resident(
     height: int,
     width: int,
     dtype=None,
+    mesh=None,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -47,7 +48,7 @@ def bench_device_resident(
 
     dtype = dtype or np.uint8
     shape = (batch_size, height, width, 3)
-    engine = Engine(filt)
+    engine = Engine(filt, mesh=mesh)
     engine.compile(shape, dtype)
 
     checksum = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
@@ -150,14 +151,14 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
 
 def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
                   queue_size, collect_mode="thread", transport="python",
-                  wire="raw") -> dict:
+                  wire="raw", mesh=None) -> dict:
     import numpy as np
 
     from dvf_tpu.io.sinks import NullSink
     from dvf_tpu.runtime.engine import Engine
     from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
 
-    engine = Engine(filt)
+    engine = Engine(filt, mesh=mesh)
     engine.compile((batch_size, height, width, 3), np.uint8)
     sink = NullSink()
     queue = None
@@ -213,6 +214,7 @@ def bench_e2e_streaming(
     collect_mode: str = "thread",
     transport: str = "python",
     wire: str = "raw",
+    mesh=None,
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
@@ -230,7 +232,7 @@ def bench_e2e_streaming(
         SyntheticSource(height=height, width=width, n_frames=n_frames, rate=rate),
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
-        collect_mode=collect_mode, transport=transport, wire=wire,
+        collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
     )
 
 
@@ -243,6 +245,7 @@ def bench_e2e_latency(
     target_fps: float,
     max_inflight: int = 2,
     collect_mode: str = "thread",
+    mesh=None,
 ) -> dict:
     """Latency mode: source throttled to ``target_fps`` (pick ~0.8× the
     measured throughput), ingest queue bounded to one batch, shallow
@@ -257,7 +260,7 @@ def bench_e2e_latency(
                         rate=target_fps),
         batch_size, height, width, max_inflight,
         queue_size=batch_size,
-        collect_mode=collect_mode,
+        collect_mode=collect_mode, mesh=mesh,
     )
     r["target_fps"] = target_fps
     return r

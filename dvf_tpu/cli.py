@@ -72,6 +72,41 @@ def _parse_filter_arg(name: str, config_json: Optional[str]):
     return get_filter(name, **cfg)
 
 
+def _parse_mesh(arg):
+    """Parse --mesh into a jax Mesh (None = engine default: all-data DP).
+
+    Forms: "data=2,space=2,model=2" (explicit axis sizes; omitted axes
+    default to 1) or "auto" / "auto:space" / "auto:model"
+    (parallel.mesh.auto_mesh_config policies over all attached devices).
+    """
+    if not arg:
+        return None
+    import jax
+
+    from dvf_tpu.parallel.mesh import MeshConfig, auto_mesh_config, make_mesh
+
+    def bad(why):
+        raise SystemExit(
+            f"error: bad --mesh {arg!r} ({why}; want e.g. data=2,space=2 "
+            f"or auto:space)")
+
+    if arg == "auto" or arg.startswith("auto:"):
+        prefer = arg.split(":", 1)[1] if ":" in arg else "data"
+        if prefer not in ("data", "space", "model"):
+            bad(f"unknown auto policy {prefer!r}")
+        return make_mesh(auto_mesh_config(len(jax.devices()), prefer=prefer))
+    sizes = {}
+    for part in arg.split(","):
+        k, _, v = part.partition("=")
+        if k not in ("data", "space", "model") or not v.isdigit() or int(v) < 1:
+            bad(f"bad axis spec {part!r}")
+        sizes[k] = int(v)
+    try:
+        return make_mesh(MeshConfig(**sizes))
+    except ValueError as e:  # more devices requested than attached
+        bad(str(e))
+
+
 def cmd_filters(_args) -> int:
     from dvf_tpu.ops import list_filters
 
@@ -148,6 +183,11 @@ def cmd_serve(args) -> int:
             return 2
     else:
         filt = _parse_filter_arg(args.filter, args.filter_config)
+    # Parse --mesh BEFORE acquiring the source: a typo'd mesh must not
+    # first open a camera / allocate the native shm ring.
+    from dvf_tpu.runtime.engine import Engine
+
+    engine = Engine(filt, mesh=_parse_mesh(args.mesh))
     source, frame_shape = _resolve_source(args)
 
     # Live serving is resilient (one bad frame never kills the stream,
@@ -183,12 +223,12 @@ def cmd_serve(args) -> int:
             headless=args.headless,
             telemetry_interval_s=config.telemetry_interval_s,
         )
-        pipe = Pipeline(tap, filt, sink, config, queue=queue)
+        pipe = Pipeline(tap, filt, sink, config, engine=engine, queue=queue)
         sink.stop_cb = pipe.stop        # ESC → graceful stop
         sink.stats_fn = pipe.stats
     else:
         sink = NullSink()
-        pipe = Pipeline(source, filt, sink, config, queue=queue)
+        pipe = Pipeline(source, filt, sink, config, engine=engine, queue=queue)
 
     # SIGINT/SIGTERM → graceful stop; repeat → hard abort (the reference
     # installs the same pair, webcam_app.py:46-48 / inverter.py:16-17).
@@ -315,7 +355,8 @@ def cmd_bench(args) -> int:
     if args.e2e:
         r = bench_e2e_streaming(filt, args.frames, batch, h, w,
                                 collect_mode=args.collect_mode,
-                                transport=args.transport, wire=args.wire)
+                                transport=args.transport, wire=args.wire,
+                                mesh=_parse_mesh(args.mesh))
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -333,7 +374,8 @@ def cmd_bench(args) -> int:
                   "(device-resident mode never touches the ingest path)",
                   file=sys.stderr)
             return 2
-        r = bench_device_resident(filt, args.iters, batch, h, w)
+        r = bench_device_resident(filt, args.iters, batch, h, w,
+                                  mesh=_parse_mesh(args.mesh))
         out = {
             "metric": f"{args.config}_device_fps",
             "value": round(r["fps"], 1),
@@ -592,6 +634,11 @@ def main(argv=None) -> int:
                     help="ingest queue: 'ring' routes frames through the "
                          "native C++ shared-memory ring (drop counter shows "
                          "up in stats as dropped_at_ingest)")
+    sp.add_argument("--mesh", default=None,
+                    help="device mesh for the engine: 'data=2,space=2,"
+                         "model=2' (omitted axes = 1) or 'auto[:space|"
+                         ":model]'; default = all-data DP over attached "
+                         "devices")
     sp.add_argument("--collect-mode", choices=("thread", "inline"),
                     default="thread",
                     help="'inline': the dispatch thread retires results "
@@ -687,6 +734,8 @@ def main(argv=None) -> int:
                          "their JSON so cross-harness numbers compare)")
     bp.add_argument("--transport", choices=("python", "ring"), default="python",
                     help="--e2e ingest transport (ring = native C++ ring)")
+    bp.add_argument("--mesh", default=None,
+                    help="device mesh, same forms as serve --mesh")
     bp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
                     help="--e2e ring payload format (jpeg measures the "
                          "codec-on-the-hot-path cost)")

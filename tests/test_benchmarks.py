@@ -54,3 +54,15 @@ def test_e2e_streaming_ring_transport_variants():
         r = bench_e2e_streaming(get_filter("invert"), 16, 4, 24, 32,
                                 transport="ring", wire=wire)
         assert r["frames"] == 16, (wire, r)
+
+
+def test_latency_bench_accepts_mesh():
+    import dvf_tpu
+    from dvf_tpu.benchmarks import bench_e2e_latency
+    from dvf_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    r = bench_e2e_latency(dvf_tpu.get_filter("invert"), n_frames=24,
+                          batch_size=8, height=32, width=32,
+                          target_fps=500.0,
+                          mesh=make_mesh(MeshConfig(data=2)))
+    assert r["frames"] > 0 and r["p50_ms"] > 0

@@ -122,3 +122,47 @@ def test_camera_to_serve_over_shm(tmp_path):
     # invariants; here we check conservation).
     assert cstats["delivered"] + pstats["dropped"] >= 24 - cstats["dropped_at_ingest"]
     assert cstats["delivered"] > 0
+
+
+def test_serve_with_explicit_mesh(capsys):
+    """--mesh exposes the engine's device mesh from the CLI: a
+    data=2,space=2,model=2 mesh over the 8 virtual CPU devices serves the
+    stream end-to-end and matches single-device numerics implicitly (the
+    dryrun/spatial suites pin that; here we pin the CLI wiring)."""
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--filter", "gaussian_blur", "--filter-config",
+        '{"ksize": 3}', "--source", "synthetic", "--height", "32",
+        "--width", "32", "--frames", "16", "--batch", "8",
+        "--frame-delay", "0", "--queue-size", "64",
+        "--mesh", "data=2,space=2,model=2",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 16
+
+
+def test_bench_with_auto_mesh(capsys):
+    from dvf_tpu.cli import main
+
+    rc = main(["bench", "--config", "invert_640x480", "--iters", "3",
+               "--batch", "8", "--mesh", "auto"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] > 0
+
+
+def test_bad_mesh_arg_fails_loudly():
+    from dvf_tpu.cli import _parse_mesh
+
+    with pytest.raises(SystemExit, match="bad --mesh"):
+        _parse_mesh("rows=2")
+    with pytest.raises(SystemExit, match="bad --mesh"):
+        _parse_mesh("data=two")
+    with pytest.raises(SystemExit, match="bad --mesh"):
+        _parse_mesh("data=0")
+    with pytest.raises(SystemExit, match="bad --mesh"):
+        _parse_mesh("auto:bogus")
+    with pytest.raises(SystemExit, match="bad --mesh"):
+        _parse_mesh("data=512")  # more devices than attached
