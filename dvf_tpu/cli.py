@@ -66,9 +66,26 @@ def _force_platform() -> None:
 
 
 def _parse_filter_arg(name: str, config_json: Optional[str]):
+    """``--filter`` value → Filter. ``"a|b|c"`` composes registered
+    filters left-to-right into one FilterChain (one fused device program —
+    the TPU analog of the reference's chain-of-worker-processes idea);
+    ``--filter-config`` JSON applies to a single filter only, since a
+    chain gives no way to address one member's kwargs."""
     from dvf_tpu.ops import get_filter
 
     cfg = json.loads(config_json) if config_json else {}
+    if "|" in name:
+        if cfg:
+            raise SystemExit(
+                "error: --filter-config cannot target members of a '|' "
+                "chain; use --filter chain --filter-config "
+                "'{\"specs\": [[\"name\", {...}], ...]}' for per-member config")
+        members = [part.strip() for part in name.split("|") if part.strip()]
+        if len(members) < 2:
+            raise SystemExit(f"error: bad chain --filter {name!r}")
+        # Sugar over the registered generic chain factory (ops.chains) —
+        # one composition path, the CLI just translates the syntax.
+        return get_filter("chain", specs=members)
     return get_filter(name, **cfg)
 
 
@@ -100,6 +117,9 @@ def _parse_mesh(arg):
         k, _, v = part.partition("=")
         if k not in ("data", "space", "model") or not v.isdigit() or int(v) < 1:
             bad(f"bad axis spec {part!r}")
+        if k in sizes:
+            bad(f"duplicate axis {k!r}")  # a typo'd layout must not
+            # silently become last-one-wins with the other axis at 1
         sizes[k] = int(v)
     try:
         return make_mesh(MeshConfig(**sizes))
@@ -257,11 +277,13 @@ def cmd_serve(args) -> int:
 def cmd_worker(args) -> int:
     _force_platform()
 
+    from dvf_tpu.runtime.engine import Engine
     from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
 
     filt = _parse_filter_arg(args.filter, args.filter_config)
     worker = TpuZmqWorker(
         filt,
+        engine=Engine(filt, mesh=_parse_mesh(args.mesh)),
         host=args.host,
         distribute_port=args.distribute_port,
         collect_port=args.collect_port,
@@ -685,6 +707,8 @@ def main(argv=None) -> int:
     wp.add_argument("--delay", type=float, default=0.0,
                     help="fault injection: sleep this many seconds per batch "
                          "(simulate a slow worker, like inverter.py --delay)")
+    wp.add_argument("--mesh", default=None,
+                    help="device mesh, same forms as serve --mesh")
 
     tp = sub.add_parser("train", help="train the style net (checkpoint/resume)")
     tp.add_argument("--steps", type=int, default=50)

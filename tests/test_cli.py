@@ -166,3 +166,28 @@ def test_bad_mesh_arg_fails_loudly():
         _parse_mesh("auto:bogus")
     with pytest.raises(SystemExit, match="bad --mesh"):
         _parse_mesh("data=512")  # more devices than attached
+    with pytest.raises(SystemExit, match="duplicate axis"):
+        _parse_mesh("data=2,data=4")
+
+
+def test_filter_pipe_composition(capsys):
+    """--filter "a|b" composes registered filters into one fused chain."""
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--filter", "gaussian_blur|invert", "--source", "synthetic",
+        "--height", "32", "--width", "32", "--frames", "16", "--batch", "8",
+        "--frame-delay", "0", "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 16
+
+
+def test_filter_pipe_composition_rejects_config_and_singletons():
+    from dvf_tpu.cli import _parse_filter_arg
+
+    with pytest.raises(SystemExit, match="chain"):
+        _parse_filter_arg("invert|sobel", '{"ksize": 3}')
+    with pytest.raises(SystemExit, match="bad chain"):
+        _parse_filter_arg("invert|", None)
