@@ -7,7 +7,8 @@ in submission order on the device, so out-of-order arrival only happens at
 the edges (multi-host async mode, elastic CPU workers via the ZMQ ingress).
 The buffer is the display sink's shock absorber either way.
 
-Semantics preserved exactly (property-tested in tests/test_reorder.py):
+Semantics preserved exactly (example-tested in tests/test_sched.py, property-tested under
+random schedules in tests/test_reorder_properties.py):
 
 - completed frames land keyed by index; ``latest`` is the max index seen
   (distributor.py:271-279);
