@@ -63,6 +63,12 @@ class JpegCodec:
             raise ValueError("JPEG decode failed")
         return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
 
+    def probe(self, data: bytes):
+        """(height, width) of a JPEG blob. cv2 has no header-only path,
+        so this decodes — use the native codec where probe cost matters."""
+        h, w = self.decode(data).shape[:2]
+        return h, w
+
     # -- batched (thread-parallel) --------------------------------------
 
     def encode_batch(self, frames: Sequence[np.ndarray]) -> List[bytes]:
@@ -195,11 +201,16 @@ class NativeJpegCodec:
         if rc != 0:
             raise ValueError("JPEG decode failed (corrupt stream)")
 
-    def decode(self, data: bytes) -> np.ndarray:
+    def probe(self, data: bytes):
+        """(height, width) from the JPEG header — no pixel decode."""
         h, w = ctypes.c_int(), ctypes.c_int()
         if self._lib.dvf_jpeg_probe(data, len(data), ctypes.byref(h), ctypes.byref(w)) != 0:
             raise ValueError("JPEG decode failed (bad header)")
-        out = np.empty((h.value, w.value, 3), np.uint8)
+        return h.value, w.value
+
+    def decode(self, data: bytes) -> np.ndarray:
+        h, w = self.probe(data)
+        out = np.empty((h, w, 3), np.uint8)
         self.decode_into(data, out)
         return out
 
@@ -215,11 +226,8 @@ class NativeJpegCodec:
         (the staging buffer handed to device_put) every frame is written
         in place by the C shim — the zero-copy path."""
         if out is None:
-            h, w = ctypes.c_int(), ctypes.c_int()
-            first = blobs[0]
-            if self._lib.dvf_jpeg_probe(first, len(first), ctypes.byref(h), ctypes.byref(w)) != 0:
-                raise ValueError("JPEG decode failed (bad header)")
-            out = np.empty((len(blobs), h.value, w.value, 3), np.uint8)
+            h, w = self.probe(blobs[0])
+            out = np.empty((len(blobs), h, w, 3), np.uint8)
         list(self.pool.map(self.decode_into, blobs, [out[i] for i in range(len(blobs))]))
         return out
 

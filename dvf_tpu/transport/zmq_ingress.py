@@ -82,6 +82,7 @@ class TpuZmqWorker:
         self.filt = filt
         self.engine = engine or Engine(filt)
         self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
+        self._staging = None  # reusable decode batch buffer (_process_batch)
         self.batch_size = batch_size
         self.assemble_timeout_s = assemble_timeout_s
         self.use_jpeg = use_jpeg
@@ -117,14 +118,6 @@ class TpuZmqWorker:
     def stop(self) -> None:
         self._stop.set()
 
-    def _decode(self, blobs):
-        if self.use_jpeg:
-            return self.codec.decode_batch(blobs)
-        s = self.raw_size
-        return np.stack([
-            np.frombuffer(b, np.uint8).reshape(s, s, 3) for b in blobs
-        ])
-
     def _encode(self, batch_u8: np.ndarray):
         if self.use_jpeg:
             return self.codec.encode_batch(list(batch_u8))
@@ -138,16 +131,46 @@ class TpuZmqWorker:
         """
         t0 = time.time()
         indices = [i for i, _ in pending]
-        frames = self._decode([b for _, b in pending])
-        valid = len(frames)
+        valid = len(pending)
+        blobs = [b for _, b in pending]
+        # One reusable full-batch staging buffer: _process_batch is fully
+        # synchronous (the np.asarray below fetches the result before the
+        # next batch is assembled), so the buffer handed to engine.submit
+        # is never still in flight when rewritten. JPEG mode decodes each
+        # frame in place via the C shim — zero per-batch allocations.
+        # Geometry follows the STREAM (the app's target_size), not our
+        # --target-size flag, which only governs the raw path's reshape
+        # (reference inverter.py:34 hardcodes raw geometry the same way).
+        # Probe only when the cached staging is absent or proves stale
+        # (the cv2 fallback codec's probe() is a full decode — probing
+        # every batch would double-decode the first frame on that path).
+        if self.use_jpeg:
+            if self._staging is None:
+                h, w = self.codec.probe(blobs[0])
+                self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
+            try:
+                self.codec.decode_batch(blobs, out=self._staging[:valid])
+            except ValueError:
+                # Stream geometry changed (the app restarted with a new
+                # target_size): re-probe, re-stage, retry once — a real
+                # decode error then raises into run()'s containment.
+                h, w = self.codec.probe(blobs[0])
+                self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
+                self.codec.decode_batch(blobs, out=self._staging[:valid])
+        else:
+            h = w = self.raw_size
+            shape = (self.batch_size, h, w, 3)
+            if self._staging is None or self._staging.shape != shape:
+                self._staging = np.empty(shape, np.uint8)
+            for row, b in enumerate(blobs):
+                self._staging[row] = np.frombuffer(b, np.uint8).reshape(h, w, 3)
+        frames = self._staging
         # Pad to the compiled batch signature (static shapes — one
         # compilation for every batch size). Repeat-last keeps stateful
         # temporal windows correct — see Filter.pad_safe (enforced in
         # __init__ for filters where it wouldn't).
-        if valid < self.batch_size:
-            frames = np.concatenate(
-                [frames, np.repeat(frames[-1:], self.batch_size - valid, 0)]
-            )
+        for row in range(valid, self.batch_size):
+            frames[row] = frames[valid - 1]
         if self.delay_s > 0:
             # Fault injection: simulate a slow worker to exercise the app's
             # drop/reorder logic, like the reference's --delay
