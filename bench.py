@@ -166,6 +166,24 @@ def main(argv=None) -> int:
         "fallback": fallback,
         "error": error,
     }
+    tpu_doc = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "TPU_BENCH_R3.json")
+    if fallback and os.path.exists(tpu_doc):
+        # A real-chip measurement exists from an earlier healthy tunnel
+        # window; embed its identity (metric/value/when) so a CPU-fallback
+        # round-end run is never mistaken for "no TPU number exists" — and
+        # so a STALE on-file number is visibly stamped, not silently cited.
+        try:
+            with open(tpu_doc) as f:
+                doc = json.load(f)
+            out["tpu_result_on_file"] = {
+                "path": "benchmarks/TPU_BENCH_R3.json",
+                "metric": doc.get("result", {}).get("metric"),
+                "value": doc.get("result", {}).get("value"),
+                "captured_utc": doc.get("captured_utc"),
+            }
+        except Exception:
+            pass
     print(json.dumps(out), flush=True)
     return 0
 

@@ -187,8 +187,10 @@ def sharpen(amount: float = 1.0, ksize: int = 5, sigma: float = 1.0) -> Filter:
 def emboss(strength: float = 1.0) -> Filter:
     """Classic 3x3 emboss (directional relief) on luma, +0.5 gray offset.
 
-    Non-separable kernel — lowered as one depthwise conv; reflect-101
-    borders like every other stencil here.
+    Non-separable kernel — lowered as 9 shifted-slice FMAs (the same
+    stencil-as-shifts policy as :func:`_shifted_sep_conv`: a C=1
+    depthwise conv is the slow XLA path on TPU and CPU alike; zero taps
+    are skipped entirely). Reflect-101 borders like every other stencil.
     """
     kern = np.array(
         [[-2.0, -1.0, 0.0],
@@ -199,12 +201,14 @@ def emboss(strength: float = 1.0) -> Filter:
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         gray = rgb_to_gray(batch)
+        h, w = gray.shape[1], gray.shape[2]
         x = jnp.pad(gray, ((0, 0), (1, 1), (1, 1), (0, 0)), mode="reflect")
-        k4 = jnp.asarray(kern).reshape(3, 3, 1, 1)
-        y = lax.conv_general_dilated(
-            x, k4, window_strides=(1, 1), padding="VALID",
-            dimension_numbers=_DN, feature_group_count=1,
-        )
+        y = jnp.zeros_like(gray)
+        for dy in range(3):
+            for dx in range(3):
+                tap = float(kern[dy, dx])
+                if tap != 0.0:
+                    y = y + tap * x[:, dy : dy + h, dx : dx + w, :]
         out = jnp.clip(y + 0.5, 0.0, 1.0)
         return jnp.broadcast_to(out, batch.shape).astype(batch.dtype)
 

@@ -38,6 +38,12 @@ except ImportError:  # pragma: no cover
     _HAS_CV2 = False
 
 
+class JpegGeometryError(ValueError):
+    """The JPEG's dims differ from the caller's staging geometry — a
+    re-stageable condition (the stream changed size), distinct from a
+    corrupt stream, so callers can retry exactly this case."""
+
+
 class JpegCodec:
     def __init__(self, quality: int = 90, threads: int = 4):
         if not _HAS_CV2:
@@ -83,6 +89,10 @@ class JpegCodec:
         if out is None:
             return np.stack(frames)
         for i, f in enumerate(frames):
+            if f.shape != out[i].shape:
+                raise JpegGeometryError(
+                    f"JPEG is {f.shape[0]}x{f.shape[1]}, staging row is "
+                    f"{out[i].shape[0]}x{out[i].shape[1]}")
             out[i] = f
         return out
 
@@ -195,7 +205,7 @@ class NativeJpegCodec:
             ctypes.byref(gh), ctypes.byref(gw),
         )
         if rc == 1:
-            raise ValueError(
+            raise JpegGeometryError(
                 f"JPEG is {gh.value}x{gw.value}, staging row is {h}x{w}"
             )
         if rc != 0:

@@ -31,7 +31,7 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.runtime.engine import Engine
-from dvf_tpu.transport.codec import make_codec
+from dvf_tpu.transport.codec import JpegGeometryError, make_codec
 
 
 class TpuZmqWorker:
@@ -150,10 +150,11 @@ class TpuZmqWorker:
                 self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
             try:
                 self.codec.decode_batch(blobs, out=self._staging[:valid])
-            except ValueError:
+            except JpegGeometryError:
                 # Stream geometry changed (the app restarted with a new
-                # target_size): re-probe, re-stage, retry once — a real
-                # decode error then raises into run()'s containment.
+                # target_size): re-probe, re-stage, retry once. Corrupt
+                # streams raise plain ValueError and go straight to
+                # run()'s containment — no wasted second decode.
                 h, w = self.codec.probe(blobs[0])
                 self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
                 self.codec.decode_batch(blobs, out=self._staging[:valid])
