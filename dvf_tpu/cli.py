@@ -127,6 +127,77 @@ def _parse_mesh(arg):
         bad(str(e))
 
 
+def cmd_doctor(args) -> int:
+    """Environment diagnostics, safely bounded: backend reachability is
+    probed in a KILLED-on-timeout subprocess (a hung PJRT init — the
+    observed failure mode of this bench host's TPU tunnel — must never
+    hang the diagnostic itself). Prints one JSON document."""
+    import subprocess
+
+    from dvf_tpu.bench_child import JAX_CACHE_DIR
+
+    report = {"python": sys.version.split()[0]}
+
+    # Native shims: build (content-hash cached) and report.
+    try:
+        from dvf_tpu.transport.ring import FrameRing
+
+        ring = FrameRing(capacity_bytes=1 << 16)
+        ring.close()
+        report["ring_shim"] = "ok"
+    except Exception as e:  # noqa: BLE001
+        report["ring_shim"] = f"FAILED: {e}"
+    try:
+        from dvf_tpu.transport.codec import NativeJpegCodec
+
+        NativeJpegCodec().close()
+        report["jpeg_shim"] = "ok"
+    except Exception as e:  # noqa: BLE001
+        report["jpeg_shim"] = f"cv2 fallback ({e})"
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
+    report["compile_cache"] = {
+        "dir": cache_dir,
+        "entries": len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0,
+    }
+
+    # Backend probe in a bounded subprocess (never hangs this process).
+    # Runs _force_platform itself, so the doctor reports exactly the
+    # backend+cache configuration every other subcommand would get.
+    probe = (
+        "import json\n"
+        "from dvf_tpu.cli import _force_platform\n"
+        "_force_platform()\n"
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "print(json.dumps({'platform': ds[0].platform,"
+        " 'n_devices': len(ds), 'kinds': sorted({d.device_kind for d in ds})}))\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", probe], capture_output=True,
+                           text=True, timeout=args.probe_timeout)
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        stderr_tail = (r.stderr.strip().splitlines() or ["<no stderr>"])[-1]
+        report["backend"] = json.loads(line) if r.returncode == 0 and line.startswith("{") else {
+            "error": f"probe rc={r.returncode}: {stderr_tail}"}
+    except subprocess.TimeoutExpired:
+        report["backend"] = {
+            "error": f"backend init exceeded {args.probe_timeout:.0f}s "
+                     "(tunnel down?); CPU runs still work via "
+                     "DVF_FORCE_PLATFORM=cpu"}
+    n = report["backend"].get("n_devices")
+    if n:
+        from dvf_tpu.parallel.mesh import auto_mesh_config
+
+        cfgs = {p: auto_mesh_config(n, prefer=p) for p in ("data", "space", "model")}
+        report["mesh_suggestions"] = {
+            p: f"data={c.data},space={c.space},model={c.model}"
+            for p, c in cfgs.items()
+        }
+    print(json.dumps(report, indent=2))
+    return 0 if "error" not in report["backend"] else 1
+
+
 def cmd_filters(_args) -> int:
     from dvf_tpu.ops import list_filters
 
@@ -627,6 +698,10 @@ def main(argv=None) -> int:
 
     sub.add_parser("filters", help="list registered filters")
 
+    dp_ = sub.add_parser("doctor", help="environment diagnostics (bounded backend probe)")
+    dp_.add_argument("--probe-timeout", type=float, default=60.0,
+                     help="seconds before declaring the backend unreachable")
+
     sp = sub.add_parser("serve", help="run the pipeline")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
@@ -766,7 +841,8 @@ def main(argv=None) -> int:
 
     args = ap.parse_args(argv)
     return {
-        "filters": cmd_filters, "serve": cmd_serve, "worker": cmd_worker,
+        "filters": cmd_filters, "doctor": cmd_doctor,
+        "serve": cmd_serve, "worker": cmd_worker,
         "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
         "camera": cmd_camera,
     }[args.cmd](args)

@@ -221,3 +221,16 @@ def test_serve_video_file_end_to_end(tmp_path, capsys):
     assert rc == 0
     stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert stats["delivered"] == 20
+
+
+def test_doctor_reports_environment(capsys, monkeypatch):
+    monkeypatch.setenv("DVF_FORCE_PLATFORM", "cpu")
+    from dvf_tpu.cli import main
+
+    rc = main(["doctor", "--probe-timeout", "120"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["ring_shim"] == "ok"
+    assert "backend" in out and "compile_cache" in out
+    if rc == 0:  # backend reachable: mesh suggestions present
+        assert out["backend"]["platform"] == "cpu"
+        assert set(out["mesh_suggestions"]) == {"data", "space", "model"}
