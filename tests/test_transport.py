@@ -259,3 +259,62 @@ def test_zmq_ingress_serves_reference_protocol(rng):
         assert pid > 0 and t1 >= t0
     worker.close()
     app.close()
+
+
+def test_zmq_ingress_jpeg_geometry_follows_stream(rng):
+    """JPEG mode stages to the STREAM's geometry and survives the app
+    changing target_size mid-run (JpegGeometryError → re-probe → retry):
+    both sizes come back exact-inverse modulo JPEG loss, with zero
+    contained errors."""
+    pytest.importorskip("zmq")
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.transport.codec import NativeJpegCodec
+    from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+    try:
+        codec = NativeJpegCodec(quality=95)
+    except RuntimeError as e:
+        pytest.skip(f"native jpeg shim unavailable: {e}")
+
+    def smooth(s):
+        y, x = np.mgrid[0:s, 0:s]
+        return np.stack([(x * 3) % 256, (y * 3) % 256, (x + y) % 256], -1).astype(np.uint8)
+
+    frames = [smooth(48)] * 6 + [smooth(24)] * 6
+    blobs = [codec.encode(f) for f in frames]
+    app = MiniApp(blobs)
+    worker = TpuZmqWorker(
+        get_filter("invert"),
+        host="127.0.0.1",
+        distribute_port=app.dist_port,
+        collect_port=app.coll_port,
+        batch_size=4,
+        use_jpeg=True,
+        # assemble quickly so the 48px and 24px runs land in separate
+        # batches (mixed-geometry WITHIN a batch is spec'd to drop)
+        assemble_timeout_s=0.05,
+    )
+    t = threading.Thread(target=worker.run, kwargs={"max_frames": len(frames)},
+                         daemon=True)
+    t.start()
+    app.serve(timeout_s=15.0)
+    worker.stop()
+    t.join(timeout=10)
+    # At-most-once: a batch that straddles the geometry change mixes
+    # sizes and is dropped into containment (one contained error); every
+    # other frame — including the all-new-size batches that exercise the
+    # JpegGeometryError re-probe/re-stage retry — must come back exact.
+    assert len(app.results) >= len(frames) - worker.batch_size
+    assert worker.errors <= 1
+    shapes_seen = set()
+    for i, payload in app.results.items():
+        out = codec.decode(payload)
+        f = frames[i]
+        assert out.shape == f.shape
+        shapes_seen.add(out.shape)
+        err = np.abs(out.astype(int) - (255 - f).astype(int)).mean()
+        assert err < 8, (i, err)  # two JPEG round-trips of loss
+    assert shapes_seen == {(48, 48, 3), (24, 24, 3)}
+    worker.close()
+    app.close()
+    codec.close()
