@@ -225,3 +225,35 @@ def test_sep_conv_impls_agree():
         d = jax.jit(lambda b: sep_conv2d(b, k, k, impl="depthwise"))(x)
         np.testing.assert_allclose(np.asarray(a), np.asarray(d),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_equalize_matches_cv2_on_gray():
+    """Global histogram equalization reproduces cv2.equalizeHist exactly
+    (same cdf-min LUT rounding), per sample in the batch."""
+    rng = np.random.default_rng(3)
+    img = (rng.normal(120, 40, (3, 40, 56)).clip(0, 255)).astype(np.uint8)
+    rgb = np.repeat(img[..., None], 3, -1)
+    f = get_filter("equalize", on_gray=True)
+    out = np.asarray(f.fn(jnp.asarray(rgb), None)[0])
+    for b in range(img.shape[0]):
+        want = cv2.equalizeHist(img[b])
+        np.testing.assert_array_equal(out[b, :, :, 0], want)
+    # Degenerate constant frame: cv2 leaves it unchanged; so do we.
+    const = np.full((1, 8, 8, 3), 77, np.uint8)
+    np.testing.assert_array_equal(np.asarray(f.fn(jnp.asarray(const), None)[0]), const)
+
+
+def test_equalize_per_channel_flattens_histogram():
+    rng = np.random.default_rng(4)
+    # Low-contrast input: values squeezed into [100, 156).
+    x = (rng.integers(100, 156, (2, 32, 32, 3))).astype(np.uint8)
+    f = get_filter("equalize")
+    out = np.asarray(f.fn(jnp.asarray(x), None)[0])
+    assert out.shape == x.shape and out.dtype == np.uint8
+    # Equalization stretches the squeezed range toward full scale.
+    assert out.min() < 20 and out.max() > 235
+    # Monotonic: pixel ordering within a channel is preserved.
+    b, c = 0, 0
+    xv, ov = x[b, :, :, c].ravel(), out[b, :, :, c].ravel()
+    order = np.argsort(xv, kind="stable")
+    assert (np.diff(ov[order]) >= 0).all()
