@@ -33,32 +33,50 @@ from dvf_tpu.ops.registry import register_filter
 from dvf_tpu.utils.image import rgb_to_gray, to_float, to_uint8
 
 
-def _equalize_u8_plane(plane_u8: jnp.ndarray) -> jnp.ndarray:
-    """Equalize one uint8 plane (B, H, W): per-sample 256-bin histogram →
-    cv2.equalizeHist's exact LUT → gather. Vectorized over the batch."""
-    b, h, w = plane_u8.shape
-    flat = plane_u8.reshape(b, h * w)
-    # cdf[b, v] = #pixels <= v, via sort + binary search (see module
-    # docstring for why not a scatter or compare-reduce histogram).
-    srt = jnp.sort(flat.astype(jnp.int32), axis=1)
+def _plane_cdf(flat_i32: jnp.ndarray) -> jnp.ndarray:
+    """(B, P) int32 pixels → (B, 256) float32 cdf: cdf[b, v] = #pixels<=v,
+    via sort + binary search (see module docstring for why not a scatter
+    or compare-reduce histogram). Under spatial sharding this runs on the
+    LOCAL pixels; counts are additive, so one psum makes the global cdf."""
+    srt = jnp.sort(flat_i32, axis=1)
     bins = jnp.arange(256, dtype=jnp.int32)
-    cdf = jax.vmap(
+    return jax.vmap(
         lambda s: jnp.searchsorted(s, bins, side="right")
-    )(srt).astype(jnp.float32)                          # (B, 256)
-    hist = jnp.diff(cdf, axis=1, prepend=0.0)           # (B, 256)
-    # cv2.equalizeHist: lut[v] = round((cdf[v] - cdf_min) / (N - cdf_min) * 255)
-    # where cdf_min is the cdf at the lowest OCCUPIED bin. For a constant
-    # frame (N == cdf_min) cv2 leaves the image unchanged via a guarded
-    # division; jnp.where keeps that branch traceable.
-    n = jnp.asarray(h * w, jnp.float32)
+    )(srt).astype(jnp.float32)
+
+
+def _lut_apply(cdf: jnp.ndarray, flat_i32: jnp.ndarray, n: float) -> jnp.ndarray:
+    """cv2.equalizeHist's exact LUT from a (B, 256) cdf over ``n`` total
+    pixels, gathered back onto (B, P) pixels → uint8."""
+    hist = jnp.diff(cdf, axis=1, prepend=0.0)
+    # lut[v] = round((cdf[v] - cdf_min) / (N - cdf_min) * 255), cdf_min =
+    # cdf at the lowest OCCUPIED bin. For a constant frame (N == cdf_min)
+    # cv2 leaves the image unchanged via a guarded division; jnp.where
+    # keeps that branch traceable.
+    n = jnp.asarray(n, jnp.float32)
     cdf_min = jnp.min(jnp.where(hist > 0, cdf, n + 1.0), axis=1, keepdims=True)
     denom = n - cdf_min
     scale = jnp.where(denom > 0, 255.0 / jnp.maximum(denom, 1.0), 0.0)
     lut = jnp.round((cdf - cdf_min) * scale)
     lut = jnp.where(denom > 0, lut, jnp.arange(256, dtype=jnp.float32)[None])
     lut = jnp.clip(lut, 0.0, 255.0).astype(jnp.uint8)   # (B, 256)
-    # Per-sample gather: out[b, p] = lut[b, flat[b, p]].
-    out = jnp.take_along_axis(lut, flat.astype(jnp.int32), axis=1)
+    return jnp.take_along_axis(lut, flat_i32, axis=1)
+
+
+def _equalize_u8_plane(plane_u8: jnp.ndarray, reduce_cdf=None,
+                       n_total=None) -> jnp.ndarray:
+    """Equalize uint8 planes (B, H, W), vectorized over the batch.
+
+    ``reduce_cdf``/``n_total``: the spatial-sharding hooks — inside a
+    shard_map, ``reduce_cdf`` is ``psum over 'space'`` and ``n_total``
+    the GLOBAL pixel count, so each shard LUTs its rows against the
+    whole-frame statistic."""
+    b, h, w = plane_u8.shape
+    flat = plane_u8.reshape(b, h * w).astype(jnp.int32)
+    cdf = _plane_cdf(flat)
+    if reduce_cdf is not None:
+        cdf = reduce_cdf(cdf)
+    out = _lut_apply(cdf, flat, n_total if n_total is not None else h * w)
     return out.reshape(b, h, w)
 
 
@@ -72,12 +90,13 @@ def equalize(on_gray: bool = False) -> Filter:
     mode.
     """
 
-    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+    def body(batch: jnp.ndarray, reduce_cdf=None, h_total=None) -> jnp.ndarray:
         u8 = batch.dtype == jnp.uint8
         x = to_uint8(batch)
+        nt = None if h_total is None else h_total * x.shape[2]
         if on_gray:
             gray = x if x.shape[-1] == 1 else to_uint8(rgb_to_gray(to_float(x)))
-            eq = _equalize_u8_plane(gray[..., 0])[..., None]
+            eq = _equalize_u8_plane(gray[..., 0], reduce_cdf, nt)[..., None]
             out = jnp.broadcast_to(eq, x.shape)
         else:
             # Channels fold into the batch axis: one traced histogram/LUT
@@ -85,7 +104,53 @@ def equalize(on_gray: bool = False) -> Filter:
             b, h, w, c = x.shape
             planes = jnp.moveaxis(x, -1, 1).reshape(b * c, h, w)
             out = jnp.moveaxis(
-                _equalize_u8_plane(planes).reshape(b, c, h, w), 1, -1)
+                _equalize_u8_plane(planes, reduce_cdf, nt).reshape(b, c, h, w),
+                1, -1)
         return out if u8 else to_float(out, batch.dtype)
 
-    return stateless(f"equalize(gray={on_gray})", fn, uint8_ok=True, halo=None)
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        return body(batch)
+
+    def specialize(mesh, batch_shape):
+        """Spatial sharding the global-reduction way: each shard computes
+        the cdf of its H-slice (counts are additive) and ONE psum over
+        'space' makes the whole-frame statistic — no halo, no gather of
+        pixels, 256 floats of collective traffic per plane."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        d, sp = axes.get("data", 1), axes.get("space", 1)
+        b, h = batch_shape[0], batch_shape[1]
+        if sp <= 1 or h % sp != 0:
+            return None  # engine default: replicate H (correct, just unsharded)
+        # H-sharding only needs h % space == 0; an indivisible batch just
+        # degrades the batch axis (like ops.style / ops.sr do).
+        bspec = "data" if b % d == 0 else None
+        spec = P(bspec, "space", None, None)
+
+        def inner(x_shard):
+            return body(x_shard,
+                        reduce_cdf=lambda cdf: jax.lax.psum(cdf, "space"),
+                        h_total=h)
+
+        def sharded_fn(batch, state):
+            out = jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=spec,
+                out_specs=spec,
+                check_vma=False,
+            )(batch)
+            return out, state
+
+        return Filter(
+            name=f"space(equalize(gray={on_gray}))",
+            fn=sharded_fn,
+            uint8_ok=True,
+            # halo=0: this body OWNS its spatial distribution (the psum);
+            # the engine must keep H GSPMD-sharded and must not route it
+            # through the stencil halo machinery or replicate H.
+            halo=0,
+        )
+
+    return stateless(f"equalize(gray={on_gray})", fn, uint8_ok=True, halo=None,
+                     specialize=specialize)

@@ -285,3 +285,42 @@ def test_pallas_gaussian_filter_registered(batch):
     want, _ = ref.fn(jnp.asarray(batch), None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
     assert f.halo == 4
+
+
+def test_equalize_space_sharded_matches_replicated():
+    """The global-reduction parallel pattern: per-shard partial cdf + one
+    psum over 'space' must equal the single-device whole-frame result
+    EXACTLY (counts are additive integers; the LUT sees identical cdfs)."""
+    from dvf_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dvf_tpu.runtime.engine import Engine
+
+    x = np.random.default_rng(5).integers(0, 255, (4, 64, 48, 3), np.uint8)
+    mesh = make_mesh(MeshConfig(data=2, space=4))
+    eng = Engine(get_filter("equalize"), mesh=mesh)
+    eng.compile(x.shape, np.uint8)
+    assert eng._exec_filter.name.startswith("space("), eng._exec_filter.name
+    got = np.asarray(eng.submit(x))
+    want = np.asarray(
+        Engine(get_filter("equalize"), mesh=make_mesh(MeshConfig())).submit(x))
+    np.testing.assert_array_equal(got, want)
+
+    # Indivisible H falls back to the replicated path, still exact.
+    x2 = np.random.default_rng(6).integers(0, 255, (4, 62, 48, 3), np.uint8)
+    eng2 = Engine(get_filter("equalize"), mesh=mesh)
+    eng2.compile(x2.shape, np.uint8)
+    assert not eng2._exec_filter.name.startswith("space(")
+    got2 = np.asarray(eng2.submit(x2))
+    want2 = np.asarray(
+        Engine(get_filter("equalize"), mesh=make_mesh(MeshConfig())).submit(x2))
+    np.testing.assert_array_equal(got2, want2)
+
+    # Indivisible BATCH keeps the space sharding (only the batch axis
+    # degrades — the psum scheme needs just H % space == 0).
+    x3 = np.random.default_rng(7).integers(0, 255, (3, 64, 48, 3), np.uint8)
+    eng3 = Engine(get_filter("equalize"), mesh=mesh)
+    eng3.compile(x3.shape, np.uint8)
+    assert eng3._exec_filter.name.startswith("space(")
+    got3 = np.asarray(eng3.submit(x3))
+    want3 = np.asarray(
+        Engine(get_filter("equalize"), mesh=make_mesh(MeshConfig())).submit(x3))
+    np.testing.assert_array_equal(got3, want3)
