@@ -696,13 +696,22 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="dvf_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
+    # Shared by the device-touching subcommands: --platform cpu|tpu is
+    # the flag form of DVF_FORCE_PLATFORM (the escape hatch when the
+    # pinned accelerator is unreachable — see `doctor`).
+    plat = argparse.ArgumentParser(add_help=False)
+    plat.add_argument("--platform", default=None, metavar="NAME",
+                      help="force the jax platform (e.g. cpu); equivalent "
+                           "to DVF_FORCE_PLATFORM=NAME")
+
     sub.add_parser("filters", help="list registered filters")
 
-    dp_ = sub.add_parser("doctor", help="environment diagnostics (bounded backend probe)")
+    dp_ = sub.add_parser("doctor", parents=[plat],
+                         help="environment diagnostics (bounded backend probe)")
     dp_.add_argument("--probe-timeout", type=float, default=60.0,
                      help="seconds before declaring the backend unreachable")
 
-    sp = sub.add_parser("serve", help="run the pipeline")
+    sp = sub.add_parser("serve", parents=[plat], help="run the pipeline")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
     sp.add_argument("--source", default="synthetic",
@@ -752,7 +761,7 @@ def main(argv=None) -> int:
                          "staging buffer — the reference's use_jpeg path)")
 
     cp = sub.add_parser(
-        "camera",
+        "camera",  # host-only (no jax): the --platform flag would be a no-op
         help="push frames into a shared-memory ring for a serve process")
     cp.add_argument("--shm", required=True, help="shm ring name")
     cp.add_argument("--source", default="synthetic",
@@ -770,7 +779,7 @@ def main(argv=None) -> int:
                          "consumer to attach and drain before unlinking "
                          "the shm ring (serve cold-start can take ~10 s)")
 
-    wp = sub.add_parser("worker", help="ZMQ worker for the reference app")
+    wp = sub.add_parser("worker", parents=[plat], help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")
     wp.add_argument("--filter-config", default=None)
     wp.add_argument("--host", default="localhost")
@@ -785,7 +794,7 @@ def main(argv=None) -> int:
     wp.add_argument("--mesh", default=None,
                     help="device mesh, same forms as serve --mesh")
 
-    tp = sub.add_parser("train", help="train the style net (checkpoint/resume)")
+    tp = sub.add_parser("train", parents=[plat], help="train the style net (checkpoint/resume)")
     tp.add_argument("--steps", type=int, default=50)
     tp.add_argument("--batch", type=int, default=4)
     tp.add_argument("--size", type=int, default=64, help="square frame size")
@@ -805,7 +814,7 @@ def main(argv=None) -> int:
                     help="override StyleTrainConfig.style_weight")
 
     tsp = sub.add_parser(
-        "train-sr",
+        "train-sr", parents=[plat],
         help="train the super-resolution net (self-supervised, "
              "checkpoint/resume)")
     tsp.add_argument("--steps", type=int, default=50)
@@ -820,7 +829,7 @@ def main(argv=None) -> int:
     tsp.add_argument("--checkpoint-every", type=int, default=25)
     tsp.add_argument("--resume", default=None, help="checkpoint dir to resume from")
 
-    bp = sub.add_parser("bench", help="run a benchmark config")
+    bp = sub.add_parser("bench", parents=[plat], help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")
     bp.add_argument("--iters", type=int, default=200)
     bp.add_argument("--frames", type=int, default=512, help="--e2e mode")
@@ -840,12 +849,26 @@ def main(argv=None) -> int:
                          "codec-on-the-hot-path cost)")
 
     args = ap.parse_args(argv)
-    return {
-        "filters": cmd_filters, "doctor": cmd_doctor,
-        "serve": cmd_serve, "worker": cmd_worker,
-        "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
-        "camera": cmd_camera,
-    }[args.cmd](args)
+    prior = os.environ.get("DVF_FORCE_PLATFORM")
+    if getattr(args, "platform", None):
+        # Flag form of DVF_FORCE_PLATFORM: _force_platform (and every
+        # probe subprocess inheriting the env) picks it up. Restored
+        # after dispatch so in-process callers (tests, embeddings) don't
+        # leak the forced platform into later invocations.
+        os.environ["DVF_FORCE_PLATFORM"] = args.platform
+    try:
+        return {
+            "filters": cmd_filters, "doctor": cmd_doctor,
+            "serve": cmd_serve, "worker": cmd_worker,
+            "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
+            "camera": cmd_camera,
+        }[args.cmd](args)
+    finally:
+        if getattr(args, "platform", None):
+            if prior is None:
+                os.environ.pop("DVF_FORCE_PLATFORM", None)
+            else:
+                os.environ["DVF_FORCE_PLATFORM"] = prior
 
 
 if __name__ == "__main__":

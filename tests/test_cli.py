@@ -234,3 +234,28 @@ def test_doctor_reports_environment(capsys, monkeypatch):
     if rc == 0:  # backend reachable: mesh suggestions present
         assert out["backend"]["platform"] == "cpu"
         assert set(out["mesh_suggestions"]) == {"data", "space", "model"}
+
+
+def test_platform_flag_forces_backend(capsys, monkeypatch):
+    """--platform cpu == DVF_FORCE_PLATFORM=cpu, on any subcommand."""
+    monkeypatch.delenv("DVF_FORCE_PLATFORM", raising=False)
+    from dvf_tpu.cli import main
+
+    calls = {}
+    import dvf_tpu.cli as cli
+    real = cli.cmd_doctor
+
+    def spy(args):
+        import os
+        calls["env"] = os.environ.get("DVF_FORCE_PLATFORM")
+        return real(args)
+
+    monkeypatch.setattr(cli, "cmd_doctor", spy)  # dispatch uses the module dict
+    rc = main(["doctor", "--platform", "cpu", "--probe-timeout", "120"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["backend"]["platform"] == "cpu"
+    # The bridge actually fired (on a CPU-only host the platform assert
+    # alone would pass vacuously) and didn't leak past main().
+    assert calls["env"] == "cpu"
+    import os
+    assert os.environ.get("DVF_FORCE_PLATFORM") is None
