@@ -198,11 +198,16 @@ def cmd_doctor(args) -> int:
     return 0 if "error" not in report["backend"] else 1
 
 
-def cmd_filters(_args) -> int:
+def cmd_filters(args) -> int:
     from dvf_tpu.ops import list_filters
+    from dvf_tpu.ops.registry import _REGISTRY
 
     for name in list_filters():
-        print(name)
+        if getattr(args, "verbose", False):
+            doc = (_REGISTRY[name].__doc__ or "").strip().splitlines()
+            print(f"{name:24s} {doc[0] if doc else ''}")
+        else:
+            print(name)
     return 0
 
 
@@ -704,7 +709,9 @@ def main(argv=None) -> int:
                       help="force the jax platform (e.g. cpu); equivalent "
                            "to DVF_FORCE_PLATFORM=NAME")
 
-    sub.add_parser("filters", help="list registered filters")
+    fp = sub.add_parser("filters", help="list registered filters")
+    fp.add_argument("-v", "--verbose", action="store_true",
+                    help="include each filter's one-line description")
 
     dp_ = sub.add_parser("doctor", parents=[plat],
                          help="environment diagnostics (bounded backend probe)")
