@@ -57,6 +57,7 @@ def bilateral_nhwc(
 
 @register_filter("bilateral")
 def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0) -> Filter:
+    """Edge-preserving bilateral smoothing (cv2.bilateralFilter semantics)."""
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return bilateral_nhwc(batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space)
 

@@ -18,6 +18,7 @@ def sobel_bilateral(
     d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0,
     magnitude_scale: float = 1.0,
 ) -> Filter:
+    """BASELINE configs[2]: Sobel edges then bilateral, fused into one program."""
     return FilterChain(
         get_filter("sobel", magnitude_scale=magnitude_scale),
         get_filter("bilateral", d=d, sigma_color=sigma_color, sigma_space=sigma_space),

@@ -121,6 +121,7 @@ def sep_conv2d(
 
 @register_filter("gaussian_blur")
 def gaussian_blur(ksize: int = 9, sigma: float = 0.0, impl: str = "shift") -> Filter:
+    """Separable Gaussian blur matching cv2.GaussianBlur taps."""
     kern = gaussian_kernel_1d(ksize, sigma)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
@@ -131,6 +132,7 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0, impl: str = "shift") -> Fi
 
 @register_filter("box_blur")
 def box_blur(ksize: int = 3, impl: str = "shift") -> Filter:
+    """Separable box (mean) blur."""
     kern = np.full((ksize,), 1.0 / ksize, dtype=np.float32)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:

@@ -19,6 +19,7 @@ from dvf_tpu.utils.image import rgb_to_gray
 
 @register_filter("invert")
 def invert() -> Filter:
+    """Color invert - the reference's one op (cv2.bitwise_not, inverter.py:41)."""
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         if batch.dtype == jnp.uint8:
             # uint8 arithmetic wraps, so 255 - x is exactly bitwise_not.
@@ -37,6 +38,7 @@ def identity() -> Filter:
 
 @register_filter("grayscale")
 def grayscale() -> Filter:
+    """Rec.601 luma, broadcast back to 3 channels."""
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         gray = rgb_to_gray(batch, keepdims=True)
         return jnp.broadcast_to(gray, batch.shape)
@@ -56,6 +58,7 @@ def brightness_contrast(alpha: float = 1.0, beta: float = 0.0) -> Filter:
 
 @register_filter("gamma")
 def gamma(g: float = 2.2) -> Filter:
+    """Gamma correction: out = x ** (1/g)."""
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return jnp.power(jnp.clip(batch, 0.0, 1.0), g)
 
@@ -64,6 +67,7 @@ def gamma(g: float = 2.2) -> Filter:
 
 @register_filter("threshold")
 def threshold(t: float = 0.5) -> Filter:
+    """Binary threshold on luma at t."""
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return jnp.where(batch > t, 1.0, 0.0).astype(batch.dtype)
 
@@ -72,6 +76,7 @@ def threshold(t: float = 0.5) -> Filter:
 
 @register_filter("sepia")
 def sepia() -> Filter:
+    """Classic sepia tone matrix."""
     # Classic sepia matrix, rows = output RGB.
     m = jnp.array(
         [[0.393, 0.769, 0.189],
