@@ -1,0 +1,125 @@
+"""Host JPEG codec microbench — SURVEY.md §7 hard part 3 quantified.
+
+The reference pays TurboJPEG encode+decode per frame on both endpoints
+(webcam_app.py:110,140; inverter.py:32,44); at TPU frame rates the host
+codec, not the device, becomes the wall. This table measures both shims
+(native jpeg_shim.cpp vs the cv2 fallback) across geometries and thread
+counts, so the codec_threads knob and the native/cv2 choice are sized
+from data. No jax import — pure host work.
+
+Usage: python benchmarks/codec_bench.py [--out-dir benchmarks] [--reps 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+GEOMETRIES = [("512sq", 512, 512), ("720p", 720, 1280), ("1080p", 1080, 1920)]
+THREADS = (1, 4, 8)
+
+
+def _frame(h: int, w: int) -> np.ndarray:
+    y, x = np.mgrid[0:h, 0:w]
+    return np.stack([(x * 3) % 256, (y * 3) % 256, (x + y) % 256], -1).astype(np.uint8)
+
+
+def bench_codec(codec, frames, reps: int) -> dict:
+    blobs = codec.encode_batch(frames)
+    staging = np.empty((len(frames),) + frames[0].shape, np.uint8)
+    # warmup
+    codec.decode_batch(blobs, out=staging)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        codec.encode_batch(frames)
+    enc_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        codec.decode_batch(blobs, out=staging)
+    dec_s = time.perf_counter() - t0
+    n = reps * len(frames)
+    return {
+        "encode_fps": round(n / enc_s, 1),
+        "decode_fps": round(n / dec_s, 1),
+        "jpeg_kb": round(len(blobs[0]) / 1024, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default=os.path.join(REPO, "benchmarks"))
+    ap.add_argument("--reps", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    from dvf_tpu.transport.codec import JpegCodec, NativeJpegCodec
+
+    impls = {"cv2": JpegCodec}
+    try:
+        NativeJpegCodec()
+        impls["native"] = NativeJpegCodec
+    except RuntimeError as e:
+        print(f"[codec-bench] native shim unavailable: {e}", file=sys.stderr)
+
+    results = {}
+    for gname, h, w in GEOMETRIES:
+        frames = [_frame(h, w)] * args.batch
+        for iname, cls in impls.items():
+            for threads in THREADS:
+                codec = cls(quality=90, threads=threads)
+                try:
+                    reps = max(4, args.reps * 512 * 512 // (h * w))
+                    r = bench_codec(codec, frames, reps)
+                finally:
+                    codec.close()
+                results[f"{gname}/{iname}/t{threads}"] = r
+                print(f"[codec-bench] {gname} {iname} t{threads}: {r}",
+                      file=sys.stderr, flush=True)
+
+    doc = {
+        "generated_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "batch": args.batch,
+        "host_cpus": os.cpu_count(),
+        "results": results,
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    jpath = os.path.join(args.out_dir, "CODEC_BENCH.json")
+    with open(jpath, "w") as f:
+        json.dump(doc, f, indent=2)
+
+    lines = [
+        "# Host JPEG codec microbench (SURVEY §7 hard part 3)",
+        "",
+        f"Generated {doc['generated_utc']} · batch {args.batch} · quality 90 · "
+        f"host CPUs: {doc['host_cpus']} · "
+        "fps = frames/sec through encode_batch / decode_batch "
+        "(decode lands in a preallocated staging array). NB: on a 1-CPU "
+        "host the threads column is necessarily flat — the codec_threads "
+        "knob needs real cores to bite (both shims release the GIL "
+        "inside libjpeg).",
+        "",
+        "| geometry | impl | threads | encode fps | decode fps | jpeg KB |",
+        "|---|---|---|---|---|---|",
+    ]
+    for key, r in results.items():
+        g, i, t = key.split("/")
+        lines.append(f"| {g} | {i} | {t[1:]} | {r['encode_fps']} | "
+                     f"{r['decode_fps']} | {r['jpeg_kb']} |")
+    mpath = os.path.join(args.out_dir, "CODEC_BENCH.md")
+    with open(mpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps({"written": [jpath, mpath]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
