@@ -124,3 +124,65 @@ def test_reference_distributor_drives_tpu_worker(rng, transport):
     # The worker really batched (not one frame per roundtrip like the
     # reference's own workers).
     assert worker.batches < worker.frames_processed
+
+
+@pytest.mark.skipif(not os.path.exists(REF), reason="reference not present")
+def test_reference_distributor_drives_tpu_worker_jpeg(rng):
+    """The reference app's DEFAULT wire (use_jpeg=True, webcam_app.py:203
+    footgun: JPEG effectively always on) against our JPEG-mode worker:
+    the reference's own Distributor fans out JPEG frames, the worker
+    decodes through the native C shim, inverts on device, re-encodes,
+    and the display path serves bytes that decode to the inverse."""
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.transport.codec import NativeJpegCodec
+    from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+    try:
+        codec = NativeJpegCodec(quality=95)
+    except RuntimeError as e:
+        pytest.skip(f"native jpeg shim unavailable: {e}")
+
+    Distributor = _load_reference_distributor()
+    p_dist, p_coll = _free_port(), _free_port()
+    dist = Distributor(distribute_port=p_dist, collect_port=p_coll, frame_delay=0)
+    dist.start()
+
+    worker = TpuZmqWorker(
+        get_filter("invert"),
+        host="127.0.0.1",
+        distribute_port=p_dist,
+        collect_port=p_coll,
+        batch_size=4,
+        assemble_timeout_s=0.06,
+        use_jpeg=True,
+    )
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+
+    n = 24
+    # Smooth frames: JPEG loss stays small enough to assert the inverse.
+    y, x = np.mgrid[0:32, 0:32]
+    frames = {}
+    got = {}
+    try:
+        for i in range(n):
+            f = np.stack([(x * 3 + i) % 256, (y * 3) % 256, (x + y) % 256],
+                         -1).astype(np.uint8)
+            frames[i] = f
+            dist.add_frame_for_distribution(codec.encode(f), time.time())
+            time.sleep(0.015)
+        deadline = time.time() + 15
+        while time.time() < deadline and dist.latest_received_frame < n - 1:
+            time.sleep(0.01)
+        for idx, entry in list(dist.received_frames.items()):
+            got[idx] = codec.decode(entry["frame_data"])
+    finally:
+        worker.stop()
+        wt.join(timeout=5)
+        worker.close()
+        dist.cleanup()
+
+    assert len(got) >= n // 2, f"only {len(got)}/{n} frames came back"
+    for idx, out in got.items():
+        err = np.abs(out.astype(int) - (255 - frames[idx]).astype(int)).mean()
+        assert err < 8, (idx, err)  # two JPEG round-trips of loss
