@@ -591,27 +591,45 @@ def cmd_train(args) -> int:
 def _run_train_loop(args, mesh, state, step_fn, batch_sharding, frames,
                     save_checkpoint, log_line, final_json):
     """The training driver both families share: stack-a-batch → sharded
-    step → periodic log → periodic checkpoint → final checkpoint + JSON.
-    Family-specific pieces come in as functions (``log_line(metrics)``,
-    ``final_json(metrics)``); resume/state/step_fn setup stays with the
-    caller, which knows its own restore machinery."""
+    step → periodic log → periodic ASYNC checkpoint → final checkpoint +
+    JSON. Mid-run checkpoints dispatch through train.checkpoint.AsyncSaver
+    so the device keeps stepping while orbax writes; the final save uses
+    the blocking ``save_checkpoint`` (the run must not exit before its
+    terminal state is durable). Family-specific pieces come in as
+    functions (``log_line(metrics)``, ``final_json(metrics)``);
+    resume/state/step_fn setup stays with the caller, which knows its own
+    restore machinery."""
     import jax
     import numpy as np
 
+    from dvf_tpu.train.checkpoint import AsyncSaver
+
+    saver = AsyncSaver() if args.checkpoint_dir else None
     start = int(state.step)
     metrics = {}
-    for i in range(start, args.steps):
-        batch_np = np.stack([
-            next(frames)[0] for _ in range(args.batch)
-        ]).astype(np.float32) / 255.0
-        batch = jax.device_put(batch_np, batch_sharding)
-        state, metrics = step_fn(state, batch)
-        if (i + 1) % args.log_every == 0:
-            print(f"step {i + 1}: {log_line(metrics)}", file=sys.stderr)
-        if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
-            path = os.path.join(args.checkpoint_dir, f"step_{i + 1:06d}")
-            save_checkpoint(path, state)
-            print(f"checkpointed {path}", file=sys.stderr)
+    try:
+        for i in range(start, args.steps):
+            batch_np = np.stack([
+                next(frames)[0] for _ in range(args.batch)
+            ]).astype(np.float32) / 255.0
+            batch = jax.device_put(batch_np, batch_sharding)
+            state, metrics = step_fn(state, batch)
+            if (i + 1) % args.log_every == 0:
+                print(f"step {i + 1}: {log_line(metrics)}", file=sys.stderr)
+            if saver is not None and (i + 1) % args.checkpoint_every == 0:
+                path = os.path.join(args.checkpoint_dir, f"step_{i + 1:06d}")
+                saver.save(path, state)
+                print(f"checkpointed {path} (async)", file=sys.stderr)
+    finally:
+        if saver is not None:
+            try:
+                saver.close()  # drain the in-flight write before final save
+            except Exception as e:  # noqa: BLE001 — a failed background
+                # write must not mask the training exception propagating
+                # through this finally (the blocking final save below
+                # still surfaces a genuinely broken disk on the happy path).
+                print(f"[train] async checkpoint drain failed: {e!r}",
+                      file=sys.stderr)
     if args.checkpoint_dir:
         path = os.path.join(args.checkpoint_dir, "final")
         save_checkpoint(path, state)

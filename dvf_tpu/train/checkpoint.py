@@ -62,7 +62,14 @@ def _resolve_checkpoint_dir(ckpt_dir: str, family: str, train_cmd: str) -> str:
         raise FileNotFoundError(f"{family} checkpoint dir {ckpt_dir!r} does not exist")
     final = os.path.join(ckpt_dir, "final")
     if not os.path.isdir(final):
-        steps = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
+        import re
+
+        # step_NNNNNN only: orbax stages async writes in
+        # 'step_*.orbax-checkpoint-tmp' dirs that sort AFTER every
+        # committed step — a run killed mid-(async)-write must fall back
+        # to the newest COMMITTED checkpoint, never the torn tmp dir.
+        steps = sorted(d for d in os.listdir(ckpt_dir)
+                       if re.fullmatch(r"step_\d+", d))
         if not steps:
             raise FileNotFoundError(
                 f"{ckpt_dir!r} has no 'final' or step_* checkpoint — pass "
@@ -174,3 +181,33 @@ def restore_sr_checkpoint(path: str, template, mesh=None, config=None):
     if mesh is not None:
         state = shard_sr(state, mesh, config or SrTrainConfig())
     return state
+
+
+class AsyncSaver:
+    """Non-blocking checkpoint writes for training loops (TPU-idiomatic:
+    the device keeps stepping while orbax serializes to disk in the
+    background).
+
+    One in-flight save at a time: ``save()`` first waits for the previous
+    write (usually already finished — checkpoint cadence >> write time),
+    snapshots the state to host, and returns as soon as the async write
+    is dispatched. ``close()`` drains the last write; without it a
+    killed-right-after-save run could leave a torn final checkpoint (the
+    step_* cadence means at most one checkpoint interval is lost either
+    way — same at-most-once gap as the reference's dropped frames).
+    """
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, path: str, state) -> str:
+        path = os.path.abspath(path)
+        self._ckptr.wait_until_finished()
+        self._ckptr.save(path, jax.device_get(state), force=True)
+        return path
+
+    def close(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()

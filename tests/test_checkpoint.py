@@ -120,3 +120,41 @@ def test_cli_train_sr_checkpoint_resume(tmp_path, capsys):
     state = filt.init_state((1, 32, 32, 3), jnp.float32)
     y, _ = filt.fn(jnp.full((1, 32, 32, 3), 0.5), state)
     assert y.shape == (1, 64, 64, 3)
+
+
+def test_async_saver_roundtrip(tmp_path):
+    """AsyncSaver's dispatched write is durable and restorable after
+    close() — the mid-run checkpoint path of _run_train_loop."""
+    import jax
+
+    from dvf_tpu.train.checkpoint import AsyncSaver, load_params
+    from dvf_tpu.train.sr import SrTrainConfig, init_train_state
+
+    state = init_train_state(jax.random.PRNGKey(0), SrTrainConfig())
+    saver = AsyncSaver()
+    p1 = str(tmp_path / "step_000001")
+    p2 = str(tmp_path / "step_000002")
+    saver.save(p1, state)
+    saver.save(p2, state)  # waits for p1 first: one in-flight write max
+    saver.close()
+    for p in (p1, p2):
+        params = load_params(p)
+        np.testing.assert_array_equal(
+            np.asarray(params["feat"]["w"]), np.asarray(state.params["feat"]["w"]))
+
+
+def test_resume_fallback_ignores_orbax_tmp_dirs(tmp_path):
+    """A torn async write (step_*.orbax-checkpoint-tmp) must never be
+    picked as the newest step checkpoint."""
+    import jax
+
+    from dvf_tpu.train.checkpoint import (
+        _resolve_checkpoint_dir, save_checkpoint)
+    from dvf_tpu.train.sr import SrTrainConfig, init_train_state
+
+    state = init_train_state(jax.random.PRNGKey(0), SrTrainConfig())
+    good = tmp_path / "step_000002"
+    save_checkpoint(str(good), state)
+    (tmp_path / "step_000009.orbax-checkpoint-tmp").mkdir()  # torn write
+    picked = _resolve_checkpoint_dir(str(tmp_path), "sr", "train-sr")
+    assert picked == str(good)
