@@ -92,3 +92,16 @@ def test_structured_texture_deterministic_and_distinct():
     assert not np.array_equal(fa[0], noise)
     with pytest.raises(ValueError, match="texture"):
         SyntheticSource(height=8, width=8, texture="fractal")
+
+
+def test_cli_eval_reproduces_demo_claim(capsys):
+    """`train-sr --steps 0 --resume <committed> --eval` is the auditable
+    form of the README's '+4.6 dB over nearest' number."""
+    from dvf_tpu.cli import main
+
+    rc = main(["train-sr", "--steps", "0", "--batch", "2", "--size", "32",
+               "--resume", os.path.join(CKPT, "final"), "--eval",
+               "--log-every", "100"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["held_out"]["delta_db"] > 2.5
