@@ -24,7 +24,7 @@ TPU-first:
   (:mod:`dvf_tpu.parallel`).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from dvf_tpu.api.filter import Filter, FilterChain  # noqa: F401
 from dvf_tpu.ops import get_filter, list_filters, register_filter  # noqa: F401
