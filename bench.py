@@ -20,16 +20,25 @@ e2e fps at a few fps regardless of the framework (a real v5e PCIe link is
 ~3 orders of magnitude faster); ``roofline_frac`` says how close the
 pipeline gets to that ceiling, which is the framework-attributable part.
 
-Reliability design (round 1-2 post-mortems: backend init hung or was
-SIGKILLed in both rounds; the old probe+child structure paid init twice
-and starved the real bench):
+Reliability design (rounds 1-3 post-mortems: backend init hung or was
+SIGKILLed in rounds 1-2; round 3's driver run burned its whole budget on
+one child against a dead tunnel and fell back to CPU even though healthy
+windows existed during the round):
 
 - This parent process NEVER imports jax. ALL device work — init included —
-  runs in ONE child (``dvf_tpu/bench_child.py``) bounded by the full
-  ``--bench-timeout`` budget, heartbeat-logging init/compile progress to
-  stderr so a timeout post-mortem shows how far it got.
+  runs in bounded children (``dvf_tpu/bench_child.py``).
+- **Probe first** (VERDICT r3 item 3): a cheap ``--mode probe`` child
+  (bounded ~75 s; healthy init is <5 s) gates the expensive bench child.
+  On a dead tunnel the probe is retried a few times across the budget —
+  the tunnel's health flips on minutes-scale — and only then does the
+  bench fall back, fast, instead of hanging 420 s.
 - ``JAX_COMPILATION_CACHE_DIR`` is set so any rerun (or fallback after a
   partial run) skips compiles.
+- A successful real-TPU run is **persisted** to
+  ``benchmarks/TPU_BENCH_R4.json`` (timestamped) so the best on-chip
+  capture of the round survives even if the round-end driver run lands in
+  a dead window; the CPU fallback JSON embeds the freshest on-file TPU
+  result so a fallback line is never mistaken for "no TPU number exists".
 - If the TPU child fails or times out, the bench degrades LOUDLY: it
   reruns on CPU with a scaled-down workload and emits the JSON line with
   ``"fallback": true`` and the real TPU error in ``"error"``.
@@ -37,7 +46,7 @@ and starved the real bench):
   whenever a measurement (even the CPU fallback) was obtained.
 
 Usage: python bench.py [--iters K] [--batch B] [--frames N] [--cpu]
-                       [--bench-timeout S] [--e2e]
+                       [--bench-timeout S] [--e2e] [--probe-retries N]
 """
 
 from __future__ import annotations
@@ -48,7 +57,13 @@ import os
 import sys
 import time
 
-from benchtools import JAX_CACHE_DIR, last_json_line, run_cmd as _run, tail as _tail
+from benchtools import (
+    JAX_CACHE_DIR,
+    last_json_line,
+    probe_backend,
+    run_cmd as _run,
+    tail as _tail,
+)
 
 
 def _log(msg: str) -> None:
@@ -68,6 +83,54 @@ def run_bench_child(child_args, env, timeout):
     return None, f"child rc={rc}; stderr tail:\n{_tail(err)}"
 
 
+def probe_tpu(env, timeout, retries, retry_wait):
+    """Bounded pre-flight: is the TPU reachable right now?
+
+    Returns (True, probe_dict) when a probe child initializes a tpu
+    backend and executes a tiny computation; (False, last_error) after
+    exhausting retries. ``retries < 1`` means "skip the probe, go
+    straight to the bench" — never a silent CPU fallback on a healthy
+    chip. A probe that comes up on a non-tpu backend is not retried — a
+    missing plugin won't heal on a timescale retries cover.
+    """
+    if retries < 1:
+        _log("probe skipped (--probe-retries < 1); proceeding to the bench")
+        return True, {"skipped": True}
+    last_err = None
+    for attempt in range(1, retries + 1):
+        _log(f"probe attempt {attempt}/{retries} (timeout {timeout:.0f}s)")
+        probe = probe_backend(env, timeout)
+        if probe is not None and probe.get("backend") == "tpu":
+            _log(f"probe healthy: {probe}")
+            return True, probe
+        if probe is not None:
+            last_err = f"probe backend={probe.get('backend')!r}, not tpu"
+            _log(last_err)
+            break
+        last_err = "probe failed (no output — init hung or crashed)"
+        _log(last_err)
+        if attempt < retries:
+            time.sleep(retry_wait)
+    return False, last_err
+
+
+def freshest_tpu_result_on_file(bench_dir):
+    """Newest benchmarks/TPU_BENCH_R*.json by captured_utc (path, doc)."""
+    import glob
+
+    best = None
+    for path in glob.glob(os.path.join(bench_dir, "TPU_BENCH_R*.json")):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        stamp = doc.get("captured_utc") or ""
+        if best is None or stamp > best[2]:
+            best = (path, doc, stamp)
+    return (best[0], best[1]) if best else (None, None)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--iters", type=int, default=300, help="device-resident chain length")
@@ -81,6 +144,9 @@ def main(argv=None) -> int:
                     help="(compat) e2e-only mode; default now reports both")
     ap.add_argument("--cpu", action="store_true", help="run on CPU directly")
     ap.add_argument("--bench-timeout", type=float, default=420.0)
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--probe-retries", type=int, default=3)
+    ap.add_argument("--probe-retry-wait", type=float, default=30.0)
     args = ap.parse_args(argv)
 
     mode = "e2e" if args.e2e else "headline"
@@ -92,26 +158,35 @@ def main(argv=None) -> int:
 
     result = None
     if not args.cpu:
-        child_args = [
-            "--mode", mode,
-            "--iters", str(args.iters), "--batch", str(args.batch),
-            "--height", str(args.height), "--width", str(args.width),
-            "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
-            "--lat-batch", str(args.lat_batch),
-        ]
-        _log(f"running bench (init + measure in one child, "
-             f"timeout {args.bench_timeout:.0f}s)")
-        result, bench_err = run_bench_child(child_args, env, args.bench_timeout)
-        if result is None:
-            error = f"TPU bench failed: {bench_err}"
-            _log(error)
-        elif result.get("backend") != "tpu":
-            # jax initialized but landed on CPU (no TPU plugin / plugin
-            # failed to claim the chip). The numbers are real but must be
-            # labeled as the fallback they are.
-            error = (f"backend came up as {result.get('backend')!r}, not tpu")
-            fallback = True
-            _log(error)
+        healthy, probe_info = probe_tpu(env, args.probe_timeout,
+                                        args.probe_retries,
+                                        args.probe_retry_wait)
+        if not healthy:
+            error = f"TPU probe failed: {probe_info}"
+            _log(error + " — skipping straight to CPU fallback")
+        else:
+            child_args = [
+                "--mode", mode,
+                "--iters", str(args.iters), "--batch", str(args.batch),
+                "--height", str(args.height), "--width", str(args.width),
+                "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
+                "--lat-batch", str(args.lat_batch),
+            ]
+            _log(f"probe healthy → running bench (timeout "
+                 f"{args.bench_timeout:.0f}s)")
+            result, bench_err = run_bench_child(child_args, env,
+                                                args.bench_timeout)
+            if result is None:
+                error = f"TPU bench failed: {bench_err}"
+                _log(error)
+            elif result.get("backend") != "tpu":
+                # jax initialized but landed on CPU (no TPU plugin / plugin
+                # failed to claim the chip). The numbers are real but must
+                # be labeled as the fallback they are.
+                error = (f"backend came up as {result.get('backend')!r}, "
+                         f"not tpu")
+                fallback = True
+                _log(error)
     else:
         error = "cpu requested via --cpu"
 
@@ -151,6 +226,8 @@ def main(argv=None) -> int:
         "vs_baseline": round(headline / 2000.0, 3) if headline else None,
         "p50_latency_ms": result.get("p50_ms"),
         "p99_latency_ms": result.get("p99_ms"),
+        "compute_p50_ms": result.get("compute_p50_ms"),
+        "stage_decomp_ms": result.get("stage_decomp_ms"),
         "lat_target_fps": result.get("lat_target_fps"),
         "lat_batch": result.get("lat_batch"),
         "e2e_fps": result.get("e2e_fps"),
@@ -159,6 +236,9 @@ def main(argv=None) -> int:
         "d2h_mbps": result.get("d2h_mbps"),
         "link_roofline_fps": result.get("link_roofline_fps"),
         "roofline_frac": result.get("roofline_frac"),
+        "hbm_roofline_fps": result.get("hbm_roofline_fps"),
+        "hbm_roofline_frac": result.get("hbm_roofline_frac"),
+        "mfu": result.get("mfu"),
         "backend": result.get("backend"),
         "n_devices": result.get("n_devices"),
         "batch": result.get("batch"),
@@ -166,24 +246,61 @@ def main(argv=None) -> int:
         "fallback": fallback,
         "error": error,
     }
-    tpu_doc = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "benchmarks", "TPU_BENCH_R3.json")
-    if fallback and os.path.exists(tpu_doc):
-        # A real-chip measurement exists from an earlier healthy tunnel
-        # window; embed its identity (metric/value/when) so a CPU-fallback
-        # round-end run is never mistaken for "no TPU number exists" — and
-        # so a STALE on-file number is visibly stamped, not silently cited.
-        try:
-            with open(tpu_doc) as f:
-                doc = json.load(f)
+    bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks")
+    if not fallback and out.get("backend") == "tpu" and headline:
+        # Persist the real-chip capture: the round's best on-chip evidence
+        # must survive the round-end run landing in a dead tunnel window.
+        import datetime
+
+        capture = {
+            "captured_utc": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(),
+            "result": out,
+            "device_frames": result.get("device_frames", 0),
+            "argv": sys.argv[1:],
+        }
+        path = os.path.join(bench_dir, "TPU_BENCH_R4.json")
+        existing_frames = -1
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    existing_frames = json.load(f).get("device_frames", 0)
+            except Exception:
+                existing_frames = -1  # corrupt → replace
+        if capture["device_frames"] < existing_frames:
+            # A quick smoke run (--iters 3) must not clobber the round's
+            # full-workload capture; the bigger measurement stays.
+            _log(f"not persisting: existing capture measured "
+                 f"{existing_frames} frames > this run's "
+                 f"{capture['device_frames']}")
+        else:
+            try:
+                os.makedirs(bench_dir, exist_ok=True)
+                tmp = path + ".tmp"
+                # Atomic replace: a SIGKILL mid-write (this environment's
+                # documented failure mode) must not corrupt the previous
+                # good capture.
+                with open(tmp, "w") as f:
+                    json.dump(capture, f, indent=2)
+                os.replace(tmp, path)
+                _log(f"TPU capture persisted to {path}")
+            except OSError as e:
+                _log(f"could not persist TPU capture: {e!r}")
+    if fallback:
+        # A real-chip measurement may exist from an earlier healthy tunnel
+        # window; embed the freshest one's identity (metric/value/when) so
+        # a CPU-fallback round-end run is never mistaken for "no TPU
+        # number exists" — and so a STALE on-file number is visibly
+        # stamped, not silently cited.
+        path, doc = freshest_tpu_result_on_file(bench_dir)
+        if doc is not None:
             out["tpu_result_on_file"] = {
-                "path": "benchmarks/TPU_BENCH_R3.json",
+                "path": os.path.relpath(path, os.path.dirname(bench_dir)),
                 "metric": doc.get("result", {}).get("metric"),
                 "value": doc.get("result", {}).get("value"),
                 "captured_utc": doc.get("captured_utc"),
             }
-        except Exception:
-            pass
     print(json.dumps(out), flush=True)
     return 0
 

@@ -1,0 +1,110 @@
+"""Tunnel watcher: capture TPU benchmark evidence whenever a window opens.
+
+The bench host reaches its one real TPU chip through a tunnel whose health
+flips on a timescale of hours, with healthy windows of ~20 minutes
+(benchmarks/TPU_RESULTS.md). Waiting until round-end to bench means
+rolling one die; this daemon rolls it continuously:
+
+    probe (bounded, ~75 s)  — dead → sleep and re-probe
+                            — healthy → immediately:
+        1. python bench.py            (headline; persists TPU_BENCH_R4.json)
+        2. python benchmarks/run_table.py --min-fresh <start>
+                                      (incremental; fills only missing rows)
+
+Both children are the probe-gated harnesses, so a window that closes
+mid-run costs one bounded timeout and the already-landed rows persist.
+Log: benchmarks/tpu_watch.log (stamped, append).
+
+Usage: python benchmarks/tpu_watch.py [--interval 300] [--max-hours 12]
+       [--min-fresh ISO]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchtools import (  # noqa: E402
+    JAX_CACHE_DIR,
+    last_json_line,
+    probe_backend,
+    run_cmd,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes while the tunnel is down")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--min-fresh",
+                    default=datetime.datetime.now(datetime.timezone.utc)
+                    .replace(hour=0, minute=0, second=0, microsecond=0)
+                    .isoformat(),
+                    help="run_table rows older than this are re-measured")
+    ap.add_argument("--log", default=os.path.join(REPO, "benchmarks",
+                                                  "tpu_watch.log"))
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
+    deadline = time.time() + args.max_hours * 3600.0
+    logf = open(args.log, "a", buffering=1)
+
+    def log(msg: str) -> None:
+        stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()[:19]
+        logf.write(f"[{stamp}Z] {msg}\n")
+
+    log(f"watcher start: interval={args.interval}s max={args.max_hours}h "
+        f"min_fresh={args.min_fresh}")
+    windows = 0
+    while time.time() < deadline:
+        parsed = probe_backend(env, 75.0, cwd=REPO)
+        if parsed is None or parsed.get("backend") != "tpu":
+            log(f"probe: down ({parsed})")
+            time.sleep(args.interval)
+            continue
+
+        windows += 1
+        log(f"probe: HEALTHY ({parsed.get('device0')}) — window #{windows}, "
+            f"capturing now")
+        # Headline first (fast, persists TPU_BENCH_R4.json on success) —
+        # probe retries minimal since we just probed.
+        # Cap must exceed bench.py's own worst case (probe 75 s + TPU
+        # child 420 s + CPU fallback 240 s ≈ 735 s) so a window closing
+        # mid-run still yields bench.py's diagnostic JSON line instead of
+        # a SIGKILL.
+        rc, out, err = run_cmd(
+            [sys.executable, "bench.py", "--probe-retries", "1"],
+            env, 900.0, cwd=REPO)
+        line = last_json_line(out) or {}
+        log(f"bench.py rc={rc} backend={line.get('backend')} "
+            f"value={line.get('value')} fallback={line.get('fallback')}")
+
+        # Then the table: incremental, probe-gated per row; rc=2 = tunnel
+        # died mid-table (fine — finished rows persisted).
+        rc, out, err = run_cmd(
+            [sys.executable, "benchmarks/run_table.py",
+             "--min-fresh", args.min_fresh], env, 3600.0, cwd=REPO)
+        log(f"run_table rc={rc} last: {last_json_line(out)}")
+        if rc == 0 and not line.get("fallback"):
+            # Full capture landed (headline + every table row fresh).
+            # Don't re-bench in a tight loop for the rest of the window —
+            # the host has one core and the numbers are already current.
+            log("full capture complete — sleeping 30 min before refreshing")
+            time.sleep(1800.0)
+        # Else loop immediately: if the window is still open, the next
+        # probe is cheap and run_table skips the rows that landed.
+    log("watcher deadline reached; exiting")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

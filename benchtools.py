@@ -49,3 +49,20 @@ def tail(s: str, n: int = 12) -> str:
 # Mirror of dvf_tpu.bench_child.JAX_CACHE_DIR (same env override) for the
 # scripts that must never import the package (bench.py's jax-free parent).
 JAX_CACHE_DIR = os.environ.get("DVF_JAX_CACHE_DIR", "/tmp/dvf_jaxcache")
+
+
+def probe_backend(env, timeout: float, cwd=None) -> Optional[dict]:
+    """Run one bounded ``bench_child --mode probe``; the parsed JSON line
+    ({"backend": ..., "n_devices": ..., "probe_sum": ...}) or None.
+
+    The single probe-child construction shared by bench.py and
+    benchmarks/run_table.py — the init-timeout margin (probe budget minus
+    subprocess startup slack) and the healthy-output contract live here
+    only.
+    """
+    import sys
+
+    cmd = [sys.executable, "-m", "dvf_tpu.bench_child", "--mode", "probe",
+           "--init-timeout", str(max(10.0, timeout - 15.0))]
+    rc, out, err = run_cmd(cmd, env, timeout, cwd=cwd)
+    return last_json_line(out)

@@ -30,6 +30,11 @@ from typing import Optional
 
 from dvf_tpu.api.filter import Filter
 
+# Per-chip peaks for the roofline/MFU columns (TPU v5e datasheet values:
+# 16 GB HBM2 @ 819 GB/s, 197 bf16 TFLOP/s on the MXU). Used only when the
+# backend reports "tpu"; CPU runs carry no roofline claim.
+V5E_PEAKS = {"hbm_gbps": 819.0, "bf16_tflops": 197.0}
+
 
 def bench_device_resident(
     filt: Filter,
@@ -92,7 +97,7 @@ def bench_device_resident(
         wall = time.perf_counter() - t0
 
     frames = iters * batch_size
-    return {
+    result = {
         "fps": frames / wall if wall > 0 else 0.0,
         "frames": frames,
         "wall_s": wall,
@@ -100,6 +105,110 @@ def bench_device_resident(
         "ms_per_frame": wall / frames * 1e3,
         "h2d_mbps": h2d_mbps,
     }
+    ca = engine.cost_analysis()
+    if ca is not None:
+        result["flops_per_frame"] = ca["flops_per_batch"] / batch_size
+        result["bytes_accessed_per_frame"] = (
+            ca["bytes_accessed_per_batch"] / batch_size)
+    return result
+
+
+def roofline_fields(r: dict, backend: str) -> dict:
+    """Roofline fraction + MFU for a :func:`bench_device_resident` result.
+
+    Memory model for the fraction (right for the stencil/pointwise filter
+    families, which are HBM-bound): achievable fps ceiling = HBM bandwidth
+    / XLA-reported bytes accessed per frame. MFU (right for the neural
+    configs style/SR, which are MXU-bound) = achieved FLOP rate / bf16
+    peak. Both are reported so each config is judged against the model
+    that binds it (VERDICT r3 item 4). Only the TPU has published peaks —
+    CPU results return {}.
+    """
+    if backend != "tpu" or "bytes_accessed_per_frame" not in r:
+        return {}
+    bytes_f = r["bytes_accessed_per_frame"]
+    flops_f = r.get("flops_per_frame", 0.0)
+    fps = r.get("fps", 0.0)
+    out = {}
+    if bytes_f > 0:
+        ceil = V5E_PEAKS["hbm_gbps"] * 1e9 / bytes_f
+        # "hbm_" prefix: bench.py's e2e phase already reports a LINK-based
+        # `roofline_frac` (fraction of the host↔device ceiling); this one
+        # is the fraction of the HBM-bandwidth ceiling for device-resident
+        # throughput — different ceiling, different name.
+        out["hbm_roofline_fps"] = round(ceil, 1)
+        out["hbm_roofline_frac"] = round(fps / ceil, 3) if ceil else None
+        out["hbm_gb_per_frame"] = round(bytes_f / 1e9, 6)
+    if flops_f > 0:
+        out["mfu"] = round(
+            fps * flops_f / (V5E_PEAKS["bf16_tflops"] * 1e12), 5)
+        out["gflops_per_frame"] = round(flops_f / 1e9, 3)
+    return out
+
+
+def bench_stage_decomposition(
+    filt: Filter,
+    batch_sizes=(1, 2, 4),
+    height: int = 1080,
+    width: int = 1920,
+    reps: int = 50,
+    transfer_reps: int = 3,
+) -> dict:
+    """Per-stage latency decomposition at small batch (VERDICT r3 item 2).
+
+    For each batch size, p50 over ``reps`` of the four legs a frame
+    actually crosses in the pipeline: host staging copy (assembler
+    stacking frames into the dispatch array), H2D ``device_put``, compute
+    (one engine step, block_until_ready — includes dispatch overhead, as
+    the pipeline experiences it), D2H (``np.asarray`` of the result).
+    On the tunneled bench chip the transfer legs measure the tunnel, not
+    PCIe; the decomposition exists precisely so the compute leg (tunnel-
+    immune) can be combined with separately-measured link figures into an
+    explicit latency model (see benchmarks/LATENCY.md). Accordingly the
+    D2H leg — ~1.3 s per batch-4 rep at the tunnel's ~20 MB/s — is timed
+    only ``transfer_reps`` times (matching bench_transfer's reps); paying
+    ``reps`` full fetches would burn minutes of the bench budget on
+    numbers the model discards. H2D must run every rep regardless (the
+    donated compute step consumes its input), so it is timed every rep.
+    """
+    import jax
+    import numpy as np
+
+    from dvf_tpu.runtime.engine import Engine
+
+    rng = np.random.default_rng(0)
+    out: dict = {}
+    for b in batch_sizes:
+        shape = (b, height, width, 3)
+        engine = Engine(filt)
+        engine.compile(shape, np.uint8)
+        frames = [rng.integers(0, 255, size=(height, width, 3), dtype=np.uint8)
+                  for _ in range(b)]
+        staging = np.empty(shape, np.uint8)
+        legs = {"staging_ms": [], "h2d_ms": [], "compute_ms": [], "d2h_ms": []}
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            for i, f in enumerate(frames):
+                staging[i] = f
+            t1 = time.perf_counter()
+            x = jax.device_put(staging)
+            x.block_until_ready()
+            t2 = time.perf_counter()
+            y = engine.run_device_resident(x)
+            y.block_until_ready()
+            t3 = time.perf_counter()
+            legs["staging_ms"].append((t1 - t0) * 1e3)
+            legs["h2d_ms"].append((t2 - t1) * 1e3)
+            legs["compute_ms"].append((t3 - t2) * 1e3)
+            if rep < transfer_reps:
+                host = np.asarray(y)
+                legs["d2h_ms"].append((time.perf_counter() - t3) * 1e3)
+                del host
+        p50 = {k: round(float(np.percentile(v, 50)), 4) for k, v in legs.items()}
+        p50["total_ms"] = round(sum(p50.values()), 4)
+        p50["per_frame_compute_ms"] = round(p50["compute_ms"] / b, 4)
+        out[str(b)] = p50
+    return out
 
 
 def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> dict:
@@ -245,13 +354,17 @@ def bench_e2e_latency(
     target_fps: float,
     max_inflight: int = 2,
     collect_mode: str = "thread",
+    transport: str = "python",
+    wire: str = "raw",
     mesh=None,
 ) -> dict:
     """Latency mode: source throttled to ``target_fps`` (pick ~0.8× the
     measured throughput), ingest queue bounded to one batch, shallow
     in-flight depth — p50/p99 then measure capture→deliver transit of an
     un-congested stream, the half of the north star the throughput run
-    can't speak to."""
+    can't speak to. ``transport``/``wire`` select the same ingest path as
+    the throughput mode — a ring/jpeg run's published transit MUST include
+    the ring hop and codec cost it is labeled with."""
     from dvf_tpu.io.sources import SyntheticSource
 
     r = _run_pipeline(
@@ -260,7 +373,7 @@ def bench_e2e_latency(
                         rate=target_fps),
         batch_size, height, width, max_inflight,
         queue_size=batch_size,
-        collect_mode=collect_mode, mesh=mesh,
+        collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
     )
     r["target_fps"] = target_fps
     return r

@@ -134,7 +134,10 @@ class Engine:
             jax.ShapeDtypeStruct(tuple(batch_shape), np.dtype(in_dtype)),
             self._state,  # built just before _build_step in compile()
         )[0]
-        donate = (0, 1) if out_aval.shape == tuple(batch_shape) else (1,)
+        donate = ((0, 1)
+                  if (out_aval.shape == tuple(batch_shape)
+                      and out_aval.dtype == np.dtype(in_dtype))
+                  else (1,))
         return jax.jit(
             step,
             in_shardings=(self._sharding, state_shardings),
@@ -218,6 +221,36 @@ class Engine:
         self.stats.batches += 1
         self.stats.frames += batch.shape[0]
         return y
+
+    def cost_analysis(self) -> Optional[dict]:
+        """XLA's own cost model for the compiled step: total FLOPs and HBM
+        bytes accessed per batch. This is what the per-config roofline
+        fractions in the bench tables are computed from — the compiler's
+        estimate of traffic/arithmetic, not a hand-counted model, so fusion
+        (e.g. the cast folded into the filter) is accounted for. Returns
+        None when the backend doesn't implement cost analysis.
+
+        Cost note: lower().compile() builds a second executable beside the
+        jit-cached one, but every bench entry point sets
+        JAX_COMPILATION_CACHE_DIR (cli._force_platform / bench_child), so
+        for any program whose compile exceeded ~1 s this is a persistent-
+        cache hit (deserialize, not recompile)."""
+        if self._step is None or self._signature is None:
+            return None
+        shape, dtype = self._signature
+        try:
+            lowered = self._step.lower(
+                jax.ShapeDtypeStruct(shape, dtype), self._state)
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0))
+            byts = float(ca.get("bytes accessed", 0.0))
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            return None
+        if not (flops or byts):
+            return None
+        return {"flops_per_batch": flops, "bytes_accessed_per_batch": byts}
 
     def reset_state(self) -> None:
         if self._exec_filter.stateful and self._signature is not None:

@@ -68,8 +68,12 @@ def _resolve_checkpoint_dir(ckpt_dir: str, family: str, train_cmd: str) -> str:
         # 'step_*.orbax-checkpoint-tmp' dirs that sort AFTER every
         # committed step — a run killed mid-(async)-write must fall back
         # to the newest COMMITTED checkpoint, never the torn tmp dir.
-        steps = sorted(d for d in os.listdir(ckpt_dir)
-                       if re.fullmatch(r"step_\d+", d))
+        # Numeric sort: lexicographic order would rely on the CLI's 6-digit
+        # zero padding and mis-rank step_1000000 below step_999999 (or any
+        # externally written unpadded dir).
+        steps = sorted((d for d in os.listdir(ckpt_dir)
+                        if re.fullmatch(r"step_\d+", d)),
+                       key=lambda d: int(d[len("step_"):]))
         if not steps:
             raise FileNotFoundError(
                 f"{ckpt_dir!r} has no 'final' or step_* checkpoint — pass "

@@ -245,6 +245,77 @@ class NativeJpegCodec:
         self.pool.shutdown(wait=False)
 
 
+def measure_codec_fps(height: int, width: int, samples: int = 8,
+                      quality: int = 90):
+    """Quick per-core codec throughput at this geometry (~0.1–0.3 s).
+
+    Returns ``(encode_fps, decode_fps)`` measured single-threaded on a
+    realistic (noise, worst-case-entropy) frame. This is the measurement
+    behind serve's wire-mode budget warning — the decision must use THIS
+    host's numbers, not the committed CODEC_BENCH table from another
+    machine (SURVEY §7 hard part 3: host JPEG throughput is the first
+    bottleneck at high rates).
+    """
+    import time
+
+    codec = make_codec(quality=quality, threads=1)
+    try:
+        rng = np.random.default_rng(0)
+        frame = rng.integers(0, 255, size=(height, width, 3), dtype=np.uint8)
+        blob = codec.encode(frame)  # warm
+        out = np.empty((height, width, 3), np.uint8)
+        if hasattr(codec, "decode_into"):
+            codec.decode_into(blob, out)
+
+            def dec():
+                codec.decode_into(blob, out)
+        else:
+            codec.decode(blob)
+
+            def dec():
+                codec.decode(blob)
+        t0 = time.perf_counter()
+        for _ in range(samples):
+            codec.encode(frame)
+        enc_s = (time.perf_counter() - t0) / samples
+        t0 = time.perf_counter()
+        for _ in range(samples):
+            dec()
+        dec_s = (time.perf_counter() - t0) / samples
+        return 1.0 / max(enc_s, 1e-9), 1.0 / max(dec_s, 1e-9)
+    finally:
+        codec.close()
+
+
+def jpeg_wire_budget(height: int, width: int, quality: int = 90,
+                     threads: Optional[int] = None) -> dict:
+    """Host-codec budget for the JPEG wire at one frame geometry.
+
+    In a single-process serve, BOTH legs run on this host (capture thread
+    encodes, dispatch decodes into staging), so the sustainable rate is
+    workers / (encode_s + decode_s), where workers is the number of codec
+    pool threads that can actually run in parallel:
+    ``min(cores, threads)`` — a 4-thread pool on a 32-core host still
+    caps at 4× per-core speed, and a 32-thread pool on this 1-core bench
+    host still caps at 1×. ``capacity_fps`` is that ceiling;
+    ``decode_only_capacity_fps`` is the ceiling when only decode is local
+    (remote camera encodes on its own host). The full break-even analysis
+    lives in benchmarks/TPU_RESULTS.md.
+    """
+    enc_fps, dec_fps = measure_codec_fps(height, width, quality=quality)
+    cores = os.cpu_count() or 1
+    workers = min(cores, threads) if threads else cores
+    per_frame_s = 1.0 / enc_fps + 1.0 / dec_fps
+    return {
+        "per_core_encode_fps": round(enc_fps, 1),
+        "per_core_decode_fps": round(dec_fps, 1),
+        "cores": cores,
+        "codec_workers": workers,
+        "capacity_fps": round(workers / per_frame_s, 1),
+        "decode_only_capacity_fps": round(workers * dec_fps, 1),
+    }
+
+
 def make_codec(quality: int = 90, threads: int = 4):
     """The production constructor: native C++ codec, falling back to the
     cv2-threaded one (with a one-line notice) if the shim can't build."""

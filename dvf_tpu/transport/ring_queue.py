@@ -71,6 +71,9 @@ class RingFrameQueue:
         self.frame_dtype = np.dtype(np.uint8)
         self._frame_bytes = int(np.prod(self.frame_shape))
         self.jpeg = jpeg
+        # Exposed so serve's wire-budget check budgets against the pool
+        # the pipeline actually runs, not the host's total core count.
+        self.codec_pool_threads = codec_threads
         self.codec = make_codec(quality=jpeg_quality, threads=codec_threads) if jpeg else None
         # Sized for capacity_frames RAW frames (a JPEG ring then holds more
         # — the bound is freshness in bytes, the stronger guarantee). The

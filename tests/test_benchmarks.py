@@ -66,3 +66,114 @@ def test_latency_bench_accepts_mesh():
                           target_fps=500.0,
                           mesh=make_mesh(MeshConfig(data=2)))
     assert r["frames"] > 0 and r["p50_ms"] > 0
+
+
+def test_stage_decomposition_fields():
+    from dvf_tpu.benchmarks import bench_stage_decomposition
+
+    d = bench_stage_decomposition(get_filter("invert"), (1, 2), 16, 16, reps=3)
+    assert set(d) == {"1", "2"}
+    for b, legs in d.items():
+        for k in ("staging_ms", "h2d_ms", "compute_ms", "d2h_ms"):
+            assert legs[k] >= 0, (b, k, legs)
+        assert legs["total_ms"] >= legs["compute_ms"]
+        assert legs["per_frame_compute_ms"] == round(
+            legs["compute_ms"] / int(b), 4)
+
+
+def test_roofline_fields_models():
+    """The roofline columns use XLA's own cost analysis: invert reads +
+    writes one uint8 frame, so bytes accessed must be exactly 2× the frame
+    bytes, and the HBM fraction must follow fps/(BW/bytes)."""
+    from dvf_tpu.benchmarks import V5E_PEAKS, roofline_fields
+
+    r = bench_device_resident(get_filter("invert"), iters=3, batch_size=2,
+                              height=16, width=16)
+    assert r["bytes_accessed_per_frame"] == 2 * 16 * 16 * 3
+    # CPU backend → no roofline claim.
+    assert roofline_fields(r, "cpu") == {}
+    fake = dict(r, fps=1000.0)
+    out = roofline_fields(fake, "tpu")
+    ceil = V5E_PEAKS["hbm_gbps"] * 1e9 / r["bytes_accessed_per_frame"]
+    assert abs(out["hbm_roofline_fps"] - round(ceil, 1)) < 0.2
+    assert out["hbm_roofline_frac"] == round(1000.0 / ceil, 3)
+
+
+def test_bench_child_probe_mode():
+    """--mode probe initializes the backend, runs a tiny computation, and
+    prints one JSON line — the tunnel pre-flight bench.py and run_table
+    gate on."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-m", "dvf_tpu.bench_child", "--mode", "probe",
+         "--platform", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    assert line["backend"] == "cpu"
+    assert line["probe_sum"] == 28.0  # sum(range(8)) — the chip executed
+
+
+def test_run_table_freshness_rules():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "run_table", os.path.join(os.path.dirname(__file__), "..",
+                                  "benchmarks", "run_table.py"))
+    rt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rt)
+
+    good = {"device": {"value": 1.0}, "e2e": {"value": 1.0},
+            "captured_utc": "2026-07-30T10:00:00+00:00"}
+    errd = {"device": {"error": "rc=-9"}, "e2e": {"value": 1.0},
+            "captured_utc": "2026-07-30T10:00:00+00:00"}
+    assert rt.is_fresh(good, "")
+    assert rt.is_fresh(good, "2026-07-30T09:00")
+    assert not rt.is_fresh(good, "2026-07-30T11:00")   # older than horizon
+    assert not rt.is_fresh(errd, "")                   # errors always rerun
+    assert not rt.is_fresh(None, "")
+    assert not rt.is_fresh({"e2e": {"value": 1}}, "")  # device leg missing
+    # Killed between legs: device persisted, e2e never ran → stale.
+    assert not rt.is_fresh(
+        {"device": {"value": 1.0},
+         "captured_utc": "2026-07-30T10:00:00+00:00"}, "")
+    # Legacy pre-incremental rows carry no stamp → stale even with no
+    # --min-fresh (their e2e percentiles predate the rate-controlled
+    # methodology and must not be republished under the new caption).
+    assert not rt.is_fresh(
+        {"device": {"value": 1.0}, "e2e": {"value": 1.0}}, "")
+
+    comp = {"jnp": {"fps": 5.0}, "pallas": {"fps": 9.0}, "winner": "pallas",
+            "captured_utc": "2026-07-30T10:00:00+00:00"}
+    assert rt.comparison_fresh(comp, "2026-07-30T09:00")
+    assert not rt.comparison_fresh(comp, "2026-07-31T00:00")
+    assert not rt.comparison_fresh(
+        dict(comp, pallas={"error": "x"}), "")
+    # Killed between impl legs: finished legs persisted, winner never
+    # computed → stale, the rerun fills the remaining impls.
+    partial = {"jnp": {"fps": 5.0},
+               "captured_utc": "2026-07-30T10:00:00+00:00"}
+    assert not rt.comparison_fresh(partial, "")
+
+    # Run-mode mismatch: a --quick or --cpu session's rows must never be
+    # treated as fresh by a full/TPU run in the same out-dir (they'd be
+    # republished under the TPU header).
+    assert not rt.is_fresh(dict(good, quick=True), "")
+    assert not rt.is_fresh(dict(good, forced_cpu=True), "")
+    assert rt.is_fresh(dict(good, quick=True), "", quick=True)
+    assert rt.is_fresh(dict(good, forced_cpu=True), "", forced_cpu=True)
+    assert not rt.is_fresh(good, "", forced_cpu=True)  # and vice versa
+    assert not rt.comparison_fresh(dict(comp, forced_cpu=True), "")
+    assert rt.comparison_fresh(dict(comp, forced_cpu=True), "",
+                               forced_cpu=True)

@@ -105,3 +105,20 @@ def test_cli_eval_reproduces_demo_claim(capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["held_out"]["delta_db"] > 2.5
+
+
+def test_cli_eval_after_real_steps(capsys):
+    """`train-sr --steps 2 --eval` must evaluate the TRAINED state.
+
+    Regression (advisor, round 3): final_json captured the pre-training
+    state whose buffers the donating train step deletes, so any
+    steps>start run with --eval crashed with 'Array has been deleted'
+    after the final checkpoint save. --steps 0 (above) masked it."""
+    from dvf_tpu.cli import main
+
+    rc = main(["train-sr", "--steps", "2", "--batch", "2", "--size", "16",
+               "--eval", "--log-every", "100"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "held_out" in out and "delta_db" in out["held_out"]
+    assert np.isfinite(out["final_loss"])
