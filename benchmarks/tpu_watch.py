@@ -94,6 +94,37 @@ def main(argv=None) -> int:
             [sys.executable, "benchmarks/run_table.py",
              "--min-fresh", args.min_fresh], env, 3600.0, cwd=REPO)
         log(f"run_table rc={rc} last: {last_json_line(out)}")
+
+        # Opportunistic: train the ≥256 px style checkpoint on-chip while
+        # the window is open (VERDICT r3 item 5 — the committed demo is a
+        # 64 px toy). Steps are device-cheap; checkpoint-every bounds the
+        # loss if the window closes, and the next window resumes. Gated on
+        # rc != 2: run_table's own probe just declared the tunnel dead in
+        # that case, and launching a 25-min train against it would burn
+        # the rest of the watcher's patience on a hung backend init.
+        ckpt = os.path.join(REPO, "checkpoints", "style_stripes_256")
+        if rc != 2 and not os.path.isdir(os.path.join(ckpt, "final")):
+            cmd = [sys.executable, "-m", "dvf_tpu", "train",
+                   "--steps", "2000", "--size", "256", "--batch", "4",
+                   "--base-channels", "16", "--n-residual", "3",
+                   "--style", "stripes", "--checkpoint-dir", ckpt,
+                   "--checkpoint-every", "250", "--log-every", "100"]
+            if os.path.isdir(ckpt):
+                # train --resume wants a CONCRETE checkpoint dir (orbax
+                # path), not the parent — the package's own resolver owns
+                # the newest-committed-step rule. (Import deferred to this
+                # healthy-window branch: the probe loop stays jax-free.)
+                from dvf_tpu.train.checkpoint import resolve_checkpoint_dir
+
+                try:
+                    cmd += ["--resume",
+                            resolve_checkpoint_dir(ckpt, "style", "train")]
+                except FileNotFoundError:
+                    pass  # dir exists but holds no checkpoint yet
+            t_rc, t_out, t_err = run_cmd(cmd, env, 1500.0, cwd=REPO)
+            log(f"style-256 train rc={t_rc} last: {last_json_line(t_out)}"
+                + ("" if t_rc == 0 else
+                   f" err tail: {t_err.strip().splitlines()[-2:]}"))
         if rc == 0 and not line.get("fallback"):
             # Full capture landed (headline + every table row fresh).
             # Don't re-bench in a tight loop for the rest of the window —

@@ -52,7 +52,7 @@ def load_params(path: str):
 
 # ------------------------------------------------- shared layout helpers
 
-def _resolve_checkpoint_dir(ckpt_dir: str, family: str, train_cmd: str) -> str:
+def resolve_checkpoint_dir(ckpt_dir: str, family: str, train_cmd: str) -> str:
     """Map a train --checkpoint-dir to the concrete checkpoint to load:
     prefer 'final'; fall back to the newest step_* — a run killed
     mid-training leaves step dirs but no final, and those must stay
@@ -127,7 +127,7 @@ def _restore_state(path: str, template, state_cls, fields: Sequence[str]):
 def load_style_filter(ckpt_dir: str):
     """Rebuild the style_transfer Filter from a train checkpoint directory
     (the single loader behind ``serve --style-checkpoint`` and the tests)."""
-    final = _resolve_checkpoint_dir(ckpt_dir, "style", "train")
+    final = resolve_checkpoint_dir(ckpt_dir, "style", "train")
     sc = _read_sidecar(ckpt_dir, ("base_channels", "n_residual"))
 
     from dvf_tpu.ops import get_filter
@@ -166,7 +166,7 @@ def restore_checkpoint(
 def load_sr_filter(ckpt_dir: str):
     """Rebuild the super_resolution Filter from a train-sr checkpoint dir
     (behind ``serve --sr-checkpoint``)."""
-    final = _resolve_checkpoint_dir(ckpt_dir, "sr", "train-sr")
+    final = resolve_checkpoint_dir(ckpt_dir, "sr", "train-sr")
     sc = _read_sidecar(ckpt_dir, ("scale",))
 
     from dvf_tpu.ops import get_filter

@@ -149,12 +149,12 @@ def test_resume_fallback_ignores_orbax_tmp_dirs(tmp_path):
     import jax
 
     from dvf_tpu.train.checkpoint import (
-        _resolve_checkpoint_dir, save_checkpoint)
+        resolve_checkpoint_dir, save_checkpoint)
     from dvf_tpu.train.sr import SrTrainConfig, init_train_state
 
     state = init_train_state(jax.random.PRNGKey(0), SrTrainConfig())
     good = tmp_path / "step_000002"
     save_checkpoint(str(good), state)
     (tmp_path / "step_000009.orbax-checkpoint-tmp").mkdir()  # torn write
-    picked = _resolve_checkpoint_dir(str(tmp_path), "sr", "train-sr")
+    picked = resolve_checkpoint_dir(str(tmp_path), "sr", "train-sr")
     assert picked == str(good)
