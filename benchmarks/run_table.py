@@ -73,8 +73,11 @@ COMPARISONS = {
         ("jnp", "bilateral", {}),
         ("pallas", "bilateral_pallas", {}),
     ]),
+    # impl pinned explicitly: get_filter("sobel_bilateral") with no config
+    # now resolves to the measured per-backend winner, which on CPU IS the
+    # pallas program — an unpinned A/B would compare pallas to itself.
     "sobel_bilateral_1080p": (1080, 1920, 8, [
-        ("jnp_chain", "sobel_bilateral", {}),
+        ("jnp_chain", "sobel_bilateral", {"impl": "chain"}),
         ("pallas_fused", "sobel_bilateral_pallas", {}),
     ]),
     "flow_warp_720p": (720, 1280, 4, [

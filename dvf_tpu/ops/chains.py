@@ -9,16 +9,36 @@ chaining worker processes over ZMQ.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from dvf_tpu.api.filter import Filter, FilterChain
-from dvf_tpu.ops.registry import get_filter, register_filter
+from dvf_tpu.ops.registry import get_filter, measured_default, register_filter
 
 
 @register_filter("sobel_bilateral")
 def sobel_bilateral(
     d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0,
-    magnitude_scale: float = 1.0,
+    magnitude_scale: float = 1.0, impl: Optional[str] = None,
 ) -> Filter:
-    """BASELINE configs[2]: Sobel edges then bilateral, fused into one program."""
+    """BASELINE configs[2]: Sobel edges then bilateral, one device program.
+
+    ``impl=None`` picks the measured per-backend winner: on CPU the fused
+    Pallas program ("pallas", 9.2 vs 3.3 fps at 1080p — it never
+    materializes the chain's intermediates; in interpret mode it lowers
+    to ordinary fused XLA ops, so it is a legitimate production path).
+    "chain" (the two-op jnp chain) remains the default on backends whose
+    A/B hasn't been captured yet. benchmarks/cpu/BENCH_TABLE.md
+    impl-comparison rows are the provenance; both filters declare the
+    same halo, so spatial sharding is unaffected by the choice.
+    """
+    if impl is None:
+        impl = measured_default({"cpu": "pallas"}, fallback="chain")
+    if impl == "pallas":
+        return get_filter("sobel_bilateral_pallas", d=d,
+                          sigma_color=sigma_color, sigma_space=sigma_space,
+                          magnitude_scale=magnitude_scale)
+    if impl != "chain":
+        raise ValueError(f"impl must be 'chain' or 'pallas', got {impl!r}")
     return FilterChain(
         get_filter("sobel", magnitude_scale=magnitude_scale),
         get_filter("bilateral", d=d, sigma_color=sigma_color, sigma_space=sigma_space),

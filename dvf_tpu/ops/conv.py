@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax
 
 from dvf_tpu.api.filter import Filter, stateless
-from dvf_tpu.ops.registry import register_filter
+from dvf_tpu.ops.registry import get_filter, measured_default, register_filter
 from dvf_tpu.utils.image import rgb_to_gray
 
 _DN = ("NHWC", "HWIO", "NHWC")  # conv dimension numbers used throughout
@@ -120,8 +120,30 @@ def sep_conv2d(
 
 
 @register_filter("gaussian_blur")
-def gaussian_blur(ksize: int = 9, sigma: float = 0.0, impl: str = "shift") -> Filter:
-    """Separable Gaussian blur matching cv2.GaussianBlur taps."""
+def gaussian_blur(ksize: int = 9, sigma: float = 0.0,
+                  impl: Optional[str] = None) -> Filter:
+    """Separable Gaussian blur matching cv2.GaussianBlur taps.
+
+    ``impl=None`` picks the measured per-backend winner for large kernels:
+    on CPU at ksize≥9 the fused Pallas lowering ("pallas", 15.3 vs
+    9.3 fps at 1080p — one VMEM residency instead of two shifted-FMA
+    passes; interpret mode lowers to ordinary fused XLA ops). "shift"
+    stays the default for small kernels (unmeasured A/B) and for backends
+    whose A/B hasn't been captured. Explicit impl pins (the A/B harness
+    passes "shift"/"depthwise"). Provenance: benchmarks/cpu/BENCH_TABLE.md
+    gauss9 comparison. Halo is ksize//2 for every impl, so spatial
+    sharding is unaffected.
+    """
+    if impl is None:
+        impl = (measured_default({"cpu": "pallas"}, fallback="shift")
+                if ksize >= 9 else "shift")
+    if impl == "pallas":
+        return get_filter("gaussian_blur_pallas", ksize=ksize, sigma=sigma)
+    if impl not in ("shift", "depthwise"):
+        # Validate at construction: deferring to trace time would surface
+        # a typo deep inside sep_conv2d, far from the misconfiguration.
+        raise ValueError(
+            f"impl must be 'shift', 'depthwise', or 'pallas', got {impl!r}")
     kern = gaussian_kernel_1d(ksize, sigma)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:

@@ -50,3 +50,23 @@ def get_filter(name: str, **config) -> Filter:
 
 def list_filters() -> List[str]:
     return sorted(_REGISTRY)
+
+
+def measured_default(winners: Dict[str, str], fallback: str) -> str:
+    """Pick a filter's default implementation from the MEASURED per-backend
+    winners (VERDICT r3 item 4: 'pick the winner as the registry default
+    per backend').
+
+    ``winners`` maps backend → impl label, populated only from committed
+    A/B rows in benchmarks/*/BENCH_TABLE.md — an unmeasured backend falls
+    back to ``fallback`` rather than guessing. Callers pin an explicit
+    ``impl=...`` to bypass this entirely (the A/B harness does).
+
+    Note this touches ``jax.default_backend()`` (initializes the backend):
+    it runs at filter-construction time, which in every CLI/worker path is
+    after ``_force_platform()``. Plain ``import dvf_tpu`` stays
+    backend-free (guarded by tests/test_import_hygiene.py).
+    """
+    import jax
+
+    return winners.get(jax.default_backend(), fallback)

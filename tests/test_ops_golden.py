@@ -257,3 +257,31 @@ def test_equalize_per_channel_flattens_histogram():
     xv, ov = x[b, :, :, c].ravel(), out[b, :, :, c].ravel()
     order = np.argsort(xv, kind="stable")
     assert (np.diff(ov[order]) >= 0).all()
+
+
+def test_measured_per_backend_defaults():
+    """impl=None resolves to the MEASURED winner for this backend
+    (benchmarks/cpu/BENCH_TABLE.md impl comparisons: the fused Pallas
+    programs win on CPU for sobel_bilateral and gauss-k9); an explicit
+    impl always pins, and unmeasured cases keep the conservative default."""
+    import pytest
+
+    from dvf_tpu.ops import get_filter
+
+    # CPU winners (this suite forces the cpu backend in conftest).
+    assert "pallas" in get_filter("sobel_bilateral").name
+    assert "pallas" in get_filter("gaussian_blur").name          # k=9
+    # Unmeasured small kernel keeps the shifted-FMA lowering.
+    assert "pallas" not in get_filter("gaussian_blur", ksize=3).name
+    # Explicit impl pins — the A/B harness depends on this.
+    assert "pallas" not in get_filter("sobel_bilateral", impl="chain").name
+    assert "pallas" not in get_filter("gaussian_blur", impl="shift").name
+    with pytest.raises(ValueError, match="impl"):
+        get_filter("sobel_bilateral", impl="nope")
+
+    from dvf_tpu.ops.registry import measured_default
+
+    assert measured_default({"cpu": "a"}, fallback="b") == "a"
+    assert measured_default({"tpu": "a"}, fallback="b") == "b"
+    with pytest.raises(ValueError, match="pallas"):
+        get_filter("gaussian_blur", impl="palas")

@@ -206,7 +206,10 @@ def test_pallas_fused_sobel_bilateral_matches_chain(batch):
     including borders (Sobel magnitude commutes with reflect-101)."""
     from dvf_tpu.ops.pallas_kernels import sobel_bilateral_nhwc_pallas
 
-    chain = get_filter("sobel_bilateral")
+    # impl="chain" pinned: the unpinned name resolves to the measured
+    # per-backend winner, which on CPU IS the pallas kernel — unpinned,
+    # this equivalence test would compare pallas to itself.
+    chain = get_filter("sobel_bilateral", impl="chain")
     want, _ = chain.fn(jnp.asarray(batch), None)
     got = sobel_bilateral_nhwc_pallas(jnp.asarray(batch), interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
@@ -215,7 +218,7 @@ def test_pallas_fused_sobel_bilateral_matches_chain(batch):
 def test_pallas_fused_sobel_bilateral_registered(batch):
     f = get_filter("sobel_bilateral_pallas", interpret=True)
     got, _ = f.fn(jnp.asarray(batch), None)
-    chain = get_filter("sobel_bilateral")
+    chain = get_filter("sobel_bilateral", impl="chain")
     want, _ = chain.fn(jnp.asarray(batch), None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
     assert f.halo == 3  # bilateral r=2 + sobel support 1
@@ -281,7 +284,9 @@ def test_pallas_sep_blur_matches_sep_conv2d(batch):
 def test_pallas_gaussian_filter_registered(batch):
     f = get_filter("gaussian_blur_pallas", ksize=9, interpret=True)
     got, _ = f.fn(jnp.asarray(batch), None)
-    ref = get_filter("gaussian_blur", ksize=9)
+    # impl="shift" pinned: unpinned k=9 resolves to pallas on CPU — the
+    # equivalence would be vacuous (see sobel_bilateral test above).
+    ref = get_filter("gaussian_blur", ksize=9, impl="shift")
     want, _ = ref.fn(jnp.asarray(batch), None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
     assert f.halo == 4
