@@ -186,13 +186,15 @@ def load_doc(json_path: str) -> dict:
             doc.setdefault("configs", {})
             doc.setdefault("impl_comparisons", {})
             for entry in doc["configs"].values():
-                # Legacy (unstamped) rows measured p50/p99 on the
-                # UNTHROTTLED run — congestion, not transit. Until the row
-                # is re-measured it renders alongside the rate-controlled
-                # caption, so demote the percentiles to their honest
-                # congestion_* names (render shows '—').
+                # Legacy (unstamped at BOTH levels) rows measured p50/p99
+                # on the UNTHROTTLED run — congestion, not transit. Until
+                # the row is re-measured it renders alongside the rate-
+                # controlled caption, so demote the percentiles to their
+                # honest congestion_* names (render shows '—').
                 e2e = entry.get("e2e")
-                if not entry.get("captured_utc") and isinstance(e2e, dict):
+                if (isinstance(e2e, dict)
+                        and not entry.get("captured_utc")
+                        and not e2e.get("captured_utc")):
                     for k in ("p50_ms", "p99_ms"):
                         if k in e2e:
                             e2e[f"congestion_{k}"] = e2e.pop(k)
@@ -213,29 +215,39 @@ def persist(doc: dict, json_path: str, md_path: str, forced_cpu: bool) -> None:
         f.write(render_md(doc, forced_cpu))
 
 
-def is_fresh(entry: dict, min_fresh: str, quick: bool = False,
-             forced_cpu: bool = False) -> bool:
-    """A row is fresh only if BOTH legs are present and error-free, it
-    carries a timestamp postdating --min-fresh, and it was produced by
-    the SAME kind of run (quick? forced-cpu?) as the current invocation.
+def leg_fresh(entry: dict, leg: str, min_fresh: str, quick: bool = False,
+              forced_cpu: bool = False) -> bool:
+    """One leg (device/e2e) is fresh if present, error-free, produced by
+    the SAME kind of run (quick? forced-cpu?), and stamped after
+    --min-fresh. Per-LEG granularity is what lets the phased runner spend
+    a short tunnel window on every config's device leg + the A/Bs before
+    paying for any link-bound e2e leg.
 
-    Unstamped rows (legacy pre-incremental files) and rows missing a leg
-    (run killed between the device and e2e legs) are stale by definition —
-    'missing/errored rows always rerun'. The mode check prevents a
-    --quick or --cpu session's rows from being skipped (i.e. silently
+    Stamps/mode live inside the leg dict; entry-level values are the
+    fallback for rows written by the earlier entry-level schema.
+    Unstamped legs (legacy pre-incremental files) are stale by definition
+    — 'missing/errored rows always rerun'. The mode check prevents a
+    --quick or --cpu session's legs from being skipped (i.e. silently
     republished) by a later full/TPU run in the same out-dir."""
-    if not entry:
+    if not entry or leg not in entry:
         return False
-    for leg in ("device", "e2e"):
-        if leg not in entry or "error" in entry.get(leg, {}):
-            return False
-    if (entry.get("quick", False) != quick
-            or entry.get("forced_cpu", False) != forced_cpu):
+    d = entry[leg]
+    if not isinstance(d, dict) or "error" in d:
         return False
-    stamp = entry.get("captured_utc", "")
+    if (d.get("quick", entry.get("quick", False)) != quick
+            or d.get("forced_cpu", entry.get("forced_cpu", False)) != forced_cpu):
+        return False
+    stamp = d.get("captured_utc") or entry.get("captured_utc", "")
     if not stamp:
         return False
     return not min_fresh or stamp >= min_fresh
+
+
+def is_fresh(entry: dict, min_fresh: str, quick: bool = False,
+             forced_cpu: bool = False) -> bool:
+    """A whole row is fresh when both its legs are (see leg_fresh)."""
+    return (leg_fresh(entry, "device", min_fresh, quick, forced_cpu)
+            and leg_fresh(entry, "e2e", min_fresh, quick, forced_cpu))
 
 
 def comparison_fresh(comp: dict, min_fresh: str,
@@ -284,7 +296,8 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         d, e = r.get("device", {}), r.get("e2e", {})
         roof = d.get("hbm_roofline_frac")
         mfu = d.get("mfu")
-        stamp = (r.get("captured_utc") or "")[:16].replace("T", " ")
+        stamp = ((d.get("captured_utc") if isinstance(d, dict) else "")
+                 or r.get("captured_utc") or "")[:16].replace("T", " ")
         lines.append(
             f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
             f"| {roof if roof is not None else '—'} "
@@ -293,13 +306,24 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             f"| {e.get('p50_ms', '—') if e else '—'} "
             f"| {e.get('p99_ms', '—') if e else '—'} | {stamp} |"
         )
-    if any(r and not r.get("captured_utc")
+    def _legacy_e2e(r):
+        # Demoted legacy e2e: load_doc renamed its p50/p99 to congestion_*
+        # because neither the entry nor the leg carried a stamp.
+        e = r.get("e2e") if r else None
+        return (isinstance(e, dict) and "p50_ms" not in e
+                and "congestion_p50_ms" in e)
+
+    if any(r and (_legacy_e2e(r)
+                  or not (r.get("captured_utc")
+                          or r.get("device", {}).get("captured_utc")))
            for r in (doc["configs"].get(n) for n, _ in TABLE)):
         lines.append(
-            "\nRows with a blank timestamp are pre-incremental (round-3) "
-            "captures kept until the next healthy tunnel window re-measures "
-            "them; their unthrottled p50/p99 were demoted to `congestion_*` "
-            "in the JSON (they never measured transit).")
+            "\nRows with a blank timestamp — or e2e fps with no p50/p99 — "
+            "are pre-incremental (round-3) captures kept until the next "
+            "healthy tunnel window re-measures that leg; their unthrottled "
+            "p50/p99 were demoted to `congestion_*` in the JSON (they never "
+            "measured transit), and a device-leg re-measurement does not "
+            "refresh them.")
     lines.append(
         "\np50/p99 are RATE-CONTROLLED transit latency (source throttled to "
         "0.8× the measured throughput, ingest queue ≈ one batch) — the "
@@ -401,45 +425,67 @@ def main(argv=None) -> int:
         }
 
     ran = skipped = 0
+
+    def measure_leg(name: str, scale: float, which: str):
+        """Measure one leg of one config; returns False when the tunnel
+        died (caller exits rc=2). Meta (stamp, run mode, workload) lives
+        in the leg dict so each leg carries its own provenance."""
+        nonlocal ran, skipped
+        entry = doc["configs"].setdefault(name, {})
+        if leg_fresh(entry, which, min_fresh, args.quick, args.cpu):
+            skipped += 1
+            return True
+        if not tunnel_ok():
+            return False
+        iters_c = max(3, int(iters * scale))
+        frames_c = max(12, int(frames * scale))
+        t_leg = time.time()
+        _log(f"{name}: {which} (iters={iters_c}, frames={frames_c})…")
+        # e2e gets 2× budget: it is TWO pipeline runs in one child
+        # (throughput, then the rate-controlled latency leg at 0.8× the
+        # measured rate).
+        leg = bench_config(name, env,
+                           args.timeout * (2 if which == "e2e" else 1),
+                           iters_c, frames_c, e2e=(which == "e2e"),
+                           batch=batch)
+        leg.update(captured_utc=_now(), quick=args.quick,
+                   forced_cpu=args.cpu, code_rev=rev, iters=iters_c,
+                   frames=frames_c, wall_s=round(time.time() - t_leg, 1))
+        entry[which] = leg
+        # Migrate any entry-level (pre-leg-schema) provenance down into
+        # the OTHER leg before clearing it: the untouched leg must keep
+        # its stamp/mode (it may still be fresh), and the entry must not
+        # carry a second, contradictory stamp/revision beside the new leg.
+        other = entry.get("e2e" if which == "device" else "device")
+        if isinstance(other, dict) and not other.get("captured_utc"):
+            for k in ("captured_utc", "quick", "forced_cpu", "code_rev",
+                      "iters", "frames"):
+                if k in entry and k not in other:
+                    other[k] = entry[k]
+        for k in ("captured_utc", "quick", "forced_cpu", "code_rev",
+                  "iters", "frames", "wall_s"):
+            entry.pop(k, None)
+        save()
+        ran += 1
+        _log(f"{name}: {which}={leg.get('value', leg.get('error'))}")
+        # The leg may have burned its timeout against a tunnel that died
+        # after its probe — re-check before feeding the next leg.
+        if "error" in leg and not tunnel_ok():
+            return False
+        return True
+
+    # Phase 1 — device legs for every config. These are the VERDICT's
+    # primary ask (per-chip capability + roofline fraction), cost seconds
+    # each on a healthy chip, and are immune to the tunnel's ~20 MB/s
+    # device→host link. A short window lands all of them.
     for name, scale in TABLE:
         if only and name not in only:
             continue
-        if is_fresh(doc["configs"].get(name), min_fresh,
-                    quick=args.quick, forced_cpu=args.cpu):
-            skipped += 1
-            continue
-        if not tunnel_ok():
+        if not measure_leg(name, scale, "device"):
             return 2
-        iters_c = max(3, int(iters * scale))
-        frames_c = max(12, int(frames * scale))
-        entry = {"iters": iters_c, "frames": frames_c, "code_rev": rev,
-                 "quick": args.quick, "forced_cpu": args.cpu}
-        t_row = time.time()
-        _log(f"{name}: device (iters={iters_c})…")
-        entry["device"] = bench_config(name, env, args.timeout, iters_c,
-                                       frames_c, e2e=False, batch=batch)
-        entry["captured_utc"] = _now()
-        doc["configs"][name] = entry
-        save()  # persist the device leg before risking the e2e leg
-        if "error" in entry["device"] and not tunnel_ok():
-            # The leg may have burned its timeout against a tunnel that
-            # died after the row's probe — re-check before feeding the
-            # e2e leg another 420 s.
-            return 2
-        _log(f"{name}: e2e (frames={frames_c})…")
-        # 2× budget: the e2e leg is now TWO pipeline runs in one child
-        # (throughput, then the rate-controlled latency leg at 0.8× the
-        # measured rate) — slow configs that fit 420 s before would
-        # otherwise be SIGKILLed by the second run.
-        entry["e2e"] = bench_config(name, env, 2 * args.timeout, iters_c,
-                                    frames_c, e2e=True, batch=batch)
-        entry["captured_utc"] = _now()
-        entry["wall_s"] = round(time.time() - t_row, 1)
-        save()
-        ran += 1
-        _log(f"{name}: device={entry['device'].get('value', entry['device'].get('error'))} "
-             f"e2e={entry['e2e'].get('value', entry['e2e'].get('error'))}")
 
+    # Phase 2 — implementation A/Bs (device-resident, tunnel-link-immune):
+    # the per-backend winner evidence, ahead of any link-bound e2e leg.
     for cname, (h, w, cbatch, impls) in comparisons.items():
         if comparison_fresh(doc["impl_comparisons"].get(cname), min_fresh,
                             forced_cpu=args.cpu):
@@ -484,6 +530,17 @@ def main(argv=None) -> int:
         comp["winner"] = max(fps, key=fps.get) if any(fps.values()) else "n/a"
         save()
         ran += 1
+
+    # Phase 3 — e2e legs, LAST by design: on the tunneled bench chip each
+    # 1080p e2e leg is bound by the ~20 MB/s device→host link (minutes per
+    # leg for a ~2 fps number that mostly re-validates the link roofline).
+    # A window that closes here has already banked the device rows and the
+    # A/Bs — the evidence the verdict actually asked for.
+    for name, scale in TABLE:
+        if only and name not in only:
+            continue
+        if not measure_leg(name, scale, "e2e"):
+            return 2
 
     doc["wall_s_last_session"] = round(time.time() - t0, 1)
     save()

@@ -177,3 +177,20 @@ def test_run_table_freshness_rules():
     assert not rt.comparison_fresh(dict(comp, forced_cpu=True), "")
     assert rt.comparison_fresh(dict(comp, forced_cpu=True), "",
                                forced_cpu=True)
+
+    # Leg-level schema (phased runner): each leg carries its own stamp and
+    # mode, so a device leg from one session stays fresh while the e2e leg
+    # is still owed — the window-triage property.
+    dev = {"value": 1.0, "captured_utc": "2026-07-30T18:00:00+00:00"}
+    e2e = {"value": 2.0, "captured_utc": "2026-07-30T19:00:00+00:00"}
+    legged = {"device": dev, "e2e": e2e}
+    assert rt.leg_fresh(legged, "device", "2026-07-30T17:00")
+    assert rt.leg_fresh(legged, "e2e", "2026-07-30T18:30")
+    assert not rt.leg_fresh(legged, "device", "2026-07-30T18:30")  # stale
+    assert rt.is_fresh(legged, "2026-07-30T17:00")
+    assert not rt.is_fresh({"device": dev}, "")          # e2e owed
+    assert rt.leg_fresh({"device": dev}, "device", "")   # but device banked
+    # Leg-level mode beats entry-level fallback.
+    cpu_leg = dict(dev, forced_cpu=True)
+    assert not rt.leg_fresh({"device": cpu_leg}, "device", "")
+    assert rt.leg_fresh({"device": cpu_leg}, "device", "", forced_cpu=True)
