@@ -1,0 +1,96 @@
+"""AOT compile-check: do the Pallas kernels LOWER for TPU at table geometry?
+
+Round 3's lesson: interpret-mode tests prove numerics but not Mosaic
+lowering — all four on-chip A/Bs died on the (8,128) output-block tiling
+rule that interpret mode never checks. This script is the cheap guard:
+``jax.jit(...).lower(shapes).compile()`` for every kernel at its
+BENCH_TABLE geometry — no device data transfer, so it fits a tunnel
+window in seconds and can run while other legs stream.
+
+Prints one JSON line: {"backend": ..., "results": {name: "ok"|error}}.
+Exit 0 iff every kernel compiled AND the backend is tpu (a CPU run only
+proves tracing, and says so).
+
+Usage: python benchmarks/pallas_compile_check.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (tracing smoke, e.g. pre-commit)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dvf_tpu.ops.conv import gaussian_kernel_1d
+    from dvf_tpu.ops.pallas_kernels import (
+        bilateral_nhwc_pallas,
+        sep_blur_nhwc_pallas,
+        sobel_bilateral_nhwc_pallas,
+        warp_bounded_pallas,
+    )
+
+    if args.quick:
+        frame = jax.ShapeDtypeStruct((2, 48, 64, 3), jnp.float32)
+        frame720 = jax.ShapeDtypeStruct((2, 48, 64, 3), jnp.float32)
+        flow = jax.ShapeDtypeStruct((2, 48, 64, 2), jnp.float32)
+    else:
+        frame = jax.ShapeDtypeStruct((8, 1080, 1920, 3), jnp.float32)
+        frame720 = jax.ShapeDtypeStruct((4, 720, 1280, 3), jnp.float32)
+        flow = jax.ShapeDtypeStruct((4, 720, 1280, 2), jnp.float32)
+
+    backend = jax.default_backend()
+    # Off-TPU the pltpu primitives (manual DMA, VMEM scratch, semaphores)
+    # cannot lower at all — interpret mode turns the run into the pure
+    # tracing smoke that --quick advertises. Only a tpu-backend run
+    # exercises (and can vouch for) Mosaic lowering.
+    interp = backend != "tpu"
+    k9 = gaussian_kernel_1d(9, 0.0)
+    cases = {
+        "bilateral_1080p": (
+            lambda x: bilateral_nhwc_pallas(x, interpret=interp), (frame,)),
+        "sobel_bilateral_1080p": (
+            lambda x: sobel_bilateral_nhwc_pallas(x, interpret=interp),
+            (frame,)),
+        "gauss9_1080p": (
+            lambda x: sep_blur_nhwc_pallas(x, k9, k9, interpret=interp),
+            (frame,)),
+        "flow_warp_720p": (
+            lambda i, f: warp_bounded_pallas(i, f, interpret=interp),
+            (frame720, flow)),
+    }
+    results = {}
+    for name, (fn, shapes) in cases.items():
+        try:
+            jax.jit(fn).lower(*shapes).compile()
+            results[name] = "ok"
+        except Exception as e:  # noqa: BLE001 — the error IS the datum
+            results[name] = f"{type(e).__name__}: {e}"[:500]
+    print(json.dumps({"backend": backend, "results": results}))
+    ok = all(v == "ok" for v in results.values())
+    if not ok:
+        return 1
+    # --quick is a tracing smoke usable on a CPU dev box; only the full
+    # run claims "lowers on TPU", so only it demands the tpu backend
+    # (rc=3 = clean trace, wrong backend — not evidence).
+    if args.quick or backend == "tpu":
+        return 0
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,8 +69,10 @@ TABLE = [
 # datapoint.
 COMPARISONS = {
     # name → (h, w, batch, [(impl_label, filter_name, cfg_dict)])
+    # impl pinned: get_filter("bilateral") with no config resolves to the
+    # measured per-backend winner, which on TPU IS the pallas kernel.
     "bilateral_1080p": (1080, 1920, 8, [
-        ("jnp", "bilateral", {}),
+        ("jnp", "bilateral", {"impl": "jnp"}),
         ("pallas", "bilateral_pallas", {}),
     ]),
     # impl pinned explicitly: get_filter("sobel_bilateral") with no config
@@ -155,8 +157,11 @@ def bench_impl(fname: str, cfg: dict, iters: int, batch: int, h: int, w: int,
     )
     rc, out, err = _run([sys.executable, "-c", code], env, timeout)
     parsed = _last_json(out)
+    # 15 lines: JAX's traceback filtering puts the actual exception several
+    # lines above its "internal frames removed" banner — 4 lines captured
+    # only the banner for the round-3 flow_warp failure.
     return parsed if parsed else {
-        "error": f"rc={rc}: " + "\n".join(err.strip().splitlines()[-4:])
+        "error": f"rc={rc}: " + "\n".join(err.strip().splitlines()[-15:])
     }
 
 
@@ -288,6 +293,23 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         "| p50 ms | p99 ms | captured (UTC) |",
         "|---|---|---|---|---|---|---|---|---|",
     ]
+    overcounted = False
+
+    def _fmt_roof(v):
+        # XLA's bytes-accessed counts every HLO op's operands+results;
+        # for deep fused programs (flow: hundreds of ops kept in
+        # registers/VMEM) that overcounts real HBM traffic, the derived
+        # "ceiling" is an underestimate, and the fraction exceeds 1 —
+        # the model is not the binding one there (MFU is), so flag it
+        # rather than publish a >1 "fraction of roofline".
+        nonlocal overcounted
+        if v is None:
+            return "—"
+        if v > 1.05:
+            overcounted = True
+            return f"{v} †"
+        return str(v)
+
     for name, _ in TABLE:
         r = doc["configs"].get(name)
         if not r:
@@ -300,7 +322,7 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
                  or r.get("captured_utc") or "")[:16].replace("T", " ")
         lines.append(
             f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
-            f"| {roof if roof is not None else '—'} "
+            f"| {_fmt_roof(roof)} "
             f"| {mfu if mfu is not None else '—'} "
             f"| {e.get('value', 'ERR') if e else '—'} "
             f"| {e.get('p50_ms', '—') if e else '—'} "
@@ -349,8 +371,15 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             lines.append(
                 f"| {impl} | {c.get('fps', 'ERR')} "
                 f"| {c.get('ms_per_frame', '—')} "
-                f"| {c.get('hbm_roofline_frac', '—')} |")
+                f"| {_fmt_roof(c.get('hbm_roofline_frac'))} |")
         lines.append(f"\nWinner: **{comp.get('winner', 'n/a')}**")
+    if overcounted:
+        lines.append(
+            "\n† fraction > 1: XLA's bytes-accessed overcounts HBM traffic "
+            "for deep fused programs (every HLO op's operands+results are "
+            "counted even when fusion keeps them on-chip), so the derived "
+            "ceiling underestimates and the HBM model is not the binding "
+            "one for this config — judge it by MFU / wall time instead.")
     return "\n".join(lines) + "\n"
 
 
@@ -380,7 +409,18 @@ def main(argv=None) -> int:
                     help="comma-separated subset of config/comparison names")
     ap.add_argument("--force", action="store_true",
                     help="rerun everything regardless of freshness")
+    ap.add_argument("--legs", default="device,e2e",
+                    help="which config legs to (re)measure. An impl-default "
+                         "change only moves the device numbers — "
+                         "'--legs device' refreshes those without burning "
+                         "window time re-streaming the link-bound e2e legs")
     args = ap.parse_args(argv)
+    legs = {s for s in args.legs.split(",") if s}
+    if not legs or not legs <= {"device", "e2e"}:
+        # An empty set would silently skip every leg and exit 0 with a
+        # re-rendered-but-stale table — worst thing to do in a scarce
+        # tunnel window.
+        ap.error(f"--legs must name device and/or e2e; got {args.legs!r}")
 
     env = dict(os.environ)
     if args.cpu:
@@ -479,7 +519,7 @@ def main(argv=None) -> int:
     # each on a healthy chip, and are immune to the tunnel's ~20 MB/s
     # device→host link. A short window lands all of them.
     for name, scale in TABLE:
-        if only and name not in only:
+        if only and name not in only or "device" not in legs:
             continue
         if not measure_leg(name, scale, "device"):
             return 2
@@ -537,7 +577,7 @@ def main(argv=None) -> int:
     # A window that closes here has already banked the device rows and the
     # A/Bs — the evidence the verdict actually asked for.
     for name, scale in TABLE:
-        if only and name not in only:
+        if only and name not in only or "e2e" not in legs:
             continue
         if not measure_leg(name, scale, "e2e"):
             return 2

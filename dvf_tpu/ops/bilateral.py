@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import math
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 from dvf_tpu.api.filter import Filter, stateless
-from dvf_tpu.ops.registry import register_filter
+from dvf_tpu.ops.registry import measured_default, register_filter
 
 
 def bilateral_nhwc(
@@ -56,8 +58,28 @@ def bilateral_nhwc(
 
 
 @register_filter("bilateral")
-def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0) -> Filter:
-    """Edge-preserving bilateral smoothing (cv2.bilateralFilter semantics)."""
+def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0,
+              impl: Optional[str] = None) -> Filter:
+    """Edge-preserving bilateral smoothing (cv2.bilateralFilter semantics).
+
+    ``impl=None`` picks the measured per-backend winner: on TPU the Pallas
+    kernel ("pallas", 765 vs 256 fps at 1080p batch 8 — one HBM pass per
+    tile, no spilled shifted views); on CPU the unrolled jnp lowering
+    ("jnp", 3.7 vs 2.0 fps — interpret mode pays per-tile overhead with
+    no VMEM to win back). Provenance: the bilateral_1080p impl-comparison
+    rows in benchmarks/BENCH_TABLE.md (TPU) and benchmarks/cpu/ (CPU).
+    Both impls declare the same halo, so spatial sharding is unaffected.
+    """
+    if impl is None:
+        impl = measured_default({"tpu": "pallas"}, fallback="jnp")
+    if impl == "pallas":
+        from dvf_tpu.ops.registry import get_filter
+
+        return get_filter("bilateral_pallas", d=d, sigma_color=sigma_color,
+                          sigma_space=sigma_space)
+    if impl != "jnp":
+        raise ValueError(f"impl must be 'jnp' or 'pallas', got {impl!r}")
+
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
         return bilateral_nhwc(batch, d=d, sigma_color=sigma_color, sigma_space=sigma_space)
 

@@ -22,17 +22,20 @@ def sobel_bilateral(
 ) -> Filter:
     """BASELINE configs[2]: Sobel edges then bilateral, one device program.
 
-    ``impl=None`` picks the measured per-backend winner: on CPU the fused
-    Pallas program ("pallas", 9.2 vs 3.3 fps at 1080p — it never
-    materializes the chain's intermediates; in interpret mode it lowers
-    to ordinary fused XLA ops, so it is a legitimate production path).
-    "chain" (the two-op jnp chain) remains the default on backends whose
-    A/B hasn't been captured yet. benchmarks/cpu/BENCH_TABLE.md
-    impl-comparison rows are the provenance; both filters declare the
-    same halo, so spatial sharding is unaffected by the choice.
+    ``impl=None`` picks the measured per-backend winner — the fused
+    Pallas program on BOTH measured backends: TPU 1071 vs 226 fps at
+    1080p batch 8 (4.7×: one VMEM residency, no HBM round-trip for the
+    edge map), CPU 9.2 vs 3.3 fps (in interpret mode it lowers to
+    ordinary fused XLA ops, a legitimate production path). "chain" (the
+    two-op jnp chain) remains the default on backends whose A/B hasn't
+    been captured yet. Provenance: the sobel_bilateral_1080p
+    impl-comparison rows in benchmarks/BENCH_TABLE.md (TPU) and
+    benchmarks/cpu/ (CPU); both filters declare the same halo, so
+    spatial sharding is unaffected by the choice.
     """
     if impl is None:
-        impl = measured_default({"cpu": "pallas"}, fallback="chain")
+        impl = measured_default({"cpu": "pallas", "tpu": "pallas"},
+                                fallback="chain")
     if impl == "pallas":
         return get_filter("sobel_bilateral_pallas", d=d,
                           sigma_color=sigma_color, sigma_space=sigma_space,
@@ -41,7 +44,11 @@ def sobel_bilateral(
         raise ValueError(f"impl must be 'chain' or 'pallas', got {impl!r}")
     return FilterChain(
         get_filter("sobel", magnitude_scale=magnitude_scale),
-        get_filter("bilateral", d=d, sigma_color=sigma_color, sigma_space=sigma_space),
+        # impl pinned: "chain" is the A/B's jnp baseline — without the pin
+        # the nested bilateral would itself resolve to the TPU Pallas
+        # winner and the comparison would be pallas vs half-pallas.
+        get_filter("bilateral", d=d, sigma_color=sigma_color,
+                   sigma_space=sigma_space, impl="jnp"),
         name=f"sobel_bilateral(d={d})",
     )
 

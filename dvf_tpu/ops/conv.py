@@ -124,18 +124,21 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0,
                   impl: Optional[str] = None) -> Filter:
     """Separable Gaussian blur matching cv2.GaussianBlur taps.
 
-    ``impl=None`` picks the measured per-backend winner for large kernels:
-    on CPU at ksize≥9 the fused Pallas lowering ("pallas", 15.3 vs
-    9.3 fps at 1080p — one VMEM residency instead of two shifted-FMA
-    passes; interpret mode lowers to ordinary fused XLA ops). "shift"
-    stays the default for small kernels (unmeasured A/B) and for backends
-    whose A/B hasn't been captured. Explicit impl pins (the A/B harness
-    passes "shift"/"depthwise"). Provenance: benchmarks/cpu/BENCH_TABLE.md
-    gauss9 comparison. Halo is ksize//2 for every impl, so spatial
-    sharding is unaffected.
+    ``impl=None`` picks the measured per-backend winner for large
+    kernels — the fused Pallas lowering on BOTH measured backends at
+    ksize≥9: TPU 1726 vs 1027 fps at 1080p batch 8 (1.7× over the
+    shifted-FMA rework), CPU 15.3 vs 9.3 fps (one VMEM residency
+    instead of two passes; interpret mode lowers to ordinary fused XLA
+    ops). "shift" stays the default for small kernels (unmeasured A/B)
+    and for backends whose A/B hasn't been captured. Explicit impl pins
+    (the A/B harness passes "shift"/"depthwise"). Provenance: the
+    gauss9_1080p impl-comparison rows in benchmarks/BENCH_TABLE.md (TPU)
+    and benchmarks/cpu/ (CPU). Halo is ksize//2 for every impl, so
+    spatial sharding is unaffected.
     """
     if impl is None:
-        impl = (measured_default({"cpu": "pallas"}, fallback="shift")
+        impl = (measured_default({"cpu": "pallas", "tpu": "pallas"},
+                                 fallback="shift")
                 if ksize >= 9 else "shift")
     if impl == "pallas":
         return get_filter("gaussian_blur_pallas", ksize=ksize, sigma=sigma)

@@ -27,7 +27,7 @@ control flow under jit (pyramid levels unroll at trace time).
 from __future__ import annotations
 
 import functools
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -36,7 +36,7 @@ from jax import lax
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.ops.conv import sep_conv2d, gaussian_kernel_1d
-from dvf_tpu.ops.registry import register_filter
+from dvf_tpu.ops.registry import measured_default, register_filter
 from dvf_tpu.utils.image import rgb_to_gray
 
 
@@ -256,7 +256,7 @@ def flow_warp(
     win_size: int = 15,
     n_iters: int = 3,
     flow_scale: int = 2,
-    warp_impl: str = "gather",
+    warp_impl: Optional[str] = None,
     max_disp: int = 4,
 ) -> Filter:
     """Motion-compensate each previous frame onto the current one.
@@ -270,7 +270,22 @@ def flow_warp(
     "pallas" = gather-free bounded-displacement kernel
     (:func:`dvf_tpu.ops.pallas_kernels.warp_bounded_pallas`), which clips
     flow to ±``max_disp`` px — the table benchmark compares the two.
+    ``None`` picks the measured per-backend winner: "pallas" on TPU
+    (39.6 vs 17.4 fps at 720p batch 4 — TPU has no fast vector gather),
+    "gather" on CPU (3.1 vs 3.0; and it imposes no displacement clip).
+    Provenance: the flow_warp_720p impl-comparison rows in
+    benchmarks/BENCH_TABLE.md (TPU) and benchmarks/cpu/ (CPU).
+
+    NOTE the TPU default is an APPROXIMATION, unlike the other measured
+    winners (which are numerics-identical): the Pallas warp clips
+    displacements to ±``max_disp`` px (after ``flow_scale`` upsampling
+    doubles magnitudes). At video rates Farneback flows are a few px and
+    the clip is invisible; for fast motion beyond ±max_disp, pin
+    ``warp_impl="gather"`` (full displacement, 2.3× slower on TPU) or
+    raise ``max_disp`` (taps grow as (2·max_disp+2)²).
     """
+    if warp_impl is None:
+        warp_impl = measured_default({"tpu": "pallas"}, fallback="gather")
     if warp_impl not in ("gather", "pallas"):
         raise ValueError(f"warp_impl must be 'gather' or 'pallas', got {warp_impl!r}")
 

@@ -27,6 +27,9 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
 - grid = (batch, H tiles); each step DMAs a (C, tile_h + 2r, W + 2r) slab
   from HBM (kept in ANY space) into a VMEM scratch, computes the tile's
   core rows, and writes a (C, tile_h, W) output block;
+- tile_h is 8-row aligned (or whole-H): Mosaic requires output blocks
+  whose second-to-last dim is a multiple of the f32 sublane tile — see
+  :func:`_pick_tile_h`, which pads H when no aligned divisor exists;
 - all window shifts are static python-int slices — fully unrolled at trace
   time, no data-dependent control flow;
 - accumulation in float32 regardless of I/O dtype.
@@ -56,12 +59,91 @@ def _auto_interpret(interpret):
     return interpret
 
 
-def _pick_tile_h(h: int, target: int = 16) -> int:
-    """Largest divisor of h that is <= target (grid must tile H exactly)."""
-    for th in range(min(target, h), 0, -1):
+_TILE_TARGET = 32  # rows per program; multiple of the f32 sublane tile (8)
+_SUBLANE = 8       # f32 sublane tile: DMA slice rows must be multiples
+_LANE = 128        # lane tile: DMA slice cols must be multiples (or full)
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _slab_rows(th: int, halo2: int) -> int:
+    """DMA slab row extent: tile + two-sided halo, rounded up to the
+    sublane tile (Mosaic rejects unaligned ``tpu.memref_slice`` extents;
+    the spare rows are DMA'd but never read)."""
+    return _round_up(th + halo2, _SUBLANE)
+
+
+def _extra_rows(h: int, h_pad: int, th: int, halo2: int) -> int:
+    """Bottom padding beyond the halo so the LAST grid step's slab
+    ``[h_pad - th, h_pad - th + slab_rows)`` is in-bounds."""
+    return (h_pad - th + _slab_rows(th, halo2)) - (h + halo2)
+
+
+def _pick_tile_h(h: int, target: int = _TILE_TARGET) -> tuple:
+    """``(tile_h, padded_h)`` for the TPU grid over H.
+
+    Mosaic requires an output block's second-to-last dim to be a multiple
+    of the 8-row f32 sublane tile — or the whole dimension.  (The round-3
+    on-chip A/Bs all died on exactly this: a 15-row tile over H=1080.)
+    Preference order: the largest 8-aligned divisor of ``h`` that is
+    ≤ ``target`` (no padding); a short image as one whole-H tile (legal at
+    any h); else — h > target with no 8-aligned divisor, e.g. 540 = 4·135
+    — pad H up to a tile multiple and let the caller slice the pad off.
+    Tile choice never affects numerics, only the grid.
+    """
+    if h <= target:
+        return h, h
+    for th in range(target - target % 8, 7, -8):
         if h % th == 0:
-            return th
-    return 1
+            return th, h
+    th = target - target % 8 or 8
+    return th, ((h + th - 1) // th) * th
+
+
+def _resolve_tile_h(h: int, tile_h: Optional[int],
+                    target: int = _TILE_TARGET,
+                    compiled: bool = True) -> tuple:
+    """Caller-pinned tile (must divide h — the pre-round-4 contract) or
+    the auto ``(tile_h, padded_h)`` pick aiming at ``target`` rows.
+
+    A ``compiled`` (non-interpret) pin must also satisfy Mosaic's 8-row
+    sublane rule — rejecting it here with a clear message beats the
+    opaque lowering error the same pin produced in round 3 (tile 15 over
+    H=1080). Interpret mode has no such constraint, so any divisor stays
+    legal there."""
+    if tile_h is not None:
+        if h % tile_h != 0:
+            raise ValueError(f"tile_h {tile_h} must divide H {h}")
+        if compiled and tile_h != h and tile_h % _SUBLANE != 0:
+            raise ValueError(
+                f"compiled TPU kernels need tile_h to be a multiple of "
+                f"{_SUBLANE} or the whole H; got {tile_h} (H={h})")
+        return tile_h, h
+    return _pick_tile_h(h, target)
+
+
+def _pad_rows(x: jnp.ndarray, extra: int) -> jnp.ndarray:
+    """Append ``extra`` edge-value rows to NCHW ``x`` (dim 2) so the grid
+    tiles exactly and every DMA slab is in-bounds; the values never reach
+    a valid output row (each output row y reads input rows y..y+2r, all
+    < h+2r) and the pad is sliced off after the kernel."""
+    if extra == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, extra), (0, 0)), mode="edge")
+
+
+def _pad_cols(x: jnp.ndarray, extra: int) -> jnp.ndarray:
+    """Append ``extra`` edge-value cols to NCHW ``x`` (dim 3): the DMA
+    slab copies the input's FULL width, so the width itself must be
+    lane-aligned — Mosaic rejects ``tpu.memref_slice`` extents that are
+    not multiples of the (8, 128) tile (the round-4 on-chip failure mode
+    after block alignment was fixed). Valid output col x reads cols
+    x..x+2r < w+2r, never the pad."""
+    if extra == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, extra)), mode="edge")
 
 
 def _bilateral_kernel(tile_h: int, r: int, w: int, c: int, sigma_color: float, sigma_space: float):
@@ -73,11 +155,13 @@ def _bilateral_kernel(tile_h: int, r: int, w: int, c: int, sigma_color: float, s
         for dy in range(-r, r + 1)
     ]
 
+    slab = _slab_rows(tile_h, 2 * r)
+
     def kernel(in_ref, out_ref, scratch, sem):
         b = pl.program_id(0)
         i = pl.program_id(1)
         copy = pltpu.make_async_copy(
-            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * r), :],
+            in_ref.at[b, :, pl.ds(i * tile_h, slab), :],
             scratch,
             sem,
         )
@@ -114,27 +198,28 @@ def bilateral_nhwc_pallas(
         raise ValueError(f"window d must be odd, got {d}")
     r = d // 2
     b, h, w, c = batch.shape
-    th = tile_h if tile_h is not None else _pick_tile_h(h)
-    if h % th != 0:
-        raise ValueError(f"tile_h {th} must divide H {h}")
+    th, h_pad = _resolve_tile_h(h, tile_h, compiled=not interpret)
+    w_al = _round_up(w + 2 * r, _LANE)
 
     x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
     x = jnp.pad(x, ((0, 0), (0, 0), (r, r), (r, r)), mode="reflect")
+    x = _pad_rows(x, _extra_rows(h, h_pad, th, 2 * r))
+    x = _pad_cols(x, w_al - (w + 2 * r))
 
     kernel = _bilateral_kernel(th, r, w, c, sigma_color, sigma_space)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // th),
+        grid=(b, h_pad // th),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, c, h_pad, w), batch.dtype),
         scratch_shapes=[
-            pltpu.VMEM((c, th + 2 * r, w + 2 * r), jnp.float32),
+            pltpu.VMEM((c, _slab_rows(th, 2 * r), w_al), jnp.float32),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )(x)
-    return jnp.transpose(out, (0, 2, 3, 1))
+    return jnp.transpose(out[:, :, :h, :], (0, 2, 3, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -144,22 +229,23 @@ def bilateral_nhwc_pallas(
 
 def _warp_kernel(tile_h: int, R: int, w: int, c: int):
     Rp = R + 1  # fy=R needs taps floor(R)..floor(R)+1 = R..R+1
+    slab = _slab_rows(tile_h, 2 * Rp)
 
     def kernel(img_ref, flow_ref, out_ref, scratch, fscratch, sem_i, sem_f):
         b = pl.program_id(0)
         i = pl.program_id(1)
         ci = pltpu.make_async_copy(
-            img_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * Rp), :],
+            img_ref.at[b, :, pl.ds(i * tile_h, slab), :],
             scratch, sem_i)
         cf = pltpu.make_async_copy(
-            flow_ref.at[b, :, pl.ds(i * tile_h, tile_h), :],
+            flow_ref.at[b, :, pl.ds(i * tile_h, _round_up(tile_h, _SUBLANE)), :],
             fscratch, sem_f)
         ci.start()
         cf.start()
         ci.wait()
         cf.wait()
-        img = scratch[...].astype(jnp.float32)     # (c, th+2Rp, w+2Rp)
-        fl = fscratch[...].astype(jnp.float32)     # (2, th, w)
+        img = scratch[...].astype(jnp.float32)     # (c, slab, w_al)
+        fl = fscratch[...].astype(jnp.float32)[:, :tile_h, :w]  # (2, th, w)
         fx = jnp.clip(fl[0], -R, R)
         fy = jnp.clip(fl[1], -R, R)
         acc = jnp.zeros((c, tile_h, w), jnp.float32)
@@ -200,31 +286,43 @@ def warp_bounded_pallas(
         raise ValueError("max_disp must be >= 1")
     Rp = R + 1
     b, h, w, c = img.shape
-    th = tile_h if tile_h is not None else _pick_tile_h(h)
-    if h % th != 0:
-        raise ValueError(f"tile_h {th} must divide H {h}")
+    # Smaller tile than the stencils: the (2R+2)² unrolled hat taps give
+    # Mosaic ~per-tap temporaries, and at tile 24 / R=4 the scoped-VMEM
+    # stack hit 26 MB vs the default 16 MB limit on v5e. 16 rows halves
+    # the liveness; the raised vmem_limit_bytes below covers the rest
+    # (v5e has 128 MiB of VMEM; the default limit is a conservative 16).
+    th, h_pad = _resolve_tile_h(h, tile_h, target=16,
+                                compiled=not interpret)
+    w_al = _round_up(w + 2 * Rp, _LANE)
+    w_fl = _round_up(w, _LANE)  # the flow DMA copies full width too
 
     x = jnp.transpose(img, (0, 3, 1, 2))                    # (b,c,h,w)
     x = jnp.pad(x, ((0, 0), (0, 0), (Rp, Rp), (Rp, Rp)), mode="edge")
+    x = _pad_rows(x, _extra_rows(h, h_pad, th, 2 * Rp))
+    x = _pad_cols(x, w_al - (w + 2 * Rp))
     fl = jnp.transpose(flow, (0, 3, 1, 2))                  # (b,2,h,w)
+    fl = _pad_rows(fl, h_pad - h + _round_up(th, _SUBLANE) - th)
+    fl = _pad_cols(fl, w_fl - w)
 
     kernel = _warp_kernel(th, R, w, c)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // th),
+        grid=(b, h_pad // th),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, c, h, w), img.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, c, h_pad, w), img.dtype),
         scratch_shapes=[
-            pltpu.VMEM((c, th + 2 * Rp, w + 2 * Rp), jnp.float32),
-            pltpu.VMEM((2, th, w), jnp.float32),
+            pltpu.VMEM((c, _slab_rows(th, 2 * Rp), w_al), jnp.float32),
+            pltpu.VMEM((2, _round_up(th, _SUBLANE), w_fl), jnp.float32),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(x, fl)
-    return jnp.transpose(out, (0, 2, 3, 1))
+    return jnp.transpose(out[:, :, :h, :], (0, 2, 3, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -233,11 +331,13 @@ def warp_bounded_pallas(
 
 
 def _sep_blur_kernel(tile_h: int, rh: int, rw: int, w: int, kh_taps, kw_taps):
+    slab = _slab_rows(tile_h, 2 * rh)
+
     def kernel(in_ref, out_ref, scratch, sem):
         b = pl.program_id(0)
         i = pl.program_id(1)
         copy = pltpu.make_async_copy(
-            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * rh), :],
+            in_ref.at[b, :, pl.ds(i * tile_h, slab), :],
             scratch,
             sem,
         )
@@ -274,27 +374,28 @@ def sep_blur_nhwc_pallas(
     kw_taps = [float(v) for v in np.asarray(kw)]
     rh, rw = len(kh_taps) // 2, len(kw_taps) // 2
     b, h, w, c = batch.shape
-    th = tile_h if tile_h is not None else _pick_tile_h(h)
-    if h % th != 0:
-        raise ValueError(f"tile_h {th} must divide H {h}")
+    th, h_pad = _resolve_tile_h(h, tile_h, compiled=not interpret)
+    w_al = _round_up(w + 2 * rw, _LANE)
 
     x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
     x = jnp.pad(x, ((0, 0), (0, 0), (rh, rh), (rw, rw)), mode="reflect")
+    x = _pad_rows(x, _extra_rows(h, h_pad, th, 2 * rh))
+    x = _pad_cols(x, w_al - (w + 2 * rw))
 
     kernel = _sep_blur_kernel(th, rh, rw, w, kh_taps, kw_taps)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // th),
+        grid=(b, h_pad // th),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, c, h_pad, w), batch.dtype),
         scratch_shapes=[
-            pltpu.VMEM((c, th + 2 * rh, w + 2 * rw), jnp.float32),
+            pltpu.VMEM((c, _slab_rows(th, 2 * rh), w_al), jnp.float32),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )(x)
-    return jnp.transpose(out, (0, 2, 3, 1))
+    return jnp.transpose(out[:, :, :h, :], (0, 2, 3, 1))
 
 
 @register_filter("gaussian_blur_pallas")
@@ -339,17 +440,19 @@ def _sobel_bilateral_kernel(tile_h: int, r: int, w: int, c: int,
         for dy in range(-r, r + 1)
     ]
 
+    slab = _slab_rows(tile_h, 2 * R)
+
     def kernel(in_ref, out_ref, scratch, sem):
         b = pl.program_id(0)
         i = pl.program_id(1)
         copy = pltpu.make_async_copy(
-            in_ref.at[b, :, pl.ds(i * tile_h, tile_h + 2 * R), :],
+            in_ref.at[b, :, pl.ds(i * tile_h, slab), :],
             scratch,
             sem,
         )
         copy.start()
         copy.wait()
-        x = scratch[...].astype(jnp.float32)      # (c, th+2R, w+2R)
+        x = scratch[...].astype(jnp.float32)      # (c, slab, w_al)
         gray = _LUMA[0] * x[0] + _LUMA[1] * x[1] + _LUMA[2] * x[2]
         # Sobel (ksize=3, conv taps [1,2,1]⊗[-1,0,1]) on the full slab:
         # valid region shrinks by 1 each side → (th+2r, w+2r).
@@ -392,28 +495,29 @@ def sobel_bilateral_nhwc_pallas(
     r = d // 2
     R = r + 1
     b, h, w, c = batch.shape
-    th = tile_h if tile_h is not None else _pick_tile_h(h)
-    if h % th != 0:
-        raise ValueError(f"tile_h {th} must divide H {h}")
+    th, h_pad = _resolve_tile_h(h, tile_h, compiled=not interpret)
+    w_al = _round_up(w + 2 * R, _LANE)
 
     x = jnp.transpose(batch, (0, 3, 1, 2))  # NCHW: W on lanes
     x = jnp.pad(x, ((0, 0), (0, 0), (R, R), (R, R)), mode="reflect")
+    x = _pad_rows(x, _extra_rows(h, h_pad, th, 2 * R))
+    x = _pad_cols(x, w_al - (w + 2 * R))
 
     kernel = _sobel_bilateral_kernel(th, r, w, c, sigma_color, sigma_space,
                                      magnitude_scale)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // th),
+        grid=(b, h_pad // th),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, c, th, w), lambda bb, ii: (bb, 0, ii, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, c, h, w), batch.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, c, h_pad, w), batch.dtype),
         scratch_shapes=[
-            pltpu.VMEM((c, th + 2 * R, w + 2 * R), jnp.float32),
+            pltpu.VMEM((c, _slab_rows(th, 2 * R), w_al), jnp.float32),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )(x)
-    return jnp.transpose(out, (0, 2, 3, 1))
+    return jnp.transpose(out[:, :, :h, :], (0, 2, 3, 1))
 
 
 @register_filter("sobel_bilateral_pallas")

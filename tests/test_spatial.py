@@ -189,9 +189,55 @@ def test_pallas_bilateral_params(batch):
 
 
 def test_pallas_tile_picker():
-    assert _pick_tile_h(1080) == 15      # largest divisor of 1080 <= 16
-    assert _pick_tile_h(32) == 16
-    assert _pick_tile_h(7) == 7
+    # Mosaic rejects output blocks whose second-to-last dim is neither a
+    # multiple of the 8-row sublane tile nor the whole dimension (the
+    # round-3 on-chip A/Bs all ERR'd on tile 15 over 1080) — every pick
+    # must be 8-aligned, whole-H, or trigger row padding.
+    assert _pick_tile_h(1080) == (24, 1080)   # largest 8-aligned divisor
+    assert _pick_tile_h(720) == (24, 720)
+    assert _pick_tile_h(32) == (32, 32)       # short image: one whole tile
+    assert _pick_tile_h(7) == (7, 7)
+    assert _pick_tile_h(540) == (32, 544)     # no aligned divisor: pad
+    assert _pick_tile_h(68) == (32, 96)
+
+
+def test_pallas_bilateral_padded_rows():
+    """H with no 8-aligned divisor exercises the row-padding path; the
+    pad must be invisible in the output (sliced off, never read by a
+    valid row)."""
+    rng = np.random.default_rng(7)
+    batch = jnp.asarray(rng.random((1, 68, 40, 3), dtype=np.float32))
+    want = bilateral_nhwc(batch)
+    got = bilateral_nhwc_pallas(batch, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_pallas_sep_blur_padded_rows():
+    """Row/col alignment-padding path of the fused separable blur (H=68
+    has no 8-aligned divisor; W=40 is no lane multiple)."""
+    from dvf_tpu.ops.conv import gaussian_kernel_1d, sep_conv2d
+    from dvf_tpu.ops.pallas_kernels import sep_blur_nhwc_pallas
+
+    rng = np.random.default_rng(11)
+    batch = jnp.asarray(rng.random((1, 68, 40, 3), dtype=np.float32))
+    kern = gaussian_kernel_1d(9, 0.0)
+    want = sep_conv2d(batch, kern, kern)
+    got = sep_blur_nhwc_pallas(batch, kern, kern, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_pallas_fused_sobel_bilateral_padded_rows():
+    """Same padded path for the fused kernel — it is the one kernel that
+    slices relative to the (now oversized) slab END for Sobel, so border
+    rows at an unaligned H are the regression surface."""
+    from dvf_tpu.ops.pallas_kernels import sobel_bilateral_nhwc_pallas
+
+    rng = np.random.default_rng(13)
+    batch = jnp.asarray(rng.random((1, 68, 40, 3), dtype=np.float32))
+    chain = get_filter("sobel_bilateral", impl="chain")
+    want, _ = chain.fn(batch, None)
+    got = sobel_bilateral_nhwc_pallas(batch, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
 def test_pallas_filter_registered(batch):
@@ -230,6 +276,22 @@ def test_pallas_warp_matches_gather_golden(rng):
 
     img = rng.random((2, 24, 32, 3)).astype(np.float32)
     flow = (rng.random((2, 24, 32, 2)).astype(np.float32) - 0.5) * 7.0
+    want = warp_by_flow(jnp.asarray(img), jnp.clip(jnp.asarray(flow), -4, 4))
+    got = warp_bounded_pallas(jnp.asarray(img), jnp.asarray(flow),
+                              max_disp=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-6)
+
+
+def test_pallas_warp_unaligned_height_and_width(rng):
+    """H with no 8-aligned divisor + W that is no lane multiple exercise
+    both alignment-padding paths (incl. the flow input's col pad — the
+    flow DMA copies full width, so its width must be lane-aligned on
+    TPU; round-4 code-review finding)."""
+    from dvf_tpu.ops.flow import warp_by_flow
+    from dvf_tpu.ops.pallas_kernels import warp_bounded_pallas
+
+    img = rng.random((2, 36, 40, 3)).astype(np.float32)
+    flow = (rng.random((2, 36, 40, 2)).astype(np.float32) - 0.5) * 6.0
     want = warp_by_flow(jnp.asarray(img), jnp.clip(jnp.asarray(flow), -4, 4))
     got = warp_bounded_pallas(jnp.asarray(img), jnp.asarray(flow),
                               max_disp=4, interpret=True)
