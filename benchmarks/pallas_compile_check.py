@@ -60,6 +60,7 @@ def main(argv=None) -> int:
     # exercises (and can vouch for) Mosaic lowering.
     interp = backend != "tpu"
     k9 = gaussian_kernel_1d(9, 0.0)
+    k3 = gaussian_kernel_1d(3, 0.0)
     cases = {
         "bilateral_1080p": (
             lambda x: bilateral_nhwc_pallas(x, interpret=interp), (frame,)),
@@ -68,6 +69,12 @@ def main(argv=None) -> int:
             (frame,)),
         "gauss9_1080p": (
             lambda x: sep_blur_nhwc_pallas(x, k9, k9, interpret=interp),
+            (frame,)),
+        # ksize=3 is a published table config (gauss3_1080p A/B) with a
+        # different halo → different DMA slab extents — the exact failure
+        # class this guard exists for.
+        "gauss3_1080p": (
+            lambda x: sep_blur_nhwc_pallas(x, k3, k3, interpret=interp),
             (frame,)),
         "flow_warp_720p": (
             lambda i, f: warp_bounded_pallas(i, f, interpret=interp),

@@ -91,6 +91,12 @@ COMPARISONS = {
         ("depthwise", "gaussian_blur", {"ksize": 9, "impl": "depthwise"}),
         ("pallas_fused", "gaussian_blur_pallas", {"ksize": 9}),
     ]),
+    # The small-kernel half of BASELINE configs[1]: the ksize<9 default
+    # ("shift") was assumed, not measured, until this A/B.
+    "gauss3_1080p": (1080, 1920, 8, [
+        ("shift", "gaussian_blur", {"ksize": 3, "impl": "shift"}),
+        ("pallas_fused", "gaussian_blur_pallas", {"ksize": 3}),
+    ]),
 }
 
 

@@ -129,8 +129,12 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0,
     ksize≥9: TPU 1726 vs 1027 fps at 1080p batch 8 (1.7× over the
     shifted-FMA rework), CPU 15.3 vs 9.3 fps (one VMEM residency
     instead of two passes; interpret mode lowers to ordinary fused XLA
-    ops). "shift" stays the default for small kernels (unmeasured A/B)
-    and for backends whose A/B hasn't been captured. Explicit impl pins
+    ops). "shift" stays the default for small kernels — MEASURED, not
+    assumed, since round 4: the gauss3_1080p TPU A/B has shift at 1861 vs
+    pallas 1591 fps (at 3 taps XLA's single fused pass is already one HBM
+    round-trip, and the Pallas kernel's DMA-slab staging costs more than
+    the fusion saves) — and for backends whose A/B hasn't been captured.
+    Explicit impl pins
     (the A/B harness passes "shift"/"depthwise"). Provenance: the
     gauss9_1080p impl-comparison rows in benchmarks/BENCH_TABLE.md (TPU)
     and benchmarks/cpu/ (CPU). Halo is ksize//2 for every impl, so
