@@ -248,6 +248,18 @@ def leg_fresh(entry: dict, leg: str, min_fresh: str, quick: bool = False,
     if (d.get("quick", entry.get("quick", False)) != quick
             or d.get("forced_cpu", entry.get("forced_cpu", False)) != forced_cpu):
         return False
+    # Methodology gate: an e2e leg that published percentiles without the
+    # congestion verdict predates the backoff-verified latency leg (the
+    # 0.8×-target run could silently congest and report queue residency as
+    # transit) — stale regardless of stamp, so the next session re-measures
+    # it with the congestion-checked harness.
+    if leg == "e2e" and "p50_ms" in d and "lat_congested" not in d:
+        return False
+    # A congested capture is an upper bound, not transit — keep it (it
+    # renders with the ‡ mark) but never let it satisfy freshness, so a
+    # later, healthier window replaces it with an honest measurement.
+    if leg == "e2e" and d.get("lat_congested"):
+        return False
     stamp = d.get("captured_utc") or entry.get("captured_utc", "")
     if not stamp:
         return False
@@ -326,13 +338,14 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         mfu = d.get("mfu")
         stamp = ((d.get("captured_utc") if isinstance(d, dict) else "")
                  or r.get("captured_utc") or "")[:16].replace("T", " ")
+        cong = " ‡" if e and e.get("lat_congested") else ""
         lines.append(
             f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
             f"| {_fmt_roof(roof)} "
             f"| {mfu if mfu is not None else '—'} "
             f"| {e.get('value', 'ERR') if e else '—'} "
-            f"| {e.get('p50_ms', '—') if e else '—'} "
-            f"| {e.get('p99_ms', '—') if e else '—'} | {stamp} |"
+            f"| {str(e.get('p50_ms', '—')) + cong if e else '—'} "
+            f"| {str(e.get('p99_ms', '—')) + cong if e else '—'} | {stamp} |"
         )
     def _legacy_e2e(r):
         # Demoted legacy e2e: load_doc renamed its p50/p99 to congestion_*
@@ -354,7 +367,13 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             "refresh them.")
     lines.append(
         "\np50/p99 are RATE-CONTROLLED transit latency (source throttled to "
-        "0.8× the measured throughput, ingest queue ≈ one batch) — the "
+        "0.8× the measured throughput, ingest queue ≈ one batch), VERIFIED "
+        "uncongested: the leg checks the bounded drop-oldest ingest queue "
+        "recorded no drops (the direct congestion signal), halving the "
+        "rate up to twice until it did. ‡ = still congested at the lowest "
+        "tried rate (the "
+        "link's capacity flapped below it mid-leg) — that p50 includes "
+        "standing-queue wait and is an upper bound, not transit. The "
         "congestion percentiles of the unthrottled run are kept only in the "
         "JSON under `congestion_*`. 'HBM roofline' = measured device fps / "
         "(819 GB/s ÷ XLA-reported HBM bytes per frame) — the right model "
@@ -487,11 +506,12 @@ def main(argv=None) -> int:
         frames_c = max(12, int(frames * scale))
         t_leg = time.time()
         _log(f"{name}: {which} (iters={iters_c}, frames={frames_c})…")
-        # e2e gets 2× budget: it is TWO pipeline runs in one child
+        # e2e gets 4× budget: it is up to FOUR pipeline runs in one child
         # (throughput, then the rate-controlled latency leg at 0.8× the
-        # measured rate).
+        # measured rate, which halves-and-retries up to twice when the
+        # stream congests — each retry ≈ one original-leg wall).
         leg = bench_config(name, env,
-                           args.timeout * (2 if which == "e2e" else 1),
+                           args.timeout * (4 if which == "e2e" else 1),
                            iters_c, frames_c, e2e=(which == "e2e"),
                            batch=batch)
         leg.update(captured_utc=_now(), quick=args.quick,

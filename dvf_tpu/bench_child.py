@@ -282,9 +282,13 @@ def main(argv=None) -> int:
             p99_ms=round(rl["p99_ms"], 2),
             lat_frames=rl["frames"],
             lat_batch=args.lat_batch,
-            lat_target_fps=round(target, 1),
+            lat_target_fps=round(rl["target_fps"], 1),
+            lat_congested=rl["congested"],
+            lat_backoffs=rl["backoffs"],
         )
-        _log(f"latency done: p50={result['p50_ms']}ms p99={result['p99_ms']}ms")
+        _log(f"latency done: p50={result['p50_ms']}ms p99={result['p99_ms']}ms "
+             f"(target {result['lat_target_fps']} fps after "
+             f"{rl['backoffs']} backoffs, congested={rl['congested']})")
 
     print(json.dumps(result), flush=True)
     return 0

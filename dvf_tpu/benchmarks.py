@@ -345,6 +345,29 @@ def bench_e2e_streaming(
     )
 
 
+def stream_congested(fps: float, target_fps: float, dropped: int,
+                     frames: int) -> bool:
+    """Was a rate-controlled run congested (offered rate > capacity)?
+
+    The signal is INGEST DROPS, not wall-clock fps vs target: with the
+    latency config's bounded drop-oldest queue (one batch) a paced source
+    that outruns service fills the queue within one batch period and drops
+    from then on, so sustained congestion always shows up in the counter —
+    while wall-fps systematically under-measures short legs (thread
+    startup, first-batch dispatch, drain are amortized over few frames)
+    and flagged healthy runs as congested. Exactly one drop is forgiven
+    (startup race while the ingest thread warms) — no percentage
+    allowance: a steady trickle of drops means the queue sat full for a
+    stretch and the percentiles absorbed queue residency, which is
+    precisely what the published 'verified uncongested' claim rules out.
+    ``fps``/``frames`` still guard the degenerate no-delivery case."""
+    if target_fps <= 0:
+        return True
+    if frames <= 0 or fps <= 0:
+        return True
+    return dropped > 1
+
+
 def bench_e2e_latency(
     filt: Filter,
     n_frames: int,
@@ -357,6 +380,7 @@ def bench_e2e_latency(
     transport: str = "python",
     wire: str = "raw",
     mesh=None,
+    max_backoffs: int = 2,
 ) -> dict:
     """Latency mode: source throttled to ``target_fps`` (pick ~0.8× the
     measured throughput), ingest queue bounded to one batch, shallow
@@ -364,16 +388,45 @@ def bench_e2e_latency(
     un-congested stream, the half of the north star the throughput run
     can't speak to. ``transport``/``wire`` select the same ingest path as
     the throughput mode — a ring/jpeg run's published transit MUST include
-    the ring hop and codec cost it is labeled with."""
+    the ring hop and codec cost it is labeled with.
+
+    Capacity is a measurement with variance (on a tunnel-attached chip the
+    link's capacity itself flaps between the throughput and latency legs),
+    so 0.8× the measured throughput can still exceed the TRUE capacity of
+    the latency leg — the stream then congests and the percentiles silently
+    become queue-residency numbers (round-3 verdict, weak item 1, second
+    occurrence). This is now detected (:func:`stream_congested`) and the
+    leg automatically backs off — halving ``target_fps`` up to
+    ``max_backoffs`` times — until the pipeline provably kept up. The
+    returned dict carries the verdict: ``congested`` (final run),
+    ``target_fps`` (the rate actually measured) and ``backoffs``."""
     from dvf_tpu.io.sources import SyntheticSource
 
-    r = _run_pipeline(
-        filt,
-        SyntheticSource(height=height, width=width, n_frames=n_frames,
-                        rate=target_fps),
-        batch_size, height, width, max_inflight,
-        queue_size=batch_size,
-        collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
-    )
-    r["target_fps"] = target_fps
-    return r
+    attempts = 0
+    while True:
+        r = _run_pipeline(
+            filt,
+            SyntheticSource(height=height, width=width, n_frames=n_frames,
+                            rate=target_fps),
+            batch_size, height, width, max_inflight,
+            queue_size=batch_size,
+            collect_mode=collect_mode, transport=transport, wire=wire,
+            mesh=mesh,
+        )
+        congested = stream_congested(r["fps"], target_fps, r["dropped"],
+                                     r["frames"])
+        if not congested or attempts >= max_backoffs:
+            r["target_fps"] = target_fps
+            r["congested"] = congested
+            r["backoffs"] = attempts
+            return r
+        attempts += 1
+        target_fps = target_fps / 2.0
+        # Keep the retry's wall time ≈ the original budget: half the rate
+        # with the same frame count would double it per backoff. The floor
+        # is a small absolute minimum, NOT batch-derived — a batch-derived
+        # floor (2×batch+8) could RAISE the count above the original leg's
+        # and multiply wall time on exactly the slow links that back off
+        # (the deadline assembler dispatches partial batches, so percentiles
+        # from fewer-than-a-batch frames still measure transit).
+        n_frames = max(16, n_frames // 2)

@@ -507,7 +507,12 @@ def cmd_bench(args) -> int:
             # published latency is pipeline transit, not standing queue
             # depth. The unthrottled run's percentiles measure congestion
             # and are reported only under the explicit congestion_* names
-            # (VERDICT r3 weak 1).
+            # (VERDICT r3 weak 1). The leg verifies the pipeline actually
+            # kept up (no ingest drops — the direct congestion signal of
+            # the bounded drop-oldest queue) and halves the rate until it
+            # does — lat_congested=True means even the lowest tried rate
+            # congested and the percentiles are an upper bound, not
+            # transit.
             target = 0.8 * r["fps"]
             lat_frames = args.lat_frames or min(
                 args.frames, max(16, int(target * 20.0)))
@@ -519,7 +524,9 @@ def cmd_bench(args) -> int:
                 p50_ms=round(rl["p50_ms"], 3),
                 p99_ms=round(rl["p99_ms"], 3),
                 lat_frames=rl["frames"],
-                lat_target_fps=round(target, 1),
+                lat_target_fps=round(rl["target_fps"], 1),
+                lat_congested=rl["congested"],
+                lat_backoffs=rl["backoffs"],
             )
         out.update(
             congestion_p50_ms=round(r["p50_ms"], 3),

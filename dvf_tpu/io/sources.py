@@ -19,6 +19,23 @@ import numpy as np
 Frame = Tuple[Optional[np.ndarray], float]
 
 
+def _pace(next_t: float, period: float) -> float:
+    """Sleep until ``next_t``; return the following due time.
+
+    Drift-free on the normal path (the schedule advances by exactly one
+    period, using the PRE-sleep clock — a post-sleep reading would
+    accumulate sleep overshoot and systematically under-deliver at high
+    rates) — but with no catch-up burst after a consumer stall
+    (backpressure, jit warm-up): the next frame is due one full period
+    after the LATER of the schedule and now, never immediately. Bursting
+    to repay a stall would congest the very stream bench_e2e_latency is
+    rate-controlling."""
+    now = time.perf_counter()
+    if now < next_t:
+        time.sleep(next_t - now)
+    return max(next_t, now) + period
+
+
 class SyntheticSource:
     """Procedural moving-gradient frames — deterministic, camera-free.
 
@@ -86,10 +103,7 @@ class SyntheticSource:
         n_cycle = len(self._cycle)
         for i in range(self.n_frames):
             if period:
-                now = time.perf_counter()
-                if now < next_t:
-                    time.sleep(next_t - now)
-                next_t += period
+                next_t = _pace(next_t, period)
             yield self._cycle[i % n_cycle], time.time()
         yield None, time.time()
 
@@ -135,10 +149,7 @@ class VideoFileSource:
             ok, frame = cap.read()
             while ok:
                 if period:
-                    now = time.perf_counter()
-                    if now < next_t:
-                        time.sleep(next_t - now)
-                    next_t += period
+                    next_t = _pace(next_t, period)
                 rgb = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
                 if self.target_size:
                     rgb = center_square(rgb, self.target_size)

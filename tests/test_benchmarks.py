@@ -124,7 +124,7 @@ def test_bench_child_probe_mode():
     assert line["probe_sum"] == 28.0  # sum(range(8)) — the chip executed
 
 
-def test_run_table_freshness_rules():
+def _load_run_table_module():
     import importlib.util
     import os
 
@@ -133,6 +133,11 @@ def test_run_table_freshness_rules():
                                   "benchmarks", "run_table.py"))
     rt = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(rt)
+    return rt
+
+
+def test_run_table_freshness_rules():
+    rt = _load_run_table_module()
 
     good = {"device": {"value": 1.0}, "e2e": {"value": 1.0},
             "captured_utc": "2026-07-30T10:00:00+00:00"}
@@ -194,3 +199,111 @@ def test_run_table_freshness_rules():
     cpu_leg = dict(dev, forced_cpu=True)
     assert not rt.leg_fresh({"device": cpu_leg}, "device", "")
     assert rt.leg_fresh({"device": cpu_leg}, "device", "", forced_cpu=True)
+
+
+def test_stream_congested_verdicts():
+    from dvf_tpu.benchmarks import stream_congested
+
+    assert not stream_congested(9.0, 10.0, 0, 100)     # kept up
+    # Wall-fps shortfall alone is NOT congestion: short legs amortize
+    # startup/drain over few frames and under-measure fps; with a bounded
+    # drop-oldest queue, real congestion always surfaces as drops.
+    assert not stream_congested(5.0, 10.0, 0, 100)
+    assert stream_congested(10.0, 10.0, 10, 100)       # ingest dropped
+    assert not stream_congested(10.0, 10.0, 1, 100)    # one startup drop ok
+    # No percentage allowance: a steady trickle of drops = the queue sat
+    # full for a stretch = queue residency leaked into the percentiles.
+    assert stream_congested(10.0, 10.0, 2, 512)
+    assert stream_congested(1.0, 0.0, 0, 100)          # no target = no claim
+    assert stream_congested(0.0, 10.0, 0, 0)           # nothing delivered
+
+
+def test_latency_backoff_halves_until_uncongested(monkeypatch):
+    """The rate-controlled leg must not publish queue-residency numbers:
+    when delivery falls short of the offered rate (capacity flapped below
+    0.8× the earlier throughput measurement — round-3 verdict, weak item
+    1), it halves the rate until the pipeline provably kept up."""
+    import dvf_tpu.benchmarks as B
+
+    calls = []
+
+    def fake_run_pipeline(filt, source, batch_size, h, w, max_inflight,
+                          queue_size, **kw):
+        calls.append((source.rate, source.n_frames))
+        if source.rate > 3.0:  # congested until the rate drops under 3 fps
+            return {"fps": source.rate * 0.5, "frames": source.n_frames,
+                    "wall_s": 1.0, "p50_ms": 99999.0, "p99_ms": 99999.0,
+                    "dropped": 10}
+        return {"fps": source.rate, "frames": source.n_frames, "wall_s": 1.0,
+                "p50_ms": 12.0, "p99_ms": 20.0, "dropped": 0}
+
+    monkeypatch.setattr(B, "_run_pipeline", fake_run_pipeline)
+    r = B.bench_e2e_latency(object(), n_frames=96, batch_size=8, height=8,
+                            width=8, target_fps=8.0)
+    assert [c[0] for c in calls] == [8.0, 4.0, 2.0]
+    # Frame count halves with the rate so a backoff keeps the wall budget.
+    assert [c[1] for c in calls] == [96, 48, 24]
+    assert r["congested"] is False and r["backoffs"] == 2
+    assert r["target_fps"] == 2.0 and r["p50_ms"] == 12.0
+
+
+def test_latency_backoff_exhausted_flags_congested(monkeypatch):
+    import dvf_tpu.benchmarks as B
+
+    def always_congested(filt, source, *a, **kw):
+        return {"fps": source.rate * 0.3, "frames": source.n_frames,
+                "wall_s": 1.0, "p50_ms": 5000.0, "p99_ms": 9000.0,
+                "dropped": 50}
+
+    monkeypatch.setattr(B, "_run_pipeline", always_congested)
+    r = B.bench_e2e_latency(object(), n_frames=64, batch_size=8, height=8,
+                            width=8, target_fps=8.0, max_backoffs=2)
+    assert r["congested"] is True and r["backoffs"] == 2
+    assert r["target_fps"] == 2.0  # the lowest rate actually tried
+
+
+def test_e2e_leg_freshness_requires_congestion_verdict():
+    """Methodology gate: e2e percentiles captured before the backoff-
+    verified harness (no lat_congested field) are stale regardless of
+    stamp — the next session re-measures them honestly."""
+    rt = _load_run_table_module()
+
+    pre = {"e2e": {"value": 1.0, "p50_ms": 5.0,
+                   "captured_utc": "2026-07-31T10:00:00+00:00"}}
+    assert not rt.leg_fresh(pre, "e2e", "")
+    post = {"e2e": {"value": 1.0, "p50_ms": 5.0, "lat_congested": False,
+                    "captured_utc": "2026-07-31T10:00:00+00:00"}}
+    assert rt.leg_fresh(post, "e2e", "")
+    # A leg that never published percentiles (fps-only) needs no verdict.
+    bare = {"e2e": {"value": 1.0,
+                    "captured_utc": "2026-07-31T10:00:00+00:00"}}
+    assert rt.leg_fresh(bare, "e2e", "")
+
+
+def test_latency_backoff_never_inflates_frames(monkeypatch):
+    """Large batch must not raise the retry's frame count above the
+    original leg's (a batch-derived floor would multiply wall time on
+    exactly the slow links that back off)."""
+    import dvf_tpu.benchmarks as B
+
+    frames_seen = []
+
+    def always_congested(filt, source, *a, **kw):
+        frames_seen.append(source.n_frames)
+        return {"fps": 0.1, "frames": source.n_frames, "wall_s": 1.0,
+                "p50_ms": 5000.0, "p99_ms": 9000.0, "dropped": 50}
+
+    monkeypatch.setattr(B, "_run_pipeline", always_congested)
+    B.bench_e2e_latency(object(), n_frames=48, batch_size=64, height=8,
+                        width=8, target_fps=2.4, max_backoffs=2)
+    assert frames_seen == [48, 24, 16]  # monotonically non-increasing
+
+
+def test_congested_e2e_leg_is_never_fresh():
+    """A lat_congested=True capture renders (with ‡) but must not satisfy
+    freshness — a later, healthier window replaces it with real transit."""
+    rt = _load_run_table_module()
+
+    cong = {"e2e": {"value": 1.0, "p50_ms": 5000.0, "lat_congested": True,
+                    "captured_utc": "2026-07-31T10:00:00+00:00"}}
+    assert not rt.leg_fresh(cong, "e2e", "")

@@ -320,3 +320,23 @@ class TestInlineCollectMode:
                 NullSink(),
                 PipelineConfig(collect_mode="bogus"),
             )
+
+
+def test_paced_source_does_not_burst_after_stall():
+    """A consumer stall (backpressure, jit warm-up) must not be repaid by
+    an unthrottled catch-up burst — that would congest the very stream
+    bench_e2e_latency is rate-controlling."""
+    import time
+
+    from dvf_tpu.io.sources import SyntheticSource
+
+    rate = 50.0  # 20 ms period
+    it = iter(SyntheticSource(height=8, width=8, n_frames=12, rate=rate))
+    for _ in range(3):
+        next(it)
+    time.sleep(0.25)  # stall ≈ 12 periods
+    next(it)          # resumes instantly (frame was already due)
+    t0 = time.perf_counter()
+    next(it)          # must wait ~one period, not arrive in a burst
+    gap = time.perf_counter() - t0
+    assert gap >= 0.5 / rate, f"catch-up burst after stall: gap={gap*1e3:.1f}ms"
