@@ -230,6 +230,13 @@ def main(argv=None) -> int:
         "stage_decomp_ms": result.get("stage_decomp_ms"),
         "lat_target_fps": result.get("lat_target_fps"),
         "lat_batch": result.get("lat_batch"),
+        # The latency verdict must travel with the percentiles: without
+        # lat_congested/lat_delivery_fps a reader (and run_table's own
+        # freshness gate) cannot tell verified transit from a congested
+        # upper bound.
+        "lat_delivery_fps": result.get("lat_delivery_fps"),
+        "lat_congested": result.get("lat_congested"),
+        "lat_backoffs": result.get("lat_backoffs"),
         "e2e_fps": result.get("e2e_fps"),
         "ms_per_frame": result.get("ms_per_frame"),
         "h2d_mbps": result.get("h2d_mbps"),
@@ -246,8 +253,10 @@ def main(argv=None) -> int:
         "fallback": fallback,
         "error": error,
     }
-    bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "benchmarks")
+    # DVF_BENCH_DIR: test override so the persist-gate logic can be
+    # exercised against a scratch dir instead of the real capture file.
+    bench_dir = os.environ.get("DVF_BENCH_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks")
     # mode check: an --e2e run's metric (1080p_invert_e2e_fps) is
     # incomparable with the persisted device-fps headline and must never
     # seed/overwrite TPU_BENCH_R4.json.
@@ -267,8 +276,11 @@ def main(argv=None) -> int:
             "argv": sys.argv[1:],
         }
         path = os.path.join(bench_dir, "TPU_BENCH_R4.json")
-        if (args.height, args.width, args.batch, args.iters) != (
-                1080, 1920, 64, 300):
+        # The headline workload IS the parser's defaults — derive, don't
+        # duplicate, so a default change can't silently stop persistence.
+        headline_workload = (ap.get_default("height"), ap.get_default("width"),
+                             ap.get_default("batch"), ap.get_default("iters"))
+        if (args.height, args.width, args.batch, args.iters) != headline_workload:
             # The persisted metric is by name 1080p_invert_device_fps at
             # one fixed workload; any other geometry/batch/iters can
             # match or beat device_frames (= iters × batch) while being
@@ -278,7 +290,7 @@ def main(argv=None) -> int:
             # every honest default rerun.
             _log(f"not persisting: workload {args.height}x{args.width} "
                  f"batch={args.batch} iters={args.iters} is not the "
-                 f"headline (1080p, batch 64, 300 iters)")
+                 f"headline {headline_workload}")
             print(json.dumps(out), flush=True)
             return 0
         existing_frames = -1

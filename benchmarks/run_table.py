@@ -252,8 +252,11 @@ def leg_fresh(entry: dict, leg: str, min_fresh: str, quick: bool = False,
     # congestion verdict predates the backoff-verified latency leg (the
     # 0.8×-target run could silently congest and report queue residency as
     # transit) — stale regardless of stamp, so the next session re-measures
-    # it with the congestion-checked harness.
-    if leg == "e2e" and "p50_ms" in d and "lat_congested" not in d:
+    # it with the congestion-checked harness. lat_delivery_fps marks the
+    # v3 verdict (drops + steady-state delivery rate); legs with only the
+    # v2 drops signal could false-negative on streams shorter than the
+    # pipeline's buffering over a crawling link and are equally stale.
+    if leg == "e2e" and "p50_ms" in d and "lat_delivery_fps" not in d:
         return False
     # A congested capture is an upper bound, not transit — keep it (it
     # renders with the ‡ mark) but never let it satisfy freshness, so a
@@ -368,9 +371,10 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
     lines.append(
         "\np50/p99 are RATE-CONTROLLED transit latency (source throttled to "
         "0.8× the measured throughput, ingest queue ≈ one batch), VERIFIED "
-        "uncongested: the leg checks the bounded drop-oldest ingest queue "
-        "recorded no drops (the direct congestion signal), halving the "
-        "rate up to twice until it did. ‡ = still congested at the lowest "
+        "uncongested on two signals — the bounded drop-oldest ingest queue "
+        "recorded ≤1 drop AND the steady-state delivery rate (first→last "
+        "delivery) held ≥0.85× the offered rate — halving the rate up to "
+        "twice until both held. ‡ = still congested at the lowest "
         "tried rate (the "
         "link's capacity flapped below it mid-leg) — that p50 includes "
         "standing-queue wait and is an upper bound, not transit. The "

@@ -283,6 +283,7 @@ def main(argv=None) -> int:
             lat_frames=rl["frames"],
             lat_batch=args.lat_batch,
             lat_target_fps=round(rl["target_fps"], 1),
+            lat_delivery_fps=round(rl["delivery_fps"], 2),
             lat_congested=rl["congested"],
             lat_backoffs=rl["backoffs"],
         )

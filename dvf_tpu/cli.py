@@ -525,6 +525,7 @@ def cmd_bench(args) -> int:
                 p99_ms=round(rl["p99_ms"], 3),
                 lat_frames=rl["frames"],
                 lat_target_fps=round(rl["target_fps"], 1),
+                lat_delivery_fps=round(rl["delivery_fps"], 2),
                 lat_congested=rl["congested"],
                 lat_backoffs=rl["backoffs"],
             )

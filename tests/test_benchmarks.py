@@ -205,10 +205,13 @@ def test_stream_congested_verdicts():
     from dvf_tpu.benchmarks import stream_congested
 
     assert not stream_congested(9.0, 10.0, 0, 100)     # kept up
-    # Wall-fps shortfall alone is NOT congestion: short legs amortize
-    # startup/drain over few frames and under-measure fps; with a bounded
-    # drop-oldest queue, real congestion always surfaces as drops.
-    assert not stream_congested(5.0, 10.0, 0, 100)
+    # Steady-state delivery shortfall IS congestion even with zero drops:
+    # a stream shorter than the pipeline's total buffering never
+    # overflows the drop-oldest queue, yet frames are accumulating (the
+    # crawling-link case — invert_1080p measured 146 s 'transit' with 0
+    # drops before this signal existed). The rate is first→last delivery,
+    # so startup/compile/drain overhead cannot fake a shortfall.
+    assert stream_congested(5.0, 10.0, 0, 100)
     assert stream_congested(10.0, 10.0, 10, 100)       # ingest dropped
     assert not stream_congested(10.0, 10.0, 1, 100)    # one startup drop ok
     # No percentage allowance: a steady trickle of drops = the queue sat
@@ -231,10 +234,13 @@ def test_latency_backoff_halves_until_uncongested(monkeypatch):
                           queue_size, **kw):
         calls.append((source.rate, source.n_frames))
         if source.rate > 3.0:  # congested until the rate drops under 3 fps
-            return {"fps": source.rate * 0.5, "frames": source.n_frames,
+            return {"fps": source.rate * 0.5,
+                    "delivery_fps": source.rate * 0.5,
+                    "frames": source.n_frames,
                     "wall_s": 1.0, "p50_ms": 99999.0, "p99_ms": 99999.0,
                     "dropped": 10}
-        return {"fps": source.rate, "frames": source.n_frames, "wall_s": 1.0,
+        return {"fps": source.rate, "delivery_fps": source.rate,
+                "frames": source.n_frames, "wall_s": 1.0,
                 "p50_ms": 12.0, "p99_ms": 20.0, "dropped": 0}
 
     monkeypatch.setattr(B, "_run_pipeline", fake_run_pipeline)
@@ -251,7 +257,8 @@ def test_latency_backoff_exhausted_flags_congested(monkeypatch):
     import dvf_tpu.benchmarks as B
 
     def always_congested(filt, source, *a, **kw):
-        return {"fps": source.rate * 0.3, "frames": source.n_frames,
+        return {"fps": source.rate * 0.3, "delivery_fps": source.rate * 0.3,
+                "frames": source.n_frames,
                 "wall_s": 1.0, "p50_ms": 5000.0, "p99_ms": 9000.0,
                 "dropped": 50}
 
@@ -271,7 +278,14 @@ def test_e2e_leg_freshness_requires_congestion_verdict():
     pre = {"e2e": {"value": 1.0, "p50_ms": 5.0,
                    "captured_utc": "2026-07-31T10:00:00+00:00"}}
     assert not rt.leg_fresh(pre, "e2e", "")
+    # v2 legs (drops-only verdict, no steady-delivery-rate signal) are
+    # stale too: they could false-negative on a short stream over a
+    # crawling link.
+    v2 = {"e2e": {"value": 1.0, "p50_ms": 5.0, "lat_congested": False,
+                  "captured_utc": "2026-07-31T10:00:00+00:00"}}
+    assert not rt.leg_fresh(v2, "e2e", "")
     post = {"e2e": {"value": 1.0, "p50_ms": 5.0, "lat_congested": False,
+                    "lat_delivery_fps": 9.5,
                     "captured_utc": "2026-07-31T10:00:00+00:00"}}
     assert rt.leg_fresh(post, "e2e", "")
     # A leg that never published percentiles (fps-only) needs no verdict.
@@ -290,7 +304,8 @@ def test_latency_backoff_never_inflates_frames(monkeypatch):
 
     def always_congested(filt, source, *a, **kw):
         frames_seen.append(source.n_frames)
-        return {"fps": 0.1, "frames": source.n_frames, "wall_s": 1.0,
+        return {"fps": 0.1, "delivery_fps": 0.1, "frames": source.n_frames,
+                "wall_s": 1.0,
                 "p50_ms": 5000.0, "p99_ms": 9000.0, "dropped": 50}
 
     monkeypatch.setattr(B, "_run_pipeline", always_congested)
@@ -307,3 +322,57 @@ def test_congested_e2e_leg_is_never_fresh():
     cong = {"e2e": {"value": 1.0, "p50_ms": 5000.0, "lat_congested": True,
                     "captured_utc": "2026-07-31T10:00:00+00:00"}}
     assert not rt.leg_fresh(cong, "e2e", "")
+
+
+def test_bench_persist_gate(tmp_path, monkeypatch):
+    """TPU_BENCH_R4.json keep-best safety: only the exact headline
+    workload (1080p, batch 64, 300 iters, headline mode) may persist, a
+    larger-frame different workload must never clobber the best sample,
+    and equal-workload reruns keep the faster fps."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_root", os.path.join(os.path.dirname(__file__), "..",
+                                   "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("DVF_BENCH_DIR", str(tmp_path))
+    path = tmp_path / "TPU_BENCH_R4.json"
+
+    def fake_result(device_fps, frames):
+        return {"device_fps": device_fps, "device_frames": frames,
+                "backend": "tpu", "n_devices": 1, "batch": 64,
+                "e2e_fps": 1.0, "p50_ms": 1.0, "p99_ms": 2.0}
+
+    monkeypatch.setattr(bench, "probe_tpu", lambda *a: (True, {}))
+
+    def run(value, frames, argv):
+        monkeypatch.setattr(
+            bench, "run_bench_child",
+            lambda *a, **k: (fake_result(value, frames), None))
+        assert bench.main(argv) == 0
+
+    # 1. Headline workload persists.
+    run(40000.0, 19200, [])
+    assert json.loads(path.read_text())["result"]["value"] == 40000.0
+
+    # 2. Equal workload, faster → replaces; slower → kept best.
+    run(46000.0, 19200, [])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
+    run(41000.0, 19200, [])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
+
+    # 3. Bigger device_frames but non-default workload: must NOT clobber.
+    run(30000.0, 38400, ["--iters", "600"])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
+    run(30000.0, 38400, ["--batch", "128"])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
+    run(90000.0, 19200, ["--height", "480", "--width", "640"])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
+
+    # 4. e2e mode never touches the headline capture file.
+    run(50000.0, 99999, ["--e2e"])
+    assert json.loads(path.read_text())["result"]["value"] == 46000.0
