@@ -341,14 +341,23 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         mfu = d.get("mfu")
         stamp = ((d.get("captured_utc") if isinstance(d, dict) else "")
                  or r.get("captured_utc") or "")[:16].replace("T", " ")
-        cong = " ‡" if e and e.get("lat_congested") else ""
+        # ‡ = verified-congested upper bound; § = measured by a
+        # pre-verification harness (no congestion verdict travels with
+        # the number) — both are owed a re-measure and must not read as
+        # verified transit under the caption below.
+        if e and e.get("lat_congested"):
+            mark = " ‡"
+        elif e and "p50_ms" in e and "lat_delivery_fps" not in e:
+            mark = " §"
+        else:
+            mark = ""
         lines.append(
             f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
             f"| {_fmt_roof(roof)} "
             f"| {mfu if mfu is not None else '—'} "
             f"| {e.get('value', 'ERR') if e else '—'} "
-            f"| {str(e.get('p50_ms', '—')) + cong if e else '—'} "
-            f"| {str(e.get('p99_ms', '—')) + cong if e else '—'} | {stamp} |"
+            f"| {str(e.get('p50_ms', '—')) + mark if e else '—'} "
+            f"| {str(e.get('p99_ms', '—')) + mark if e else '—'} | {stamp} |"
         )
     def _legacy_e2e(r):
         # Demoted legacy e2e: load_doc renamed its p50/p99 to congestion_*
@@ -377,7 +386,10 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         "twice until both held. ‡ = still congested at the lowest "
         "tried rate (the "
         "link's capacity flapped below it mid-leg) — that p50 includes "
-        "standing-queue wait and is an upper bound, not transit. The "
+        "standing-queue wait and is an upper bound, not transit. § = "
+        "captured by a pre-verification harness (no congestion verdict "
+        "attached) — treated as stale and re-measured at the next healthy "
+        "window. The "
         "congestion percentiles of the unthrottled run are kept only in the "
         "JSON under `congestion_*`. 'HBM roofline' = measured device fps / "
         "(819 GB/s ÷ XLA-reported HBM bytes per frame) — the right model "
@@ -443,7 +455,28 @@ def main(argv=None) -> int:
                          "change only moves the device numbers — "
                          "'--legs device' refreshes those without burning "
                          "window time re-streaming the link-bound e2e legs")
+    ap.add_argument("--render-only", action="store_true",
+                    help="re-render BENCH_TABLE.md from the persisted JSON "
+                         "without measuring anything — picks up caption/"
+                         "mark changes (e.g. a methodology-gate edit) "
+                         "immediately instead of at the next capture")
     args = ap.parse_args(argv)
+    if args.render_only:
+        # MD only — the JSON (including its updated_utc measurement stamp)
+        # is untouched: a re-render adds no data. A missing/corrupt JSON
+        # is always an error here (typo'd --out-dir, deleted file): with
+        # no data source, proceeding would clobber the published MD with
+        # an empty skeleton.
+        json_path = os.path.join(args.out_dir, "BENCH_TABLE.json")
+        doc = load_doc(json_path)
+        if not doc.get("configs") and not doc.get("impl_comparisons"):
+            ap.error(f"--render-only: no usable table data in {json_path}")
+        md_path = os.path.join(args.out_dir, "BENCH_TABLE.md")
+        with open(md_path, "w") as f:
+            f.write(render_md(doc,
+                              bool(doc.get("platform_forced_cpu", args.cpu))))
+        _log(f"re-rendered {md_path} from persisted JSON (no measurements)")
+        return 0
     legs = {s for s in args.legs.split(",") if s}
     if not legs or not legs <= {"device", "e2e"}:
         # An empty set would silently skip every leg and exit 0 with a

@@ -376,3 +376,37 @@ def test_bench_persist_gate(tmp_path, monkeypatch):
     # 4. e2e mode never touches the headline capture file.
     run(50000.0, 99999, ["--e2e"])
     assert json.loads(path.read_text())["result"]["value"] == 46000.0
+
+
+def test_render_marks_unverified_and_congested_percentiles():
+    """Percentiles may render under the 'VERIFIED uncongested' caption
+    only when a v3 verdict travels with them: congested legs get ‡,
+    pre-verification legs get §."""
+    rt = _load_run_table_module()
+
+    doc = {"configs": {
+        "invert_640x480": {
+            "device": {"value": 1.0, "captured_utc": "2026-07-31T01:00"},
+            "e2e": {"value": 1.0, "p50_ms": 10.0, "p99_ms": 20.0,
+                    "lat_delivery_fps": 5.0, "lat_congested": False,
+                    "captured_utc": "2026-07-31T01:00"}},
+        "invert_1080p": {
+            "device": {"value": 1.0, "captured_utc": "2026-07-31T01:00"},
+            "e2e": {"value": 1.0, "p50_ms": 99.0, "p99_ms": 100.0,
+                    "lat_congested": True, "lat_delivery_fps": 0.1,
+                    "captured_utc": "2026-07-31T01:00"}},
+        "gauss3_1080p": {
+            "device": {"value": 1.0, "captured_utc": "2026-07-31T01:00"},
+            "e2e": {"value": 1.0, "p50_ms": 55.0, "p99_ms": 60.0,
+                    "lat_congested": False,  # v2: verdict without rate
+                    "captured_utc": "2026-07-31T01:00"}},
+    }, "impl_comparisons": {}, "updated_utc": "2026-07-31T01:00"}
+
+    md = rt.render_md(doc, forced_cpu=False)
+    row = {ln.split("|")[1].strip(): ln for ln in md.splitlines()
+           if ln.startswith("| ")}
+    assert "§" not in row["invert_640x480"]          # clean: no mark
+    assert "‡" not in row["invert_640x480"]
+    assert "| 10.0 |" in row["invert_640x480"]
+    assert "99.0 ‡" in row["invert_1080p"]           # verified congested
+    assert "55.0 §" in row["gauss3_1080p"]           # pre-verification
