@@ -410,3 +410,62 @@ def test_render_marks_unverified_and_congested_percentiles():
     assert "| 10.0 |" in row["invert_640x480"]
     assert "99.0 ‡" in row["invert_1080p"]           # verified congested
     assert "55.0 §" in row["gauss3_1080p"]           # pre-verification
+
+
+def test_latency_backoff_floor_never_exceeds_original(monkeypatch):
+    """A 12-frame leg must not be raised to 16 frames by the retry floor —
+    on a 0.1 fps config that inflation (plus the halved rate) projects to
+    a 28-minute leg that burns the harness child's whole timeout."""
+    import dvf_tpu.benchmarks as B
+
+    frames_seen = []
+
+    def always_congested(filt, source, *a, **kw):
+        frames_seen.append(source.n_frames)
+        return {"fps": 0.01, "delivery_fps": 0.01, "frames": source.n_frames,
+                "wall_s": 1.0, "p50_ms": 5000.0, "p99_ms": 9000.0,
+                "dropped": 50}
+
+    monkeypatch.setattr(B, "_run_pipeline", always_congested)
+    r = B.bench_e2e_latency(object(), n_frames=12, batch_size=8, height=8,
+                            width=8, target_fps=8.0, max_backoffs=2)
+    assert frames_seen == [12, 12, 12]
+    assert r["congested"] is True
+
+
+def test_latency_backoff_respects_wall_budget(monkeypatch):
+    """When the halved-rate retry's offered stream alone would outlast
+    max_retry_stream_s, the leg stops and reports congested instead of
+    running it."""
+    import dvf_tpu.benchmarks as B
+
+    calls = []
+
+    def always_congested(filt, source, *a, **kw):
+        calls.append(source.rate)
+        return {"fps": 0.01, "delivery_fps": 0.01, "frames": source.n_frames,
+                "wall_s": 1.0, "p50_ms": 5000.0, "p99_ms": 9000.0,
+                "dropped": 50}
+
+    monkeypatch.setattr(B, "_run_pipeline", always_congested)
+    # 12 frames at 0.08 fps: first retry projects 12/0.04 = 300 s (ok at
+    # the 400 s default), second projects 12/0.02 = 600 s (skipped).
+    r = B.bench_e2e_latency(object(), n_frames=12, batch_size=8, height=8,
+                            width=8, target_fps=0.08, max_backoffs=2)
+    assert calls == [0.08, 0.04]
+    assert r["congested"] is True and r["backoffs"] == 1
+
+
+def test_latency_backoff_zero_target_returns_congested(monkeypatch):
+    """target_fps=0 (a broken throughput leg) must yield the congested
+    verdict, not a ZeroDivisionError in the retry projection."""
+    import dvf_tpu.benchmarks as B
+
+    def run(filt, source, *a, **kw):
+        return {"fps": 0.0, "delivery_fps": 0.0, "frames": 0, "wall_s": 1.0,
+                "p50_ms": float("nan"), "p99_ms": float("nan"), "dropped": 0}
+
+    monkeypatch.setattr(B, "_run_pipeline", run)
+    r = B.bench_e2e_latency(object(), n_frames=12, batch_size=8, height=8,
+                            width=8, target_fps=0.0)
+    assert r["congested"] is True
