@@ -31,8 +31,16 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="small shapes (tracing smoke, e.g. pre-commit)")
+    ap.add_argument("--platform", default=None,
+                    help="force the jax platform (e.g. cpu) — without it a "
+                         "dev box whose sitecustomize pins an unreachable "
+                         "TPU hangs in backend init before the first case")
     args = ap.parse_args(argv)
 
+    if args.platform:
+        os.environ["DVF_FORCE_PLATFORM"] = args.platform
+    from dvf_tpu.cli import _force_platform
+    _force_platform()
     import jax
     import jax.numpy as jnp
 
@@ -80,6 +88,18 @@ def main(argv=None) -> int:
             lambda i, f: warp_bounded_pallas(i, f, interpret=interp),
             (frame720, flow)),
     }
+    # Tile sweep (run_table *_tile_1080p comparisons): each non-default
+    # tile_h changes the DMA slab extents and VMEM footprint — verify
+    # lowering data-free before the sweep burns on-chip window time.
+    # (--quick's 48-row frame only divides by 8; skip the larger tiles.)
+    sweep_tiles = (8,) if args.quick else (8, 40, 120)
+    for th in sweep_tiles:
+        cases[f"bilateral_tile{th}"] = (
+            lambda x, th=th: bilateral_nhwc_pallas(
+                x, tile_h=th, interpret=interp), (frame,))
+        cases[f"sobel_bilateral_tile{th}"] = (
+            lambda x, th=th: sobel_bilateral_nhwc_pallas(
+                x, tile_h=th, interpret=interp), (frame,))
     results = {}
     for name, (fn, shapes) in cases.items():
         try:

@@ -97,6 +97,28 @@ COMPARISONS = {
         ("shift", "gaussian_blur", {"ksize": 3, "impl": "shift"}),
         ("pallas_fused", "gaussian_blur_pallas", {"ksize": 3}),
     ]),
+    # Tile-height sweeps for the two winning kernels with the most
+    # roofline headroom (bilateral 0.30, fused sobel_bilateral 0.42 of
+    # the HBM ceiling on-chip): tile_h sets the rows-per-program of the
+    # (batch, H-tiles) grid and hence the DMA slab size and halo-refetch
+    # overhead (halo rows are re-read once per tile: small tiles pay more
+    # redundant HBM traffic, large tiles pay VMEM pressure and less
+    # grid-level parallelism). 24 is what the auto-picker (_pick_tile_h,
+    # target 32) currently chooses at H=1080; 8/40/120 bracket it with
+    # the other 8-aligned divisors of 1080. A measured winner ≠ 24 gets
+    # wired as the per-backend default tile target.
+    "bilateral_tile_1080p": (1080, 1920, 8, [
+        ("tile8", "bilateral_pallas", {"tile_h": 8}),
+        ("tile24", "bilateral_pallas", {"tile_h": 24}),
+        ("tile40", "bilateral_pallas", {"tile_h": 40}),
+        ("tile120", "bilateral_pallas", {"tile_h": 120}),
+    ]),
+    "sobel_bilateral_tile_1080p": (1080, 1920, 8, [
+        ("tile8", "sobel_bilateral_pallas", {"tile_h": 8}),
+        ("tile24", "sobel_bilateral_pallas", {"tile_h": 24}),
+        ("tile40", "sobel_bilateral_pallas", {"tile_h": 40}),
+        ("tile120", "sobel_bilateral_pallas", {"tile_h": 120}),
+    ]),
 }
 
 
@@ -520,11 +542,19 @@ def main(argv=None) -> int:
         k: v for k, v in COMPARISONS.items() if not only or k in only}
     if args.quick:
         # Quick mode shrinks shapes — rename the keys so tiny-shape numbers
-        # can never be published under full-resolution labels.
+        # can never be published under full-resolution labels. Tile-sweep
+        # variants whose pinned tile_h does not divide the quick H cannot
+        # run at the shrunken geometry (tile_h must divide H) — drop those
+        # impls rather than recording guaranteed-error legs every smoke.
+        qh, qw = 48, 64
         comparisons = {
-            k.rsplit("_", 1)[0] + "_48x64_quick": (48, 64, b, impls)
+            k.rsplit("_", 1)[0] + "_48x64_quick": (qh, qw, b, [
+                (label, fname, cfg) for (label, fname, cfg) in impls
+                if not cfg.get("tile_h") or qh % cfg["tile_h"] == 0
+            ])
             for k, (_, _, b, impls) in comparisons.items()
         }
+        comparisons = {k: v for k, v in comparisons.items() if v[3]}
 
     ran = skipped = 0
 

@@ -88,6 +88,18 @@ def main(argv=None) -> int:
         log(f"bench.py rc={rc} backend={line.get('backend')} "
             f"value={line.get('value')} fallback={line.get('fallback')}")
 
+        # AOT lowering guard at full table geometry (seconds, data-free),
+        # covering every kernel AND the tile-sweep variants. Advisory: the
+        # table still runs either way (its other rows are unaffected and
+        # errored legs persist their own diagnostics) — this log line is
+        # what makes a sweep-leg ERR immediately attributable to lowering
+        # vs. a dead tunnel.
+        rc, out, err = run_cmd(
+            [sys.executable, "benchmarks/pallas_compile_check.py"],
+            env, 300.0, cwd=REPO)
+        level = "" if rc == 0 else " *** LOWERING FAILURE ***"
+        log(f"pallas_compile_check rc={rc}{level} {last_json_line(out)}")
+
         # Then the table: incremental, probe-gated per row; rc=2 = tunnel
         # died mid-table (fine — finished rows persisted).
         rc, out, err = run_cmd(

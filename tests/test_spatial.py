@@ -391,3 +391,26 @@ def test_equalize_space_sharded_matches_replicated():
     want3 = np.asarray(
         Engine(get_filter("equalize"), mesh=make_mesh(MeshConfig())).submit(x3))
     np.testing.assert_array_equal(got3, want3)
+
+
+def test_pallas_tile_h_variants_numerically_identical(batch):
+    """tile_h only changes the grid, never the numerics — the guarantee
+    the on-chip tile sweep (run_table COMPARISONS *_tile_1080p) relies on
+    to wire a measured winner as the default tile target."""
+    want = np.asarray(bilateral_nhwc_pallas(batch, interpret=True))
+    h = batch.shape[1]
+    for th in (8, 16, h):  # 8-aligned divisors of the test H, plus whole-H
+        if h % th:
+            continue
+        got = bilateral_nhwc_pallas(batch, tile_h=th, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6,
+                                   err_msg=f"tile_h={th}")
+
+    from dvf_tpu.ops.pallas_kernels import sobel_bilateral_nhwc_pallas
+    want = np.asarray(sobel_bilateral_nhwc_pallas(batch, interpret=True))
+    for th in (8, 16, h):
+        if h % th:
+            continue
+        got = sobel_bilateral_nhwc_pallas(batch, tile_h=th, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6,
+                                   err_msg=f"tile_h={th}")
