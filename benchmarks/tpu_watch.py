@@ -97,7 +97,13 @@ def main(argv=None) -> int:
         rc, out, err = run_cmd(
             [sys.executable, "benchmarks/pallas_compile_check.py"],
             env, 300.0, cwd=REPO)
-        level = "" if rc == 0 else " *** LOWERING FAILURE ***"
+        # rc semantics (pallas_compile_check.py): 0 = all lowered on TPU,
+        # 1 = a kernel FAILED to lower, 3 = clean trace but the backend
+        # came up CPU (tunnel died between probe and check — not a
+        # lowering verdict at all); anything else = harness error/timeout.
+        level = {0: "", 1: " *** LOWERING FAILURE ***",
+                 3: " (backend fell back to CPU — no lowering verdict)"}.get(
+                     rc, " (harness error)")
         log(f"pallas_compile_check rc={rc}{level} {last_json_line(out)}")
 
         # Then the table: incremental, probe-gated per row; rc=2 = tunnel

@@ -139,12 +139,41 @@ def poly_expansion(gray: jnp.ndarray, n: int = 5, sigma: float = 1.1):
     # v_i = correlation of f with w * b_i; separable into row (x) and col (y)
     # factors: b=1 -> k0⊗k0 ; x -> k0(y)k1(x) ; y -> k1(y)k0(x);
     # x² -> k0(y)k2(x) ; y² -> k2(y)k0(x) ; xy -> k1(y)k1(x).
-    v1 = sep_conv2d(gray, k0, k0)
-    vx = sep_conv2d(gray, k0, k1)
-    vy = sep_conv2d(gray, k1, k0)
-    vxx = sep_conv2d(gray, k0, k2)
-    vyy = sep_conv2d(gray, k2, k0)
-    vxy = sep_conv2d(gray, k1, k1)
+    #
+    # The six correlations share ONE input and only three distinct 1-D
+    # kernels per axis, so instead of six independent sep_conv2d calls
+    # (6 pads, 6 vertical + 6 horizontal passes) this runs the shifted-FMA
+    # lowering once with the passes shared: one reflect pad, the three
+    # vertical moment passes c0/c1/c2 reading the same shifted slices, and
+    # six horizontal passes over those. Tap accumulation order is
+    # identical to sep_conv2d(impl="shift"), so results are bit-identical
+    # to the unfused formulation (guarded by
+    # tests/test_flow.py::test_poly_expansion_matches_unfused_sep_convs).
+    h, w = gray.shape[1], gray.shape[2]
+    x = jnp.pad(gray, ((0, 0), (n, n), (n, n), (0, 0)), mode="reflect")
+    taps = 2 * n + 1
+    xs = [x[:, i : i + h, :, :] for i in range(taps)]
+
+    def vert(k):
+        a = k[0].astype(x.dtype) * xs[0]
+        for i in range(1, taps):
+            a = a + k[i].astype(x.dtype) * xs[i]
+        return a
+
+    c0, c1, c2 = vert(jnp.asarray(k0)), vert(jnp.asarray(k1)), vert(jnp.asarray(k2))
+
+    def horiz(a, k):
+        o = k[0].astype(a.dtype) * a[:, :, :w, :]
+        for j in range(1, taps):
+            o = o + k[j].astype(a.dtype) * a[:, :, j : j + w, :]
+        return o
+
+    v1 = horiz(c0, k0)
+    vx = horiz(c0, k1)
+    vxx = horiz(c0, k2)
+    vy = horiz(c1, k0)
+    vxy = horiz(c1, k1)
+    vyy = horiz(c2, k0)
     v = jnp.stack([v1, vx, vy, vxx, vyy, vxy], axis=-1)  # (B,H,W,1,6)
     r = jnp.einsum("...i,ji->...j", v, Ginv)  # coeffs [c, bx, by, axx, ayy, axy]
     b1 = r[..., 1]
@@ -214,9 +243,61 @@ def farneback_flow(
 
     All shapes/levels are static — the pyramid unrolls at trace time.
     """
-    b, h, w, _ = prev_gray.shape
-    win_kern = gaussian_kernel_1d(win_size, win_size / 6.0)
+    b = prev_gray.shape[0]
 
+    def polys_at(lvl, lh, lw):
+        p = jax.image.resize(prev_gray, (b, lh, lw, 1), method="linear")
+        c = jax.image.resize(curr_gray, (b, lh, lw, 1), method="linear")
+        return (jnp.concatenate(poly_expansion(p, poly_n, poly_sigma), axis=-1),
+                jnp.concatenate(poly_expansion(c, poly_n, poly_sigma), axis=-1))
+
+    return _coarse_to_fine(polys_at, b, prev_gray.shape[1],
+                           prev_gray.shape[2], prev_gray.dtype,
+                           levels, pyr_scale, win_size, n_iters)
+
+
+def farneback_flow_seq(
+    gray_seq: jnp.ndarray,
+    levels: int = 3,
+    pyr_scale: float = 0.5,
+    win_size: int = 15,
+    n_iters: int = 3,
+    poly_n: int = 5,
+    poly_sigma: float = 1.1,
+) -> jnp.ndarray:
+    """Flow for every CONSECUTIVE pair of a frame sequence.
+
+    ``gray_seq``: (B+1, H, W, 1) — frame i is "prev" of pair i and "curr"
+    of pair i-1. :func:`farneback_flow` on the shifted pair stacks
+    resizes and poly-expands each interior frame TWICE (once per role);
+    the streaming filters' batches are exactly this overlapping case, so
+    this entry computes the pyramid and polynomial expansion once per
+    unique frame (B+1 expansions instead of 2B) and slices the pair
+    views. Per-frame operations are identical to the pairwise form, so
+    the flows match it to float tolerance
+    (tests/test_flow.py::test_farneback_seq_matches_pairwise).
+
+    Returns (B, H, W, 2) flows mapping gray_seq[i] -> gray_seq[i+1].
+    """
+    bp1 = gray_seq.shape[0]
+
+    def polys_at(lvl, lh, lw):
+        g = jax.image.resize(gray_seq, (bp1, lh, lw, 1), method="linear")
+        poly_all = jnp.concatenate(poly_expansion(g, poly_n, poly_sigma),
+                                   axis=-1)
+        return poly_all[:-1], poly_all[1:]
+
+    return _coarse_to_fine(polys_at, bp1 - 1, gray_seq.shape[1],
+                           gray_seq.shape[2], gray_seq.dtype,
+                           levels, pyr_scale, win_size, n_iters)
+
+
+def _coarse_to_fine(polys_at, b, h, w, dtype, levels, pyr_scale, win_size,
+                    n_iters) -> jnp.ndarray:
+    """Shared coarse-to-fine pyramid loop: ``polys_at(lvl, lh, lw)``
+    supplies the (poly1, poly2) pair stacks per level — the only thing
+    that differs between the pairwise and sequence entry points."""
+    win_kern = gaussian_kernel_1d(win_size, win_size / 6.0)
     shapes = []
     for lvl in range(levels):
         scale = pyr_scale ** lvl
@@ -225,12 +306,9 @@ def farneback_flow(
     flow = None
     for lvl in range(levels - 1, -1, -1):
         lh, lw = shapes[lvl]
-        p = jax.image.resize(prev_gray, (b, lh, lw, 1), method="linear")
-        c = jax.image.resize(curr_gray, (b, lh, lw, 1), method="linear")
-        poly1 = jnp.concatenate(poly_expansion(p, poly_n, poly_sigma), axis=-1)
-        poly2 = jnp.concatenate(poly_expansion(c, poly_n, poly_sigma), axis=-1)
+        poly1, poly2 = polys_at(lvl, lh, lw)
         if flow is None:
-            flow = jnp.zeros((b, lh, lw, 2), dtype=prev_gray.dtype)
+            flow = jnp.zeros((b, lh, lw, 2), dtype=dtype)
         else:
             ph, pw = shapes[lvl + 1]
             flow = jax.image.resize(flow, (b, lh, lw, 2), method="linear")
@@ -242,13 +320,6 @@ def farneback_flow(
 # ---------------------------------------------------------------------------
 # filters
 # ---------------------------------------------------------------------------
-
-def _temporal_pairs(batch: jnp.ndarray, state_prev: jnp.ndarray):
-    """prev[i] for each batch element: state carries the last frame of the
-    previous batch, so consecutive batches chain seamlessly."""
-    prev = jnp.concatenate([state_prev[None], batch[:-1]], axis=0)
-    return prev
-
 
 @register_filter("flow_warp")
 def flow_warp(
@@ -298,14 +369,18 @@ def flow_warp(
 
     def fn(batch: jnp.ndarray, state) -> Tuple[jnp.ndarray, Any]:
         bsz, h, w, c = batch.shape
-        prev = _temporal_pairs(batch, state["prev"])
-        pg = rgb_to_gray(prev)
-        cg = rgb_to_gray(batch)
+        # Sequence form: frame i is curr of pair i and prev of pair i+1,
+        # so gray conversion, downscale, pyramid, and poly expansion run
+        # once per unique frame (B+1) instead of once per role (2B); the
+        # per-pair prev stack is a view of the same concat.
+        seq = jnp.concatenate([state["prev"][None], batch], axis=0)
+        prev = seq[:-1]
+        sg = rgb_to_gray(seq)
         if flow_scale > 1:
             sh, sw = h // flow_scale, w // flow_scale
-            pg = jax.image.resize(pg, (bsz, sh, sw, 1), method="linear")
-            cg = jax.image.resize(cg, (bsz, sh, sw, 1), method="linear")
-        flow = farneback_flow(pg, cg, levels=levels, win_size=win_size, n_iters=n_iters)
+            sg = jax.image.resize(sg, (bsz + 1, sh, sw, 1), method="linear")
+        flow = farneback_flow_seq(sg, levels=levels, win_size=win_size,
+                                  n_iters=n_iters)
         if flow_scale > 1:
             flow = jax.image.resize(flow, (bsz, h, w, 2), method="linear") * float(flow_scale)
         if warp_impl == "pallas":
@@ -344,9 +419,10 @@ def flow_vis(levels: int = 3, win_size: int = 15, n_iters: int = 3, max_mag: flo
         }
 
     def fn(batch: jnp.ndarray, state) -> Tuple[jnp.ndarray, Any]:
-        prev = _temporal_pairs(batch, state["prev"])
-        flow = farneback_flow(rgb_to_gray(prev), rgb_to_gray(batch),
-                              levels=levels, win_size=win_size, n_iters=n_iters)
+        seq = jnp.concatenate([state["prev"][None], batch], axis=0)
+        flow = farneback_flow_seq(rgb_to_gray(seq),
+                                  levels=levels, win_size=win_size,
+                                  n_iters=n_iters)
         mag = jnp.sqrt(jnp.sum(flow * flow, axis=-1))
         ang = jnp.arctan2(flow[..., 1], flow[..., 0])  # [-pi, pi]
         hue = (ang + jnp.pi) / (2.0 * jnp.pi)          # [0, 1]

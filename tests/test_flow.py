@@ -189,3 +189,47 @@ class TestEmaSmooth:
 
         with _pytest.raises(ValueError):
             get_filter("ema_smooth", alpha=0.0)
+
+
+def test_poly_expansion_matches_unfused_sep_convs():
+    """The fused moment computation (one pad, shared vertical passes) must
+    be bit-identical to six independent sep_conv2d(impl='shift') calls —
+    same taps, same accumulation order."""
+    import numpy as np
+
+    from dvf_tpu.ops.conv import sep_conv2d
+    from dvf_tpu.ops.flow import _poly_exp_setup, poly_expansion
+
+    rng = np.random.default_rng(3)
+    gray = jnp.asarray(rng.random((2, 24, 31, 1), dtype=np.float32))
+    n, sigma = 5, 1.1
+    k0, k1, k2, Ginv = _poly_exp_setup(n, sigma)
+    v = jnp.stack([
+        sep_conv2d(gray, k0, k0), sep_conv2d(gray, k0, k1),
+        sep_conv2d(gray, k1, k0), sep_conv2d(gray, k0, k2),
+        sep_conv2d(gray, k2, k0), sep_conv2d(gray, k1, k1),
+    ], axis=-1)
+    r = jnp.einsum("...i,ji->...j", v, Ginv)
+    want = (r[..., 3], r[..., 5] * 0.5, r[..., 4], r[..., 1], r[..., 2])
+    got = poly_expansion(gray, n, sigma)
+    for g, w, name in zip(got, want, ("A11", "A12", "A22", "b1", "b2")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=1e-7, err_msg=name)
+
+
+def test_farneback_seq_matches_pairwise():
+    """farneback_flow_seq dedups the overlapping prev/curr roles of a
+    consecutive-frame batch; its flows must match the pairwise form."""
+    import numpy as np
+
+    from dvf_tpu.ops.flow import farneback_flow, farneback_flow_seq
+
+    rng = np.random.default_rng(11)
+    seq = jnp.asarray(rng.random((4, 32, 40, 1), dtype=np.float32))
+    want = farneback_flow(seq[:-1], seq[1:], levels=2, win_size=9, n_iters=2)
+    got = farneback_flow_seq(seq, levels=2, win_size=9, n_iters=2)
+    # Same per-frame math, but XLA fuses the stacked sequence differently
+    # than two pair stacks; the reassociation noise passes through the
+    # regularized 2x2 solve. 1e-4 px is far below any visible flow.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.0, atol=1e-4)
