@@ -65,11 +65,15 @@ def test_sr_matches_committed_golden(sr_eval):
         f"SR frame drifted from golden: mean={diff.mean():.2f} max={diff.max()}")
 
 
-def test_serve_loads_sr_checkpoint(capsys):
+@pytest.mark.parametrize("ckpt", [
+    CKPT,
+    os.path.join(os.path.dirname(__file__), "..", "checkpoints", "sr2x_128"),
+], ids=["sr2x_64", "sr2x_128"])
+def test_serve_loads_sr_checkpoint(capsys, ckpt):
     from dvf_tpu.cli import main
 
     rc = main([
-        "serve", "--sr-checkpoint", CKPT,
+        "serve", "--sr-checkpoint", ckpt,
         "--source", "synthetic", "--height", "64", "--width", "64",
         "--frames", "8", "--batch", "4", "--frame-delay", "0",
         "--queue-size", "64",
