@@ -469,3 +469,55 @@ def test_latency_backoff_zero_target_returns_congested(monkeypatch):
     r = B.bench_e2e_latency(object(), n_frames=12, batch_size=8, height=8,
                             width=8, target_fps=0.0)
     assert r["congested"] is True
+
+
+def test_latency_backoff_invariants_property(monkeypatch):
+    """Property check over arbitrary congestion patterns: the backoff
+    loop always terminates within max_backoffs+1 attempts, rates halve
+    monotonically, frame counts never increase (floored at
+    min(16, original)), the returned numbers are the LAST attempt's, and
+    the congested flag matches that attempt's verdict."""
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    import dvf_tpu.benchmarks as B
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        congested_seq=st.lists(st.booleans(), min_size=1, max_size=8),
+        n_frames=st.integers(min_value=1, max_value=200),
+        target=st.floats(min_value=0.05, max_value=500.0),
+        max_backoffs=st.integers(min_value=0, max_value=4),
+    )
+    def check(congested_seq, n_frames, target, max_backoffs):
+        attempts = []
+
+        def scripted(filt, source, *a, **kw):
+            i = len(attempts)
+            attempts.append((source.rate, source.n_frames))
+            cong = congested_seq[min(i, len(congested_seq) - 1)]
+            return {"fps": source.rate, "frames": source.n_frames,
+                    "delivery_fps": (source.rate * 0.1 if cong
+                                     else source.rate),
+                    "wall_s": 1.0, "p50_ms": 100.0 + i, "p99_ms": 200.0 + i,
+                    "dropped": 50 if cong else 0}
+
+        monkeypatch.setattr(B, "_run_pipeline", scripted)
+        r = B.bench_e2e_latency(object(), n_frames=n_frames, batch_size=8,
+                                height=8, width=8, target_fps=target,
+                                max_backoffs=max_backoffs)
+        assert 1 <= len(attempts) <= max_backoffs + 1
+        rates = [a[0] for a in attempts]
+        frames = [a[1] for a in attempts]
+        for j in range(1, len(attempts)):
+            assert rates[j] == rates[j - 1] / 2.0
+            assert frames[j] <= frames[j - 1]
+            assert frames[j] >= min(16, n_frames)
+        assert r["backoffs"] == len(attempts) - 1
+        assert r["target_fps"] == rates[-1]
+        assert r["p50_ms"] == 100.0 + len(attempts) - 1  # last attempt's
+        last_cong = congested_seq[min(len(attempts) - 1,
+                                      len(congested_seq) - 1)]
+        assert r["congested"] is last_cong
+
+    check()
