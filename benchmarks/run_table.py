@@ -107,6 +107,18 @@ COMPARISONS = {
     # target 32) currently chooses at H=1080; 8/40/120 bracket it with
     # the other 8-aligned divisors of 1080. A measured winner ≠ 24 gets
     # wired as the per-backend default tile target.
+    # ALGORITHM-VARIANT comparison (not a numerics-identical impl swap,
+    # so the registry never auto-defaults on its winner): the window that
+    # averages Farneback's structure tensors. "gauss" = our default
+    # (OPTFLOW_FARNEBACK_GAUSSIAN parity, 15-tap separable FMA); "box" =
+    # cv2's flags=0 default, an O(1)-per-pixel running-sum filter —
+    # 15× fewer window FLOPs, different (slightly blunter) flow.
+    "flow_win_720p": (720, 1280, 4, [
+        ("gauss_win", "flow_warp", {"warp_impl": "pallas",
+                                    "win_type": "gaussian"}),
+        ("box_win", "flow_warp", {"warp_impl": "pallas",
+                                  "win_type": "box"}),
+    ]),
     "bilateral_tile_1080p": (1080, 1920, 8, [
         ("tile8", "bilateral_pallas", {"tile_h": 8}),
         ("tile24", "bilateral_pallas", {"tile_h": 24}),

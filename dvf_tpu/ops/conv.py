@@ -159,12 +159,53 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0,
     return stateless(f"gaussian_blur(k={ksize},s={sigma})", fn, halo=ksize // 2)
 
 
+def box_filter(x: jnp.ndarray, win: int) -> jnp.ndarray:
+    """Uniform win×win windowed MEAN via running sums — O(1) per pixel in
+    the window size (vs win taps/axis for the FMA formulation), NHWC,
+    reflect borders like :func:`dvf_tpu.ops.conv.sep_conv2d`.
+
+    This is cv2's Farneback default window (``flags=0`` runs a box blur
+    over the structure-tensor images; the Gaussian window is opt-in via
+    OPTFLOW_FARNEBACK_GAUSSIAN) — the parity surface behind
+    ``flow_warp(win_type="box")`` and ``box_blur(impl="cumsum")``."""
+    if win % 2 != 1 or win < 1:
+        raise ValueError(f"win must be odd and positive, got {win}")
+    r = win // 2
+    xp = jnp.pad(x, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
+
+    def running(axis, c):
+        zeros = jnp.zeros_like(lax.slice_in_dim(c, 0, 1, axis=axis))
+        hi = lax.slice_in_dim(c, win - 1, None, axis=axis)
+        lo = jnp.concatenate(
+            [zeros, lax.slice_in_dim(c, 0, c.shape[axis] - win, axis=axis)],
+            axis=axis)
+        return hi - lo
+
+    s = running(1, jnp.cumsum(xp, axis=1))
+    s = running(2, jnp.cumsum(s, axis=2))
+    return s / float(win * win)
+
+
 @register_filter("box_blur")
 def box_blur(ksize: int = 3, impl: str = "shift") -> Filter:
-    """Separable box (mean) blur."""
+    """Separable box (mean) blur.
+
+    ``impl``: "shift"/"depthwise" (sep_conv2d lowerings) or "cumsum"
+    (:func:`box_filter` running sums — O(1) per pixel in ksize, though
+    measured SLOWER than the fused shift pass on CPU at ksize 15: the
+    scan's dependency chain defeats fusion; kept for A/B measurement)."""
+    if impl not in ("shift", "depthwise", "cumsum"):
+        raise ValueError(
+            f"impl must be 'shift', 'depthwise' or 'cumsum', got {impl!r}")
+    if impl == "cumsum" and (ksize % 2 != 1 or ksize < 1):
+        # Validate at construction (the pattern gaussian_blur documents):
+        # deferring surfaces the error deep inside box_filter's trace.
+        raise ValueError(f"ksize must be odd for impl='cumsum', got {ksize}")
     kern = np.full((ksize,), 1.0 / ksize, dtype=np.float32)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        if impl == "cumsum":
+            return box_filter(batch, ksize)
         return sep_conv2d(batch, kern, kern, impl=impl)
 
     return stateless(f"box_blur(k={ksize})", fn, halo=ksize // 2)

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.ops.conv import sep_conv2d, gaussian_kernel_1d
+from dvf_tpu.ops.conv import box_filter, sep_conv2d, gaussian_kernel_1d
 from dvf_tpu.ops.registry import measured_default, register_filter
 from dvf_tpu.utils.image import rgb_to_gray
 
@@ -189,9 +189,11 @@ def poly_expansion(gray: jnp.ndarray, n: int = 5, sigma: float = 1.1):
 # ---------------------------------------------------------------------------
 
 def _flow_level(
-    poly1, poly2, flow: jnp.ndarray, win_kern: jnp.ndarray, n_iters: int
+    poly1, poly2, flow: jnp.ndarray, smooth, n_iters: int
 ) -> jnp.ndarray:
-    """Refine ``flow`` at one pyramid level. poly*: stacked (B,H,W,5)."""
+    """Refine ``flow`` at one pyramid level. poly*: stacked (B,H,W,5);
+    ``smooth(x)``: the window average applied to the structure-tensor
+    images (Gaussian sep-conv or box running-sum)."""
     A11_1, A12_1, A22_1, b1_1, b2_1 = [poly1[..., i : i + 1] for i in range(5)]
 
     for _ in range(n_iters):
@@ -205,14 +207,14 @@ def _flow_level(
         db1 = -0.5 * (b1_2 - b1_1) + (A11 * fx + A12 * fy)
         db2 = -0.5 * (b2_2 - b2_1) + (A12 * fx + A22 * fy)
 
-        # Per-pixel normal equations, averaged over the Gaussian window.
+        # Per-pixel normal equations, averaged over the window.
         t11 = A11 * A11 + A12 * A12
         t12 = A12 * (A11 + A22)
         t22 = A12 * A12 + A22 * A22
         h1 = A11 * db1 + A12 * db2
         h2 = A12 * db1 + A22 * db2
         stacked = jnp.concatenate([t11, t12, t22, h1, h2], axis=-1)
-        sm = sep_conv2d(stacked, win_kern, win_kern)
+        sm = smooth(stacked)
         g11, g12, g22 = sm[..., 0:1], sm[..., 1:2], sm[..., 2:3]
         s1, s2 = sm[..., 3:4], sm[..., 4:5]
         # Scale-invariant Tikhonov: image intensities are O(1) but the
@@ -238,10 +240,14 @@ def farneback_flow(
     n_iters: int = 3,
     poly_n: int = 5,
     poly_sigma: float = 1.1,
+    win_type: str = "gaussian",
 ) -> jnp.ndarray:
     """Dense flow (B,H,W,2) mapping prev -> curr, cv2-convention.
 
     All shapes/levels are static — the pyramid unrolls at trace time.
+    ``win_type``: "gaussian" (OPTFLOW_FARNEBACK_GAUSSIAN parity, the
+    committed-golden default) or "box" (cv2's flags=0 default window;
+    O(1) running-sum smoothing per pixel regardless of win_size).
     """
     b = prev_gray.shape[0]
 
@@ -253,7 +259,7 @@ def farneback_flow(
 
     return _coarse_to_fine(polys_at, b, prev_gray.shape[1],
                            prev_gray.shape[2], prev_gray.dtype,
-                           levels, pyr_scale, win_size, n_iters)
+                           levels, pyr_scale, win_size, n_iters, win_type)
 
 
 def farneback_flow_seq(
@@ -264,6 +270,7 @@ def farneback_flow_seq(
     n_iters: int = 3,
     poly_n: int = 5,
     poly_sigma: float = 1.1,
+    win_type: str = "gaussian",
 ) -> jnp.ndarray:
     """Flow for every CONSECUTIVE pair of a frame sequence.
 
@@ -289,15 +296,22 @@ def farneback_flow_seq(
 
     return _coarse_to_fine(polys_at, bp1 - 1, gray_seq.shape[1],
                            gray_seq.shape[2], gray_seq.dtype,
-                           levels, pyr_scale, win_size, n_iters)
+                           levels, pyr_scale, win_size, n_iters, win_type)
 
 
 def _coarse_to_fine(polys_at, b, h, w, dtype, levels, pyr_scale, win_size,
-                    n_iters) -> jnp.ndarray:
+                    n_iters, win_type: str = "gaussian") -> jnp.ndarray:
     """Shared coarse-to-fine pyramid loop: ``polys_at(lvl, lh, lw)``
     supplies the (poly1, poly2) pair stacks per level — the only thing
     that differs between the pairwise and sequence entry points."""
-    win_kern = gaussian_kernel_1d(win_size, win_size / 6.0)
+    if win_type == "gaussian":
+        win_kern = gaussian_kernel_1d(win_size, win_size / 6.0)
+        smooth = lambda x: sep_conv2d(x, win_kern, win_kern)  # noqa: E731
+    elif win_type == "box":
+        smooth = lambda x: box_filter(x, win_size)  # noqa: E731
+    else:
+        raise ValueError(
+            f"win_type must be 'gaussian' or 'box', got {win_type!r}")
     shapes = []
     for lvl in range(levels):
         scale = pyr_scale ** lvl
@@ -313,7 +327,7 @@ def _coarse_to_fine(polys_at, b, h, w, dtype, levels, pyr_scale, win_size,
             ph, pw = shapes[lvl + 1]
             flow = jax.image.resize(flow, (b, lh, lw, 2), method="linear")
             flow = flow * jnp.asarray([lw / pw, lh / ph], dtype=flow.dtype)
-        flow = _flow_level(poly1, poly2, flow, win_kern, n_iters)
+        flow = _flow_level(poly1, poly2, flow, smooth, n_iters)
     return flow
 
 
@@ -329,6 +343,7 @@ def flow_warp(
     flow_scale: int = 2,
     warp_impl: Optional[str] = None,
     max_disp: int = 4,
+    win_type: str = "gaussian",
 ) -> Filter:
     """Motion-compensate each previous frame onto the current one.
 
@@ -337,6 +352,11 @@ def flow_warp(
     2-frame temporal window of BASELINE.json configs[3] lives on-device.
     ``flow_scale``: flow is estimated at 1/flow_scale resolution and
     upsampled (cost dominated by poly expansion at full res otherwise).
+    ``win_type``: "gaussian" (default; OPTFLOW_FARNEBACK_GAUSSIAN
+    parity — the committed goldens use it) or "box" (cv2's flags=0
+    default window, smoothed by an O(1) running-sum box filter — a
+    different algorithm variant, not a numerics-identical impl swap, so
+    the registry never auto-defaults to it on speed alone).
     ``warp_impl``: "gather" = XLA dynamic-gather bilinear sample;
     "pallas" = gather-free bounded-displacement kernel
     (:func:`dvf_tpu.ops.pallas_kernels.warp_bounded_pallas`), which clips
@@ -359,6 +379,14 @@ def flow_warp(
         warp_impl = measured_default({"tpu": "pallas"}, fallback="gather")
     if warp_impl not in ("gather", "pallas"):
         raise ValueError(f"warp_impl must be 'gather' or 'pallas', got {warp_impl!r}")
+    if win_type not in ("gaussian", "box"):
+        raise ValueError(
+            f"win_type must be 'gaussian' or 'box', got {win_type!r}")
+    if win_type == "box" and win_size % 2 != 1:
+        # The running-sum window needs an odd extent; fail here with the
+        # caller's parameter name, not deep inside box_filter's trace.
+        raise ValueError(
+            f"win_size must be odd when win_type='box', got {win_size}")
 
     def init_state(batch_shape: Sequence[int], dtype: Any):
         _, h, w, c = batch_shape
@@ -380,7 +408,7 @@ def flow_warp(
             sh, sw = h // flow_scale, w // flow_scale
             sg = jax.image.resize(sg, (bsz + 1, sh, sw, 1), method="linear")
         flow = farneback_flow_seq(sg, levels=levels, win_size=win_size,
-                                  n_iters=n_iters)
+                                  n_iters=n_iters, win_type=win_type)
         if flow_scale > 1:
             flow = jax.image.resize(flow, (bsz, h, w, 2), method="linear") * float(flow_scale)
         if warp_impl == "pallas":
@@ -401,7 +429,8 @@ def flow_warp(
         return out.astype(batch.dtype), new_state
 
     return Filter(
-        name=f"flow_warp(levels={levels},win={win_size},warp={warp_impl})",
+        name=(f"flow_warp(levels={levels},win={win_size},warp={warp_impl}"
+              f"{',box' if win_type == 'box' else ''})"),
         fn=fn,
         init_state=init_state,
     )
