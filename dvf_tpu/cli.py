@@ -346,13 +346,36 @@ def cmd_serve(args) -> int:
 
     if args.display:
         tap = LiveTap(source)
-        sink = SideBySideSink(
-            tap,
-            headless=args.headless,
-            telemetry_interval_s=config.telemetry_interval_s,
-        )
+        if args.display_backend == "gl":
+            # The reference's literal draw path — GL texture blits
+            # (webcam_app.py:118-150) — against a surfaceless EGL
+            # context; offscreen by design (last_pane carries the canvas).
+            from dvf_tpu.io.gl_display import (
+                GLRenderer,
+                GLSideBySideSink,
+                GLUnavailable,
+            )
+
+            # Fail fast: without this probe a missing GL stack would
+            # first surface inside sink.emit, where resilient mode
+            # contains it once per frame and serve exits 0 having
+            # displayed nothing.
+            try:
+                GLRenderer(8, 8).close()
+            except GLUnavailable as e:
+                print(f"error: --display-backend gl unavailable: {e}",
+                      file=sys.stderr)
+                return 2
+            sink = GLSideBySideSink(
+                tap, telemetry_interval_s=config.telemetry_interval_s)
+        else:
+            sink = SideBySideSink(
+                tap,
+                headless=args.headless,
+                telemetry_interval_s=config.telemetry_interval_s,
+            )
         pipe = Pipeline(tap, filt, sink, config, engine=engine, queue=queue)
-        sink.stop_cb = pipe.stop        # ESC → graceful stop
+        sink.stop_cb = pipe.stop        # ESC → graceful stop (cv2 backend)
         sink.stats_fn = pipe.stats
     else:
         sink = NullSink()
@@ -866,6 +889,11 @@ def main(argv=None) -> int:
                     help="side-by-side live|processed window (ESC stops)")
     sp.add_argument("--headless", action="store_true",
                     help="with --display: compose panes but open no window")
+    sp.add_argument("--display-backend", choices=("cv2", "gl"),
+                    default="cv2",
+                    help="pane composition: cv2 window (interactive) or "
+                         "the reference's GL texture-blit path rendered "
+                         "offscreen via surfaceless EGL (headless-capable)")
     sp.add_argument("--fail-fast", action="store_true",
                     help="abort on the first error instead of containing it")
     sp.add_argument("--quiet", action="store_true", help="no 5s telemetry prints")

@@ -28,6 +28,16 @@ import numpy as np
 from dvf_tpu.obs.metrics import RateLogger
 
 
+def letterbox_geometry(src_h: int, src_w: int, dst_h: int, dst_w: int):
+    """Aspect-preserving fit of src into dst: ``(fit_h, fit_w)``, each ≥1.
+
+    Shared by the cv2 and GL display backends so their panes scale
+    identically."""
+    scale = min(dst_h / src_h, dst_w / src_w)
+    return (max(1, int(round(src_h * scale))),
+            max(1, int(round(src_w * scale))))
+
+
 class LiveTap:
     """Source wrapper: passes frames through, keeping the newest one."""
 
@@ -88,9 +98,7 @@ class SideBySideSink:
             # aspect, centered on a black canvas — never corner-crop, which
             # would misrepresent a larger live feed in the comparison.
             h, w = processed.shape[:2]
-            scale = min(h / live.shape[0], w / live.shape[1])
-            sh = max(1, int(round(live.shape[0] * scale)))
-            sw = max(1, int(round(live.shape[1] * scale)))
+            sh, sw = letterbox_geometry(live.shape[0], live.shape[1], h, w)
             if (sh, sw) != live.shape[:2]:
                 # Centered nearest-neighbor (sample at pixel centers, not
                 # top-left corners — corner sampling never reads the last
