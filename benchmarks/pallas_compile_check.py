@@ -88,6 +88,19 @@ def main(argv=None) -> int:
             lambda i, f: warp_bounded_pallas(i, f, interpret=interp),
             (frame720, flow)),
     }
+    # The flow_inner_720p A/B warps FIVE-channel poly stacks at the
+    # flow-estimation geometry (720p / flow_scale 2 → 360×640) — a
+    # different C and W than the final-warp case above, so its DMA slab
+    # extents and VMEM footprint need their own lowering vouch.
+    if args.quick:
+        poly = jax.ShapeDtypeStruct((2, 48, 64, 5), jnp.float32)
+        pflow = jax.ShapeDtypeStruct((2, 48, 64, 2), jnp.float32)
+    else:
+        poly = jax.ShapeDtypeStruct((4, 360, 640, 5), jnp.float32)
+        pflow = jax.ShapeDtypeStruct((4, 360, 640, 2), jnp.float32)
+    cases["flow_inner_warp_5ch"] = (
+        lambda i, f: warp_bounded_pallas(i, f, interpret=interp),
+        (poly, pflow))
     # Tile sweep (run_table *_tile_1080p comparisons): each non-default
     # tile_h changes the DMA slab extents and VMEM footprint — verify
     # lowering data-free before the sweep burns on-chip window time.

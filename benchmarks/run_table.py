@@ -97,16 +97,6 @@ COMPARISONS = {
         ("shift", "gaussian_blur", {"ksize": 3, "impl": "shift"}),
         ("pallas_fused", "gaussian_blur_pallas", {"ksize": 3}),
     ]),
-    # Tile-height sweeps for the two winning kernels with the most
-    # roofline headroom (bilateral 0.30, fused sobel_bilateral 0.42 of
-    # the HBM ceiling on-chip): tile_h sets the rows-per-program of the
-    # (batch, H-tiles) grid and hence the DMA slab size and halo-refetch
-    # overhead (halo rows are re-read once per tile: small tiles pay more
-    # redundant HBM traffic, large tiles pay VMEM pressure and less
-    # grid-level parallelism). 24 is what the auto-picker (_pick_tile_h,
-    # target 32) currently chooses at H=1080; 8/40/120 bracket it with
-    # the other 8-aligned divisors of 1080. A measured winner ≠ 24 gets
-    # wired as the per-backend default tile target.
     # ALGORITHM-VARIANT comparison (not a numerics-identical impl swap,
     # so the registry never auto-defaults on its winner): the window that
     # averages Farneback's structure tensors. "gauss" = our default
@@ -119,6 +109,27 @@ COMPARISONS = {
         ("box_win", "flow_warp", {"warp_impl": "pallas",
                                   "win_type": "box"}),
     ]),
+    # APPROXIMATION-variant comparison (like flow_win_720p, no registry
+    # auto-default): the 9 inner-loop warps of the 5-channel poly stacks
+    # through the bounded Pallas shift warp vs exact XLA gathers. The
+    # final-warp A/B already measured the same kernel 2.3× faster on one
+    # 3-channel full-res warp; the inner loop is where most warp work is.
+    "flow_inner_720p": (720, 1280, 4, [
+        ("gather_inner", "flow_warp", {"warp_impl": "pallas",
+                                       "inner_warp": "gather"}),
+        ("pallas_inner", "flow_warp", {"warp_impl": "pallas",
+                                       "inner_warp": "pallas"}),
+    ]),
+    # Tile-height sweeps for the two winning kernels with the most
+    # roofline headroom (bilateral 0.30, fused sobel_bilateral 0.42 of
+    # the HBM ceiling on-chip): tile_h sets the rows-per-program of the
+    # (batch, H-tiles) grid and hence the DMA slab size and halo-refetch
+    # overhead (halo rows are re-read once per tile: small tiles pay more
+    # redundant HBM traffic, large tiles pay VMEM pressure and less
+    # grid-level parallelism). 24 is what the auto-picker (_pick_tile_h,
+    # target 32) currently chooses at H=1080; 8/40/120 bracket it with
+    # the other 8-aligned divisors of 1080. A measured winner ≠ 24 gets
+    # wired as the per-backend default tile target.
     "bilateral_tile_1080p": (1080, 1920, 8, [
         ("tile8", "bilateral_pallas", {"tile_h": 8}),
         ("tile24", "bilateral_pallas", {"tile_h": 24}),

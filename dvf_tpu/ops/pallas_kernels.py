@@ -269,7 +269,7 @@ def warp_bounded_pallas(
     flow: jnp.ndarray,
     max_disp: int = 4,
     tile_h: Optional[int] = None,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Backward-warp ``img`` (B,H,W,C) by ``flow`` (B,H,W,2; [...,0]=dx)
     with displacements clipped to ±``max_disp`` px.
@@ -279,8 +279,10 @@ def warp_bounded_pallas(
     coordinate clamping for any |f| ≤ max_disp). The (2·max_disp+2)² hat-
     weighted static shifts trade FLOPs for the dynamic gathers TPUs hate —
     worth it while max_disp stays small (Farneback flows at video rates
-    are a few px).
+    are a few px). ``interpret=None`` auto-selects: compiled on TPU,
+    interpret mode elsewhere.
     """
+    interpret = _auto_interpret(interpret)
     R = int(max_disp)
     if R < 1:
         raise ValueError("max_disp must be >= 1")

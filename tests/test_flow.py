@@ -288,3 +288,40 @@ def test_box_window_comparable_to_cv2_default_flags(rng):
     inner = np.s_[16:-16, 16:-16]
     err = np.linalg.norm(ours[inner] - ref[inner], axis=-1).mean()
     assert err < 0.05, f"mean EPE vs cv2 (flags=0, box window) = {err}"
+
+
+def test_inner_warp_pallas_recovers_translation(rng):
+    """The bounded Pallas inner warp (opt-in approximation: each
+    refinement step's displacement clipped to ±max_disp) must still
+    recover a small uniform translation like the exact gather path."""
+    base = _textured(rng, 64, 96)
+    shift = np.roll(base, -2, axis=1)
+    prev = jnp.asarray(base)[None, ..., None]
+    curr = jnp.asarray(shift)[None, ..., None]
+    flow = np.asarray(farneback_flow(prev, curr, levels=2, win_size=11,
+                                     n_iters=2, inner_warp="pallas"))
+    inner = flow[0, 16:-16, 16:-16]
+    assert abs(inner[..., 0].mean() - (-2.0)) < 0.5, inner[..., 0].mean()
+    assert abs(inner[..., 1].mean()) < 0.5
+
+
+def test_inner_warp_close_to_gather_for_small_motion(rng):
+    """Within the clip bound the two inner warps sample the same values,
+    so the flows must agree closely."""
+    base = _textured(rng, 48, 64)
+    shift = np.roll(base, -1, axis=1)
+    prev = jnp.asarray(base)[None, ..., None]
+    curr = jnp.asarray(shift)[None, ..., None]
+    a = np.asarray(farneback_flow(prev, curr, levels=2, win_size=11,
+                                  n_iters=2, inner_warp="gather"))
+    b = np.asarray(farneback_flow(prev, curr, levels=2, win_size=11,
+                                  n_iters=2, inner_warp="pallas"))
+    inner = np.s_[:, 12:-12, 12:-12, :]
+    assert np.abs(a[inner] - b[inner]).mean() < 0.05
+
+
+def test_inner_warp_validated_at_construction():
+    import pytest
+
+    with pytest.raises(ValueError, match="inner_warp"):
+        get_filter("flow_warp", inner_warp="scatter")
