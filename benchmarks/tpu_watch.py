@@ -94,9 +94,13 @@ def main(argv=None) -> int:
         # errored legs persist their own diagnostics) — this log line is
         # what makes a sweep-leg ERR immediately attributable to lowering
         # vs. a dead tunnel.
+        # 10 cases × ~20-40 s per uncached tunnel compile: the first
+        # healthy window pays up to ~400 s (the persistent compile cache
+        # makes later windows near-free, and warms the same cache the
+        # A/B legs reuse).
         rc, out, err = run_cmd(
             [sys.executable, "benchmarks/pallas_compile_check.py"],
-            env, 300.0, cwd=REPO)
+            env, 600.0, cwd=REPO)
         # rc semantics (pallas_compile_check.py): 0 = all lowered on TPU,
         # 1 = a kernel FAILED to lower, 3 = clean trace but the backend
         # came up CPU (tunnel died between probe and check — not a
