@@ -22,7 +22,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from dvf_tpu.api.filter import Filter, stateless
-from dvf_tpu.ops.registry import measured_default, register_filter
+from dvf_tpu.ops.registry import measured_default_for, register_filter
 
 
 def bilateral_nhwc(
@@ -71,7 +71,7 @@ def bilateral(d: int = 5, sigma_color: float = 0.1, sigma_space: float = 2.0,
     Both impls declare the same halo, so spatial sharding is unaffected.
     """
     if impl is None:
-        impl = measured_default({"tpu": "pallas"}, fallback="jnp")
+        impl = measured_default_for("bilateral")
     if impl == "pallas":
         from dvf_tpu.ops.registry import get_filter
 

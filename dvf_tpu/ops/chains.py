@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 from dvf_tpu.api.filter import Filter, FilterChain
-from dvf_tpu.ops.registry import get_filter, measured_default, register_filter
+from dvf_tpu.ops.registry import get_filter, measured_default_for, register_filter
 
 
 @register_filter("sobel_bilateral")
@@ -34,8 +34,7 @@ def sobel_bilateral(
     spatial sharding is unaffected by the choice.
     """
     if impl is None:
-        impl = measured_default({"cpu": "pallas", "tpu": "pallas"},
-                                fallback="chain")
+        impl = measured_default_for("sobel_bilateral")
     if impl == "pallas":
         return get_filter("sobel_bilateral_pallas", d=d,
                           sigma_color=sigma_color, sigma_space=sigma_space,

@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax
 
 from dvf_tpu.api.filter import Filter, stateless
-from dvf_tpu.ops.registry import get_filter, measured_default, register_filter
+from dvf_tpu.ops.registry import get_filter, measured_default_for, register_filter
 from dvf_tpu.utils.image import rgb_to_gray
 
 _DN = ("NHWC", "HWIO", "NHWC")  # conv dimension numbers used throughout
@@ -124,26 +124,26 @@ def gaussian_blur(ksize: int = 9, sigma: float = 0.0,
                   impl: Optional[str] = None) -> Filter:
     """Separable Gaussian blur matching cv2.GaussianBlur taps.
 
-    ``impl=None`` picks the measured per-backend winner for large
-    kernels — the fused Pallas lowering on BOTH measured backends at
-    ksize≥9: TPU 1726 vs 1027 fps at 1080p batch 8 (1.7× over the
-    shifted-FMA rework), CPU 15.3 vs 9.3 fps (one VMEM residency
-    instead of two passes; interpret mode lowers to ordinary fused XLA
-    ops). "shift" stays the default for small kernels — MEASURED, not
-    assumed, since round 4: the gauss3_1080p TPU A/B has shift at 1861 vs
-    pallas 1591 fps (at 3 taps XLA's single fused pass is already one HBM
-    round-trip, and the Pallas kernel's DMA-slab staging costs more than
-    the fusion saves) — and for backends whose A/B hasn't been captured.
-    Explicit impl pins
-    (the A/B harness passes "shift"/"depthwise"). Provenance: the
-    gauss9_1080p impl-comparison rows in benchmarks/BENCH_TABLE.md (TPU)
-    and benchmarks/cpu/ (CPU). Halo is ksize//2 for every impl, so
-    spatial sharding is unaffected.
+    ``impl=None`` picks the measured per-backend winner from the committed
+    A/B rows (``MEASURED_DEFAULTS`` in :mod:`dvf_tpu.ops.registry`; a test
+    asserts the map matches benchmarks/*/BENCH_TABLE.json). Current
+    winners: **TPU = "shift" at every ksize** — the gauss9_1080p A/B has
+    shift at 1022 vs pallas_fused 186 fps (1080p batch 8) and gauss3_1080p
+    has shift 1861 vs pallas 1613 (at 3 taps XLA's single fused pass is
+    already one HBM round-trip, and the Pallas kernel's DMA-slab staging
+    costs more than the fusion saves). An earlier round published "Pallas
+    wins gauss9 1.7×", but that measured a kernel that never lowered
+    through Mosaic (pre-accefc6); the post-fix A/B is the provenance of
+    record, and a same-window re-run is queued since its pallas leg's
+    0.043 HBM fraction is also consistent with a dying tunnel. **CPU =
+    "pallas" at ksize≥9** (15.3 vs 9.3 fps — interpret mode lowers to one
+    fused XLA pass instead of two), "shift" below. Explicit impl pins (the
+    A/B harness passes "shift"/"depthwise"). Halo is ksize//2 for every
+    impl, so spatial sharding is unaffected.
     """
     if impl is None:
-        impl = (measured_default({"cpu": "pallas", "tpu": "pallas"},
-                                 fallback="shift")
-                if ksize >= 9 else "shift")
+        impl = measured_default_for(
+            "gaussian_blur_k9" if ksize >= 9 else "gaussian_blur_small")
     if impl == "pallas":
         return get_filter("gaussian_blur_pallas", ksize=ksize, sigma=sigma)
     if impl not in ("shift", "depthwise"):

@@ -36,7 +36,7 @@ from jax import lax
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.ops.conv import box_filter, sep_conv2d, gaussian_kernel_1d
-from dvf_tpu.ops.registry import measured_default, register_filter
+from dvf_tpu.ops.registry import measured_default_for, register_filter
 from dvf_tpu.utils.image import rgb_to_gray
 
 
@@ -418,7 +418,7 @@ def flow_warp(
     raise ``max_disp`` (taps grow as (2·max_disp+2)²).
     """
     if warp_impl is None:
-        warp_impl = measured_default({"tpu": "pallas"}, fallback="gather")
+        warp_impl = measured_default_for("flow_warp")
     if warp_impl not in ("gather", "pallas"):
         raise ValueError(f"warp_impl must be 'gather' or 'pallas', got {warp_impl!r}")
     if win_type not in ("gaussian", "box"):

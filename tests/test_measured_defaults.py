@@ -1,0 +1,102 @@
+"""Machine-check the measured per-backend defaults against committed A/Bs.
+
+VERDICT r4 item 2: round 4's gauss9 default cited a 1.7x Pallas win in
+prose while the committed A/B row said shift won 5.5x -- nothing detected
+the divergence because the winners-maps were hand-transcribed. This test
+makes the provenance an assertion: every ``MEASURED_DEFAULTS`` entry in
+:mod:`dvf_tpu.ops.registry` must agree with the ``impl_comparisons``
+winner committed in benchmarks/BENCH_TABLE.json (TPU) and
+benchmarks/cpu/BENCH_TABLE.json (CPU). A default that contradicts a
+committed A/B -- or pins a backend with no committed A/B -- fails CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from dvf_tpu.ops.registry import MEASURED_DEFAULTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TABLES = {
+    "tpu": os.path.join(REPO, "benchmarks", "BENCH_TABLE.json"),
+    "cpu": os.path.join(REPO, "benchmarks", "cpu", "BENCH_TABLE.json"),
+}
+
+
+def _committed_winner(backend: str, comparison: str):
+    """The committed A/B winner label for ``comparison`` on ``backend``,
+    or None when that backend's table has no completed comparison."""
+    path = TABLES[backend]
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    comp = doc.get("impl_comparisons", {}).get(comparison)
+    if not isinstance(comp, dict):
+        return None
+    # The TPU table must not source a CPU-forced capture and vice versa;
+    # run_table stamps forced_cpu per comparison.
+    if bool(comp.get("forced_cpu", False)) != (backend == "cpu"):
+        return None
+    winner = comp.get("winner")
+    if winner in (None, "n/a"):
+        return None
+    # A comparison with an errored leg never commits a trustworthy winner
+    # (comparison_fresh would re-run it) -- don't enforce against it.
+    if any(isinstance(v, dict) and "error" in v for v in comp.values()):
+        return None
+    return winner
+
+
+@pytest.mark.parametrize("key", sorted(MEASURED_DEFAULTS))
+def test_declared_winners_match_committed_abs(key):
+    entry = MEASURED_DEFAULTS[key]
+    assert set(entry["winners"]) <= set(TABLES), (
+        f"{key}: winners-map pins backends {set(entry['winners']) - set(TABLES)} "
+        f"for which no bench table exists -- every pinned backend needs a "
+        f"committed A/B")
+    for backend in TABLES:
+        winner = _committed_winner(backend, entry["comparison"])
+        declared = entry["winners"].get(backend)
+        if winner is None:
+            assert declared is None, (
+                f"{key}: code pins {declared!r} for backend {backend!r} but "
+                f"{TABLES[backend]} commits no completed "
+                f"{entry['comparison']} comparison -- a declared winner "
+                f"must come from a committed A/B, not prose")
+            continue
+        assert winner in entry["label_to_impl"], (
+            f"{key}: committed winner label {winner!r} is not in the "
+            f"entry's label_to_impl map {entry['label_to_impl']} -- the "
+            f"A/B harness and the code disagree about the impl universe")
+        expected = entry["label_to_impl"][winner]
+        assert declared == expected, (
+            f"{key}: backend {backend!r} default is {declared!r} but the "
+            f"committed {entry['comparison']} winner is {winner!r} "
+            f"(-> impl {expected!r}). Update MEASURED_DEFAULTS (and any "
+            f"docstring numbers) to match the committed A/B, or re-run "
+            f"the A/B and commit the new winner.")
+
+
+def test_every_winner_map_is_declared():
+    """No factory may call measured_default() with an inline winners-map:
+    inline maps are exactly the hand-transcribed prose this test exists
+    to eliminate. (Grep-based so a new call site can't dodge the check.)"""
+    import re
+
+    ops_dir = os.path.join(REPO, "dvf_tpu", "ops")
+    offenders = []
+    for fname in os.listdir(ops_dir):
+        if not fname.endswith(".py") or fname == "registry.py":
+            continue
+        with open(os.path.join(ops_dir, fname)) as f:
+            src = f.read()
+        if re.search(r"measured_default\(", src):
+            offenders.append(fname)
+    assert not offenders, (
+        f"{offenders} call measured_default() with an inline winners-map; "
+        f"use measured_default_for() + a MEASURED_DEFAULTS entry so the "
+        f"winner is machine-checked against the committed A/B")

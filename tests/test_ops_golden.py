@@ -271,7 +271,7 @@ def test_measured_per_backend_defaults():
     # CPU winners (this suite forces the cpu backend in conftest).
     assert "pallas" in get_filter("sobel_bilateral").name
     assert "pallas" in get_filter("gaussian_blur").name          # k=9
-    # Unmeasured small kernel keeps the shifted-FMA lowering.
+    # Small kernel: shift wins the committed gauss3 A/B on both backends.
     assert "pallas" not in get_filter("gaussian_blur", ksize=3).name
     # Explicit impl pins — the A/B harness depends on this.
     assert "pallas" not in get_filter("sobel_bilateral", impl="chain").name
