@@ -1,6 +1,8 @@
 """Headline benchmark: 1080p color-invert through the framework, on the TPU.
 
-Prints ONE JSON line:
+Prints JSON result lines to stdout; **the LAST complete JSON line is the
+result** (a long-wait run prints a provisional CPU-fallback line first —
+see the reliability design below — and the fast path prints exactly one):
 
     {"metric": "1080p_invert", "value": <device fps>, "unit": "fps",
      "vs_baseline": value/2000, "p50_latency_ms": ..., "p99_latency_ms": ...,
@@ -20,33 +22,49 @@ e2e fps at a few fps regardless of the framework (a real v5e PCIe link is
 ~3 orders of magnitude faster); ``roofline_frac`` says how close the
 pipeline gets to that ceiling, which is the framework-attributable part.
 
-Reliability design (rounds 1-3 post-mortems: backend init hung or was
-SIGKILLed in rounds 1-2; round 3's driver run burned its whole budget on
-one child against a dead tunnel and fell back to CPU even though healthy
-windows existed during the round):
+Reliability design (post-mortems of all four prior rounds: backend init
+hung or was SIGKILLed in rounds 1-2; rounds 3-4 burned a few minutes of
+probes against a tunnel whose healthy windows recur on an HOURS cadence
+— benchmarks/tpu_watch.log — and fell back to CPU even though on-chip
+numbers were captured hours earlier in the same round):
 
 - This parent process NEVER imports jax. ALL device work — init included —
   runs in bounded children (``dvf_tpu/bench_child.py``).
-- **Probe first** (VERDICT r3 item 3): a cheap ``--mode probe`` child
-  (bounded ~75 s; healthy init is <5 s) gates the expensive bench child.
-  On a dead tunnel the probe is retried a few times across the budget —
-  the tunnel's health flips on minutes-scale — and only then does the
-  bench fall back, fast, instead of hanging 420 s.
+- **Probe first**: a cheap ``--mode probe`` child (bounded ~75 s; healthy
+  init is <5 s) gates the expensive bench child.
+- **The probe schedule matches the observed failure mode** (VERDICT r4
+  item 1): one probe up front, then — if the tunnel is down — the CPU
+  fallback measurement runs IMMEDIATELY and its JSON line is printed as a
+  provisional result, after which the bench keeps probing on a ~5-minute
+  cadence across ``--wall-budget`` (default 2 h, env
+  ``DVF_BENCH_WALL_S``). The moment a window opens, the real TPU bench
+  runs and its JSON line is printed after the provisional one.
+- **Output protocol: the LAST complete JSON line on stdout is the
+  result.** A kill (SIGTERM/SIGKILL/driver timeout) at ANY point after
+  the first ~6 minutes leaves a valid artifact: the provisional CPU line
+  if no window opened, the TPU line if one did. (The single-line contract
+  is kept on the fast path and under ``--wall-budget 0``, which restores
+  the one-shot behavior the watcher uses — the watcher is already a loop.)
+- With budget left after a successful capture, the remaining window is
+  spent on ``benchmarks/run_table.py`` (bounded, incremental) so the
+  round-end window also lands table rows; the TPU JSON line is re-printed
+  afterwards so it stays last.
 - ``JAX_COMPILATION_CACHE_DIR`` is set so any rerun (or fallback after a
   partial run) skips compiles.
 - A successful real-TPU run is **persisted** to
-  ``benchmarks/TPU_BENCH_R4.json`` (timestamped) so the best on-chip
-  capture of the round survives even if the round-end driver run lands in
-  a dead window; the CPU fallback JSON embeds the freshest on-file TPU
-  result so a fallback line is never mistaken for "no TPU number exists".
+  ``benchmarks/TPU_BENCH_R5.json`` with timestamp + git rev; the CPU
+  fallback JSON embeds the freshest on-file TPU capture AND the matching
+  ``tpu_watch.log`` line, so a skeptical reader can cross-check the
+  fallback's cited number against the watcher's record in one step.
 - If the TPU child fails or times out, the bench degrades LOUDLY: it
   reruns on CPU with a scaled-down workload and emits the JSON line with
   ``"fallback": true`` and the real TPU error in ``"error"``.
-- Whatever happens, exactly one JSON line goes to stdout. Exit code is 0
-  whenever a measurement (even the CPU fallback) was obtained.
+- Exit code is 0 whenever a measurement (even the CPU fallback) was
+  obtained.
 
 Usage: python bench.py [--iters K] [--batch B] [--frames N] [--cpu]
                        [--bench-timeout S] [--e2e] [--probe-retries N]
+                       [--wall-budget S] [--probe-interval S]
 """
 
 from __future__ import annotations
@@ -131,96 +149,72 @@ def freshest_tpu_result_on_file(bench_dir):
     return (best[0], best[1]) if best else (None, None)
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--iters", type=int, default=300, help="device-resident chain length")
-    ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--height", type=int, default=1080)
-    ap.add_argument("--width", type=int, default=1920)
-    ap.add_argument("--frames", type=int, default=512, help="e2e streaming frame cap")
-    ap.add_argument("--e2e-batch", type=int, default=16)
-    ap.add_argument("--lat-batch", type=int, default=4)
-    ap.add_argument("--e2e", action="store_true",
-                    help="(compat) e2e-only mode; default now reports both")
-    ap.add_argument("--cpu", action="store_true", help="run on CPU directly")
-    ap.add_argument("--bench-timeout", type=float, default=420.0)
-    ap.add_argument("--probe-timeout", type=float, default=75.0)
-    ap.add_argument("--probe-retries", type=int, default=3)
-    ap.add_argument("--probe-retry-wait", type=float, default=30.0)
-    args = ap.parse_args(argv)
+def matching_watch_log_line(bench_dir, captured_utc):
+    """The tpu_watch.log bench.py record nearest ``captured_utc`` (±30 min).
 
-    mode = "e2e" if args.e2e else "headline"
-    error = None
-    fallback = False
+    This is the one-step cross-check VERDICT r4 item 1 asked for: a CPU
+    fallback that cites an on-file TPU capture also carries the watcher
+    line that recorded the same run, so the two provenance trails can be
+    compared without opening the log."""
+    import datetime
 
-    env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
+    path = os.path.join(bench_dir, "tpu_watch.log")
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    try:
+        target = datetime.datetime.fromisoformat(captured_utc)
+    except (TypeError, ValueError):
+        return None
+    if target.tzinfo is None:
+        target = target.replace(tzinfo=datetime.timezone.utc)
+    best = None
+    for ln in lines:
+        # Only success records corroborate a capture — the nearest line
+        # being a failed run (rc=-9 after a window closed mid-bench) would
+        # attach a failure record to a success claim.
+        if (not ln.startswith("[") or "]" not in ln
+                or "bench.py" not in ln or "backend=tpu" not in ln):
+            continue
+        stamp = ln[1:ln.index("]")].rstrip("Z")
+        try:
+            t = datetime.datetime.fromisoformat(stamp)
+        except ValueError:
+            continue
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        dt = abs((t - target).total_seconds())
+        if best is None or dt < best[0]:
+            best = (dt, ln)
+    return best[1] if best and best[0] <= 1800 else None
 
-    result = None
-    if not args.cpu:
-        healthy, probe_info = probe_tpu(env, args.probe_timeout,
-                                        args.probe_retries,
-                                        args.probe_retry_wait)
-        if not healthy:
-            error = f"TPU probe failed: {probe_info}"
-            _log(error + " — skipping straight to CPU fallback")
-        else:
-            child_args = [
-                "--mode", mode,
-                "--iters", str(args.iters), "--batch", str(args.batch),
-                "--height", str(args.height), "--width", str(args.width),
-                "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
-                "--lat-batch", str(args.lat_batch),
-            ]
-            _log(f"probe healthy → running bench (timeout "
-                 f"{args.bench_timeout:.0f}s)")
-            result, bench_err = run_bench_child(child_args, env,
-                                                args.bench_timeout)
-            if result is None:
-                error = f"TPU bench failed: {bench_err}"
-                _log(error)
-            elif result.get("backend") != "tpu":
-                # jax initialized but landed on CPU (no TPU plugin / plugin
-                # failed to claim the chip). The numbers are real but must
-                # be labeled as the fallback they are.
-                error = (f"backend came up as {result.get('backend')!r}, "
-                         f"not tpu")
-                fallback = True
-                _log(error)
-    else:
-        error = "cpu requested via --cpu"
 
-    if result is None:
-        # Loud CPU fallback: scaled-down workload, clearly labeled. The
-        # point is a verifiable smoke number + the real failure reason,
-        # instead of a hang (round-1 failure mode).
-        fallback = True
-        env["JAX_PLATFORMS"] = "cpu"
-        child_args = [
-            "--mode", mode, "--platform", "cpu",
-            "--iters", "20", "--batch", "8",
-            "--height", str(args.height), "--width", str(args.width),
-            "--frames", "64", "--e2e-batch", "8", "--lat-batch", "4",
-            "--e2e-budget-s", "30",
-        ]
-        _log("falling back to CPU (timeout 240s)")
-        result, cpu_err = run_bench_child(child_args, env, 240.0)
-        if result is None:
-            # Total failure: still exactly one JSON line, with diagnostics.
-            out = {
-                "metric": ("1080p_invert_device_fps" if mode == "headline"
-                           else "1080p_invert_e2e_fps"),
-                "value": None,
-                "unit": "fps",
-                "vs_baseline": None,
-                "error": f"TPU: {error}; CPU fallback: {cpu_err}",
-            }
-            print(json.dumps(out), flush=True)
-            return 1
+def git_rev():
+    import subprocess
 
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+# min-fresh stamp for the table work a round-end healthy window may run:
+# rows captured by this round's watcher windows are kept, anything older
+# (or pre-v3 e2e legs, which the freshness gate stales regardless) re-runs.
+ROUND5_MIN_FRESH = "2026-07-31T15:45"
+
+
+def build_out(result, mode, fallback, error):
     headline = result.get("device_fps", result.get("e2e_fps"))
-    out = {
-        "metric": "1080p_invert_device_fps" if mode == "headline" else "1080p_invert_e2e_fps",
+    return {
+        "metric": ("1080p_invert_device_fps" if mode == "headline"
+                   else "1080p_invert_e2e_fps"),
         "value": headline,
         "unit": "fps",
         "vs_baseline": round(headline / 2000.0, 3) if headline else None,
@@ -253,95 +247,278 @@ def main(argv=None) -> int:
         "fallback": fallback,
         "error": error,
     }
+
+
+def persist_capture(out, result, args, ap, bench_dir):
+    """Persist a real-chip headline capture (keep-best, atomic)."""
+    import datetime
+
+    capture = {
+        "captured_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "code_rev": git_rev(),
+        "result": out,
+        "device_frames": result.get("device_frames", 0),
+        "workload": {"height": args.height, "width": args.width,
+                     "batch": args.batch, "iters": args.iters},
+        "argv": sys.argv[1:],
+    }
+    path = os.path.join(bench_dir, "TPU_BENCH_R5.json")
+    # The headline workload IS the parser's defaults — derive, don't
+    # duplicate, so a default change can't silently stop persistence.
+    headline_workload = (ap.get_default("height"), ap.get_default("width"),
+                         ap.get_default("batch"), ap.get_default("iters"))
+    if (args.height, args.width, args.batch, args.iters) != headline_workload:
+        # The persisted metric is by name 1080p_invert_device_fps at
+        # one fixed workload; any other geometry/batch/iters can
+        # match or beat device_frames (= iters × batch) while being
+        # incomparable on fps — the frames-first keep-best would then
+        # let a longer-but-slower run clobber the round's best sample,
+        # or a persisted odd workload would squat the file against
+        # every honest default rerun.
+        _log(f"not persisting: workload {args.height}x{args.width} "
+             f"batch={args.batch} iters={args.iters} is not the "
+             f"headline {headline_workload}")
+        return
+    existing_frames = -1
+    existing_value = -1.0
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            existing_frames = prev.get("device_frames", 0)
+            existing_value = (prev.get("result") or {}).get("value") or -1.0
+        except Exception:
+            existing_frames = -1  # corrupt → replace
+    if capture["device_frames"] < existing_frames or (
+            capture["device_frames"] == existing_frames
+            and (out.get("value") or 0) < existing_value):
+        # A quick smoke run (--iters 3) must not clobber the round's
+        # full-workload capture, and an equal-workload rerun keeps the
+        # BEST sample (the watcher re-benches every window; its tie
+        # overwrites were replacing a 46k capture with a 44.6k one).
+        _log(f"not persisting: existing capture ({existing_frames} "
+             f"frames, {existing_value} fps) beats this run's "
+             f"({capture['device_frames']}, {out.get('value')})")
+        return
+    try:
+        os.makedirs(bench_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        # Atomic replace: a SIGKILL mid-write (this environment's
+        # documented failure mode) must not corrupt the previous
+        # good capture.
+        with open(tmp, "w") as f:
+            json.dump(capture, f, indent=2)
+        os.replace(tmp, path)
+        _log(f"TPU capture persisted to {path}")
+    except OSError as e:
+        _log(f"could not persist TPU capture: {e!r}")
+
+
+def embed_tpu_provenance(out, bench_dir):
+    """On a fallback line, cite the freshest on-file TPU capture with its
+    git rev AND the watcher log line that recorded the same run — the
+    one-step cross-check a skeptical reader needs (VERDICT r4 item 1)."""
+    path, doc = freshest_tpu_result_on_file(bench_dir)
+    if doc is None:
+        return
+    out["tpu_result_on_file"] = {
+        "path": os.path.relpath(path, os.path.dirname(bench_dir)),
+        "metric": doc.get("result", {}).get("metric"),
+        "value": doc.get("result", {}).get("value"),
+        "captured_utc": doc.get("captured_utc"),
+        "code_rev": doc.get("code_rev"),
+        "watch_log_line": matching_watch_log_line(
+            bench_dir, doc.get("captured_utc")),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=300, help="device-resident chain length")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--height", type=int, default=1080)
+    ap.add_argument("--width", type=int, default=1920)
+    ap.add_argument("--frames", type=int, default=512, help="e2e streaming frame cap")
+    ap.add_argument("--e2e-batch", type=int, default=16)
+    ap.add_argument("--lat-batch", type=int, default=4)
+    ap.add_argument("--e2e", action="store_true",
+                    help="(compat) e2e-only mode; default now reports both")
+    ap.add_argument("--cpu", action="store_true", help="run on CPU directly")
+    ap.add_argument("--bench-timeout", type=float, default=420.0)
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--probe-retries", type=int, default=1)
+    ap.add_argument("--probe-retry-wait", type=float, default=30.0)
+    ap.add_argument("--wall-budget", type=float,
+                    default=float(os.environ.get("DVF_BENCH_WALL_S", "7200")),
+                    help="total seconds to keep probing for a healthy "
+                         "window after the provisional CPU fallback is "
+                         "printed; 0 restores one-shot behavior (the "
+                         "watcher's mode — it is already a loop)")
+    ap.add_argument("--probe-interval", type=float, default=240.0,
+                    help="sleep between long-wait probes (a down probe "
+                         "itself burns ~probe-timeout, so the cycle is "
+                         "~5 min — the watcher's observed-window cadence)")
+    args = ap.parse_args(argv)
+
+    mode = "e2e" if args.e2e else "headline"
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
     # DVF_BENCH_DIR: test override so the persist-gate logic can be
     # exercised against a scratch dir instead of the real capture file.
     bench_dir = os.environ.get("DVF_BENCH_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks")
-    # mode check: an --e2e run's metric (1080p_invert_e2e_fps) is
-    # incomparable with the persisted device-fps headline and must never
-    # seed/overwrite TPU_BENCH_R4.json.
-    if (not fallback and out.get("backend") == "tpu" and headline
-            and mode == "headline"):
-        # Persist the real-chip capture: the round's best on-chip evidence
-        # must survive the round-end run landing in a dead tunnel window.
-        import datetime
+    deadline = _T0 + args.wall_budget
 
-        capture = {
-            "captured_utc": datetime.datetime.now(
-                datetime.timezone.utc).isoformat(),
-            "result": out,
-            "device_frames": result.get("device_frames", 0),
-            "workload": {"height": args.height, "width": args.width,
-                         "batch": args.batch, "iters": args.iters},
-            "argv": sys.argv[1:],
-        }
-        path = os.path.join(bench_dir, "TPU_BENCH_R4.json")
-        # The headline workload IS the parser's defaults — derive, don't
-        # duplicate, so a default change can't silently stop persistence.
-        headline_workload = (ap.get_default("height"), ap.get_default("width"),
-                             ap.get_default("batch"), ap.get_default("iters"))
-        if (args.height, args.width, args.batch, args.iters) != headline_workload:
-            # The persisted metric is by name 1080p_invert_device_fps at
-            # one fixed workload; any other geometry/batch/iters can
-            # match or beat device_frames (= iters × batch) while being
-            # incomparable on fps — the frames-first keep-best would then
-            # let a longer-but-slower run clobber the round's best sample,
-            # or a persisted odd workload would squat the file against
-            # every honest default rerun.
-            _log(f"not persisting: workload {args.height}x{args.width} "
-                 f"batch={args.batch} iters={args.iters} is not the "
-                 f"headline {headline_workload}")
-            print(json.dumps(out), flush=True)
-            return 0
-        existing_frames = -1
-        existing_value = -1.0
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    prev = json.load(f)
-                existing_frames = prev.get("device_frames", 0)
-                existing_value = (prev.get("result") or {}).get("value") or -1.0
-            except Exception:
-                existing_frames = -1  # corrupt → replace
-        if capture["device_frames"] < existing_frames or (
-                capture["device_frames"] == existing_frames
-                and (out.get("value") or 0) < existing_value):
-            # A quick smoke run (--iters 3) must not clobber the round's
-            # full-workload capture, and an equal-workload rerun keeps the
-            # BEST sample (the watcher re-benches every window; its tie
-            # overwrites were replacing a 46k capture with a 44.6k one).
-            _log(f"not persisting: existing capture ({existing_frames} "
-                 f"frames, {existing_value} fps) beats this run's "
-                 f"({capture['device_frames']}, {out.get('value')})")
+    def tpu_child_args():
+        return [
+            "--mode", mode,
+            "--iters", str(args.iters), "--batch", str(args.batch),
+            "--height", str(args.height), "--width", str(args.width),
+            "--frames", str(args.frames), "--e2e-batch", str(args.e2e_batch),
+            "--lat-batch", str(args.lat_batch),
+        ]
+
+    def run_tpu():
+        """(out, error): a full TPU bench attempt → final JSON dict."""
+        _log(f"running TPU bench (timeout {args.bench_timeout:.0f}s)")
+        result, bench_err = run_bench_child(tpu_child_args(), env,
+                                            args.bench_timeout)
+        if result is None:
+            return None, f"TPU bench failed: {bench_err}"
+        if result.get("backend") != "tpu":
+            # jax initialized but landed on CPU (no TPU plugin / plugin
+            # failed to claim the chip). The numbers are real but must
+            # be labeled as the fallback they are.
+            return None, (f"backend came up as {result.get('backend')!r}, "
+                          f"not tpu")
+        out = build_out(result, mode, fallback=False, error=None)
+        if mode == "headline" and out.get("value"):
+            # mode check: an --e2e run's metric (1080p_invert_e2e_fps) is
+            # incomparable with the persisted device-fps headline and must
+            # never seed/overwrite TPU_BENCH_R5.json.
+            persist_capture(out, result, args, ap, bench_dir)
+        return out, None
+
+    error = None
+    if args.cpu:
+        error = "cpu requested via --cpu"
+    else:
+        healthy, probe_info = probe_tpu(env, args.probe_timeout,
+                                        args.probe_retries,
+                                        args.probe_retry_wait)
+        if healthy:
+            out, error = run_tpu()
+            if out is not None:
+                print(json.dumps(out), flush=True)
+                return 0
+            _log(error)
         else:
-            try:
-                os.makedirs(bench_dir, exist_ok=True)
-                tmp = path + ".tmp"
-                # Atomic replace: a SIGKILL mid-write (this environment's
-                # documented failure mode) must not corrupt the previous
-                # good capture.
-                with open(tmp, "w") as f:
-                    json.dump(capture, f, indent=2)
-                os.replace(tmp, path)
-                _log(f"TPU capture persisted to {path}")
-            except OSError as e:
-                _log(f"could not persist TPU capture: {e!r}")
-    if fallback:
-        # A real-chip measurement may exist from an earlier healthy tunnel
-        # window; embed the freshest one's identity (metric/value/when) so
-        # a CPU-fallback round-end run is never mistaken for "no TPU
-        # number exists" — and so a STALE on-file number is visibly
-        # stamped, not silently cited.
-        path, doc = freshest_tpu_result_on_file(bench_dir)
-        if doc is not None:
-            out["tpu_result_on_file"] = {
-                "path": os.path.relpath(path, os.path.dirname(bench_dir)),
-                "metric": doc.get("result", {}).get("metric"),
-                "value": doc.get("result", {}).get("value"),
-                "captured_utc": doc.get("captured_utc"),
-            }
-    print(json.dumps(out), flush=True)
-    return 0
+            error = f"TPU probe failed: {probe_info}"
+            _log(error + " — running CPU fallback, then watching for a "
+                         "healthy window")
+
+    # Loud CPU fallback: scaled-down workload, clearly labeled. The
+    # point is a verifiable smoke number + the real failure reason,
+    # instead of a hang (round-1 failure mode). In long-wait mode this
+    # line is PROVISIONAL: it goes out immediately so a kill at any later
+    # point leaves a valid artifact, and a healthy window prints the real
+    # TPU line after it (the last JSON line wins).
+    env_cpu = dict(env)
+    env_cpu["JAX_PLATFORMS"] = "cpu"
+    cpu_args = [
+        "--mode", mode, "--platform", "cpu",
+        "--iters", "20", "--batch", "8",
+        "--height", str(args.height), "--width", str(args.width),
+        "--frames", "64", "--e2e-batch", "8", "--lat-batch", "4",
+        "--e2e-budget-s", "30",
+    ]
+    _log("falling back to CPU (timeout 240s)")
+    result, cpu_err = run_bench_child(cpu_args, env_cpu, 240.0)
+    long_wait = args.wall_budget > 0 and not args.cpu
+    if result is not None:
+        prov = build_out(result, mode, fallback=True, error=error)
+        embed_tpu_provenance(prov, bench_dir)
+        if long_wait:
+            prov["provisional"] = True
+        print(json.dumps(prov), flush=True)
+        rc_on_giveup = 0
+    else:
+        prov = {
+            "metric": ("1080p_invert_device_fps" if mode == "headline"
+                       else "1080p_invert_e2e_fps"),
+            "value": None,
+            "unit": "fps",
+            "vs_baseline": None,
+            "fallback": True,
+            "error": f"TPU: {error}; CPU fallback: {cpu_err}",
+        }
+        embed_tpu_provenance(prov, bench_dir)
+        print(json.dumps(prov), flush=True)
+        rc_on_giveup = 1
+    if not long_wait:
+        return rc_on_giveup
+
+    # Long-wait phase (VERDICT r4 item 1): the watch log shows healthy
+    # windows recur on an hours cadence — 3 probes in 4 minutes was the
+    # wrong shape. Probe, sleep, repeat across the wall budget; the
+    # provisional line above already guarantees an artifact if the driver
+    # kills us mid-wait.
+    import signal
+
+    # Mutable so a TPU success during the run_table spend flips the
+    # SIGTERM exit to 0 — 'exit 0 whenever a measurement was obtained'.
+    exit_rc = [rc_on_giveup]
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(exit_rc[0]))
+    probes = 0
+    while True:
+        remaining = deadline - time.perf_counter()
+        if remaining < args.probe_timeout + 30.0:
+            break
+        time.sleep(min(args.probe_interval, max(0.0, remaining
+                                                - args.probe_timeout - 30.0)))
+        probes += 1
+        _log(f"long-wait probe #{probes} "
+             f"({(deadline - time.perf_counter()) / 60.0:.0f} min left)")
+        probe = probe_backend(env, args.probe_timeout)
+        if probe is None or probe.get("backend") != "tpu":
+            continue
+        _log(f"window opened: {probe}")
+        out, tpu_err = run_tpu()
+        if out is None:
+            _log(f"{tpu_err} — window may have closed; continuing to probe")
+            continue
+        print(json.dumps(out), flush=True)
+        exit_rc[0] = 0
+        # Spend what's left of window+budget on the benchmark table (the
+        # round's owed v3 e2e rows / A/Bs), then re-print so the TPU line
+        # stays last. run_table is incremental + probe-gated: a closing
+        # window costs one bounded timeout.
+        table_budget = deadline - time.perf_counter() - 60.0
+        if table_budget > 300.0:
+            _log(f"running run_table with {table_budget:.0f}s budget")
+            rc, t_out, _ = _run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "run_table.py"),
+                 "--min-fresh", ROUND5_MIN_FRESH],
+                env, table_budget)
+            _log(f"run_table rc={rc} last: {last_json_line(t_out)}")
+        print(json.dumps(out), flush=True)
+        return 0
+    _log(f"wall budget exhausted after {probes} long-wait probes — the "
+         f"provisional fallback line stands")
+    # Re-print the fallback as the definitive line (no longer provisional;
+    # the error now records the full probe history).
+    prov.pop("provisional", None)
+    # Append to (not overwrite) the provisional error: in the
+    # CPU-fallback-also-failed case it carries the CPU crash reason, which
+    # must survive into the definitive last line.
+    prov["error"] = (f"{prov.get('error') or error}; no healthy window in "
+                     f"{args.wall_budget / 60.0:.0f} min "
+                     f"({probes} long-wait probes)")
+    print(json.dumps(prov), flush=True)
+    return rc_on_giveup
 
 
 if __name__ == "__main__":

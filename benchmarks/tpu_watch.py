@@ -7,7 +7,7 @@ rolling one die; this daemon rolls it continuously:
 
     probe (bounded, ~75 s)  — dead → sleep and re-probe
                             — healthy → immediately:
-        1. python bench.py            (headline; persists TPU_BENCH_R4.json)
+        1. python bench.py            (headline; persists TPU_BENCH_R5.json)
         2. python benchmarks/run_table.py --min-fresh <start>
                                       (incremental; fills only missing rows)
 
@@ -81,8 +81,13 @@ def main(argv=None) -> int:
         # child 420 s + CPU fallback 240 s ≈ 735 s) so a window closing
         # mid-run still yields bench.py's diagnostic JSON line instead of
         # a SIGKILL.
+        # --wall-budget 0: the long-wait loop is bench.py's own defense for
+        # the one-shot driver run; THIS process is already the loop, and a
+        # nested 2-h wait would blow the 900-s cap below on every window
+        # that closes mid-run.
         rc, out, err = run_cmd(
-            [sys.executable, "bench.py", "--probe-retries", "1"],
+            [sys.executable, "bench.py", "--probe-retries", "1",
+             "--wall-budget", "0"],
             env, 900.0, cwd=REPO)
         line = last_json_line(out) or {}
         log(f"bench.py rc={rc} backend={line.get('backend')} "
