@@ -77,6 +77,7 @@ import time
 
 from benchtools import (
     JAX_CACHE_DIR,
+    git_rev,
     last_json_line,
     probe_backend,
     run_cmd as _run,
@@ -189,19 +190,6 @@ def matching_watch_log_line(bench_dir, captured_utc):
         if best is None or dt < best[0]:
             best = (dt, ln)
     return best[1] if best and best[0] <= 1800 else None
-
-
-def git_rev():
-    import subprocess
-
-    try:
-        return subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            stdout=subprocess.PIPE, text=True, timeout=10,
-        ).stdout.strip() or "unknown"
-    except Exception:
-        return "unknown"
 
 
 # min-fresh stamp for the table work a round-end healthy window may run:
@@ -380,25 +368,28 @@ def main(argv=None) -> int:
         ]
 
     def run_tpu():
-        """(out, error): a full TPU bench attempt → final JSON dict."""
+        """(out, error, raw): a full TPU bench attempt. ``out`` is the
+        final JSON dict on success; on a non-tpu backend ``raw`` carries
+        the completed result so the caller can reuse it as the labeled
+        fallback instead of rerunning a scaled-down CPU child."""
         _log(f"running TPU bench (timeout {args.bench_timeout:.0f}s)")
         result, bench_err = run_bench_child(tpu_child_args(), env,
                                             args.bench_timeout)
         if result is None:
-            return None, f"TPU bench failed: {bench_err}"
+            return None, f"TPU bench failed: {bench_err}", None
         if result.get("backend") != "tpu":
             # jax initialized but landed on CPU (no TPU plugin / plugin
             # failed to claim the chip). The numbers are real but must
             # be labeled as the fallback they are.
             return None, (f"backend came up as {result.get('backend')!r}, "
-                          f"not tpu")
+                          f"not tpu"), result
         out = build_out(result, mode, fallback=False, error=None)
         if mode == "headline" and out.get("value"):
             # mode check: an --e2e run's metric (1080p_invert_e2e_fps) is
             # incomparable with the persisted device-fps headline and must
             # never seed/overwrite TPU_BENCH_R5.json.
             persist_capture(out, result, args, ap, bench_dir)
-        return out, None
+        return out, None, result
 
     error = None
     if args.cpu:
@@ -408,11 +399,20 @@ def main(argv=None) -> int:
                                         args.probe_retries,
                                         args.probe_retry_wait)
         if healthy:
-            out, error = run_tpu()
+            out, error, nontpu_raw = run_tpu()
             if out is not None:
                 print(json.dumps(out), flush=True)
                 return 0
             _log(error)
+            if nontpu_raw is not None:
+                # Full-workload run completed on the wrong backend: use it
+                # as the labeled fallback (no point rerunning scaled-down
+                # CPU work), and skip the long wait — a missing TPU plugin
+                # won't heal on the timescale the wait covers.
+                out = build_out(nontpu_raw, mode, fallback=True, error=error)
+                embed_tpu_provenance(out, bench_dir)
+                print(json.dumps(out), flush=True)
+                return 0
         else:
             error = f"TPU probe failed: {probe_info}"
             _log(error + " — running CPU fallback, then watching for a "
@@ -484,8 +484,11 @@ def main(argv=None) -> int:
         if probe is None or probe.get("backend") != "tpu":
             continue
         _log(f"window opened: {probe}")
-        out, tpu_err = run_tpu()
+        out, tpu_err, _raw = run_tpu()
         if out is None:
+            # Non-tpu raw results are NOT reused here: the provisional
+            # line already stands, and a mid-window backend collapse is
+            # exactly what the next probe re-checks.
             _log(f"{tpu_err} — window may have closed; continuing to probe")
             continue
         print(json.dumps(out), flush=True)

@@ -122,6 +122,17 @@ def main(argv=None) -> int:
              "--min-fresh", args.min_fresh], env, 3600.0, cwd=REPO)
         log(f"run_table rc={rc} last: {last_json_line(out)}")
 
+        # Per-layer neural timing (VERDICT r5: attribute style_720p's gap
+        # between measured ms/frame and its roofline sum to layers, and
+        # measure the exact fast-conv rewrites block by block). ~24 small
+        # jits: the first window pays tunnel compiles (persistent cache
+        # makes later windows cheap), so it runs AFTER run_table banked
+        # the table evidence. rc=3 = backend fell back to CPU mid-window.
+        n_rc, n_out, n_err = run_cmd(
+            [sys.executable, "benchmarks/neural_layers.py"],
+            env, 1500.0, cwd=REPO)
+        log(f"neural_layers rc={n_rc} last: {last_json_line(n_out)}")
+
         # Opportunistic: train the ≥256 px style checkpoint on-chip while
         # the window is open (VERDICT r3 item 5 — the committed demo is a
         # 64 px toy). Steps are device-cheap; checkpoint-every bounds the

@@ -51,6 +51,20 @@ def tail(s: str, n: int = 12) -> str:
 JAX_CACHE_DIR = os.environ.get("DVF_JAX_CACHE_DIR", "/tmp/dvf_jaxcache")
 
 
+def git_rev(repo_dir: Optional[str] = None) -> str:
+    """Short HEAD rev for measurement provenance (one shared copy — the
+    persisted code_rev fields across bench.py / run_table / neural_layers
+    must agree on their format)."""
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
 def probe_backend(env, timeout: float, cwd=None) -> Optional[dict]:
     """Run one bounded ``bench_child --mode probe``; the parsed JSON line
     ({"backend": ..., "n_devices": ..., "probe_sum": ...}) or None.

@@ -29,7 +29,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from dvf_tpu.models.layers import Params, conv2d_nb, conv_init, depth_to_space
+from dvf_tpu.models.layers import (
+    Params,
+    conv2d_nb,
+    conv2d_s2d,
+    conv_init,
+    depth_to_space,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +44,11 @@ class EspcnConfig:
     c1: int = 64                     # feature widths from the paper
     c2: int = 32
     compute_dtype: Any = jnp.bfloat16
+    # Space-to-depth conv rewrite (models.layers.conv2d_s2d): every ESPCN
+    # conv is stride-1 with lane-starved Cout (64/32/12 of 128 lanes), so
+    # the phase decomposition raises MXU utilization 2-3x per layer
+    # (models.analysis). Exact; opt-in pending the sr_fast_540p A/B.
+    fast_convs: bool = False
 
 
 def init_espcn(rng: jax.Array, config: EspcnConfig = EspcnConfig()) -> Params:
@@ -57,7 +68,10 @@ def _forward(params: Params, batch: jnp.ndarray, config: EspcnConfig,
 
     def cv(name, x, reduce=None):
         p = params[name]
-        y = conv2d_nb(p, x, compute_dtype=cd)
+        if config.fast_convs:
+            y = conv2d_s2d(p, x, compute_dtype=cd)  # SAME zero-pad, exact
+        else:
+            y = conv2d_nb(p, x, compute_dtype=cd)
         if reduce is not None:
             y = reduce(y)
         return y + p["b"].astype(cd)

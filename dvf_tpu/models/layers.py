@@ -15,6 +15,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 _DN = ("NHWC", "HWIO", "NHWC")
@@ -103,3 +104,132 @@ def gram_matrix(feats: jnp.ndarray) -> jnp.ndarray:
     b, h, w, c = feats.shape
     f = feats.reshape(b, h * w, c).astype(jnp.float32)
     return jnp.einsum("bnc,bnd->bcd", f, f) / (h * w * c)
+
+
+# ---------------------------------------------------------------------------
+# Exact MXU-utilization conv rewrites (see models.analysis for the numbers)
+# ---------------------------------------------------------------------------
+#
+# The style net's structural MXU floor is dominated by full-resolution convs
+# with tiny channel counts: the 9x9 out conv (Cout=3) can use 3/128 of the
+# systolic array's lanes, the stem (Cout=32) 32/128, and the decoder convs
+# run on 4x-upsampled activations at quarter lane use. Two classic, EXACT
+# rearrangements fix the utilization without changing the model's math:
+#
+# - conv2d_s2d: space-to-depth phase decomposition. A stride-1 kxk conv on
+#   (H, W, Cin) equals a ceil((k+1)/2)-sized conv on the space-to-depth
+#   transform (H/2, W/2, 4*Cin) producing all four output phases (4*Cout
+#   channels), followed by depth_to_space. Same multiply-adds (a few
+#   structurally-zero taps added), 4x the lane-dimension channels.
+# - upsample2_conv: nearest-x2-upsample followed by a kxk conv collapses to
+#   a per-phase conv at LOW resolution whose taps are the sums of the
+#   original taps that landed on the same source pixel — the upsampled
+#   activation is never materialized.
+
+
+def space_to_depth(x: jnp.ndarray, factor: int = 2) -> jnp.ndarray:
+    """(B, H, W, C) → (B, H/f, W/f, f²·C); inverse of depth_to_space
+    (phase-major channel order: out[..., (a*f + b)*C + c] = x[h*f+a, w*f+b, c])."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // factor, factor, w // factor, factor, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // factor, w // factor, factor * factor * c)
+
+
+def _s2d_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """Rearrange a (k, k, Cin, Cout) stride-1 kernel into the equivalent
+    (k2, k2, 4·Cin, 4·Cout) kernel over space-to-depth phases (factor 2).
+
+    Built with one static fancy-index gather (indices are numpy, computed
+    from k alone), so tracing costs a single cheap op per step even when
+    the weights are runtime state."""
+    k = w.shape[0]
+    k2 = (k + 1) // 2
+    # Wpad's extra k-th row/col is the zero tap for out-of-range phases.
+    wpad = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    # idy[p, a, i] = dy = 2p + a - i when 0 <= dy < k, else k (zero row).
+    idy = np.full((k2, 2, 2), k, dtype=np.int32)
+    for p in range(k2):
+        for a in range(2):
+            for i in range(2):
+                dy = 2 * p + a - i
+                if 0 <= dy < k:
+                    idy[p, a, i] = dy
+    g = wpad[idy[:, :, :, None, None, None], idy[None, None, None, :, :, :]]
+    # g[p, a, i, q, b, j, ci, co] → (p, q, a, b, ci, i, j, co)
+    g = g.transpose(0, 3, 1, 4, 6, 2, 5, 7)
+    cin, cout = w.shape[2], w.shape[3]
+    return g.reshape(k2, k2, 4 * cin, 4 * cout)
+
+
+def conv2d_s2d(
+    p: Params,
+    x: jnp.ndarray,
+    compute_dtype=jnp.bfloat16,
+    reflect: bool = False,
+) -> jnp.ndarray:
+    """Stride-1 SAME conv (without bias) computed at half resolution via
+    space-to-depth — numerically identical tap arithmetic to
+    :func:`conv2d_nb`, ~4× the MXU lane utilization for small-Cout or
+    full-resolution layers. Requires even H, W (video geometries are)."""
+    k = p["w"].shape[0]
+    r = k // 2
+    b, h, w_, c = x.shape
+    if h % 2 or w_ % 2:
+        return conv2d_nb(p, x, compute_dtype=compute_dtype, reflect=reflect)
+    xp = jnp.pad(x, ((0, 0), (r, r), (r, r), (0, 0)),
+                 mode="reflect" if reflect else "constant")
+    x2 = space_to_depth(xp.astype(compute_dtype), 2)
+    k5 = _s2d_kernel(p["w"]).astype(compute_dtype)
+    y2 = lax.conv_general_dilated(
+        x2, k5, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=_DN,
+    )
+    return depth_to_space(y2, 2)
+
+
+def _upsample2_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """Phase-collapse a (k, k, Cin, Cout) kernel across a preceding
+    nearest-×2 upsample: taps of the full-res conv that read the same
+    low-res source pixel sum into one tap. Returns (kl, kl, Cin, 4·Cout)
+    for a VALID conv on the edge-padded low-res input."""
+    k = w.shape[0]
+    r = k // 2
+    # Low-res tap offset e = floor((i + dy - r) / 2) for dy in [0, k).
+    offs = sorted({(i + dy - r) // 2 for dy in range(k) for i in range(2)})
+    e0, kl = offs[0], offs[-1] - offs[0] + 1
+    cin, cout = w.shape[2], w.shape[3]
+    kl_w = jnp.zeros((kl, kl, 2, 2, cin, cout), dtype=w.dtype)
+    for i in range(2):
+        for j in range(2):
+            for dy in range(k):
+                for dx in range(k):
+                    e = (i + dy - r) // 2 - e0
+                    f = (j + dx - r) // 2 - e0
+                    kl_w = kl_w.at[e, f, i, j].add(w[dy, dx])
+    # (e, f, i, j, ci, co) → (e, f, ci, (i·2+j)·Cout + co)
+    kl_w = kl_w.transpose(0, 1, 4, 2, 3, 5).reshape(kl, kl, cin, 4 * cout)
+    return kl_w, -e0
+
+
+def upsample2_conv(
+    p: Params,
+    x: jnp.ndarray,
+    compute_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """nearest-×2 upsample + reflect-SAME conv (without bias), computed
+    entirely at LOW resolution — exact for k=3: edge padding of the
+    low-res input reproduces reflect-101 of the upsampled input when the
+    pad radius is 1 (for r≥2 the reflected full-res rows map to DIFFERENT
+    low-res pixels than edge replication, so larger kernels fall back to
+    the materialized-upsample path)."""
+    if p["w"].shape[0] != 3:
+        return conv2d_nb(p, upsample_nearest(x, 2),
+                         compute_dtype=compute_dtype, reflect=True)
+    klw, pad = _upsample2_kernel(p["w"])
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge")
+    y2 = lax.conv_general_dilated(
+        xp.astype(compute_dtype), klw.astype(compute_dtype),
+        window_strides=(1, 1), padding="VALID", dimension_numbers=_DN,
+    )
+    return depth_to_space(y2, 2)

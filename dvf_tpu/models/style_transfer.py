@@ -34,9 +34,11 @@ from jax.sharding import PartitionSpec as P
 from dvf_tpu.models.layers import (
     Params,
     conv2d_nb,
+    conv2d_s2d,
     conv_init,
     instance_norm,
     instance_norm_init,
+    upsample2_conv,
     upsample_nearest,
 )
 
@@ -46,6 +48,13 @@ class StyleNetConfig:
     base_channels: int = 32          # stem width; doubles at each downsample
     n_residual: int = 5
     compute_dtype: Any = jnp.bfloat16
+    # Exact MXU-utilization conv rewrites (models.layers.conv2d_s2d /
+    # upsample2_conv; numbers in models.analysis): the 9x9 stem/out convs
+    # run space-to-depth at half res with 4x the lane channels, and the
+    # decoder's upsample+conv pairs phase-collapse to low-res convs.
+    # Same arithmetic, parity-tested; opt-in pending the on-chip A/B
+    # (run_table comparison style_fast_720p).
+    fast_convs: bool = False
 
     @property
     def widths(self):
@@ -110,9 +119,25 @@ def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig,
     cd = config.compute_dtype
     modes = _conv_modes(config)
 
-    def cv(name, x, stride=1):
+    def cv(name, x, stride=1, upsampled=False):
         p = params[name]
-        y = conv2d_nb(p, x, stride=stride, compute_dtype=cd, reflect=True)
+        if upsampled:
+            # Decoder pair: nearest-x2 then conv. The fast path never
+            # materializes the upsampled activation (exact for k=3).
+            if config.fast_convs:
+                y = upsample2_conv(p, x, compute_dtype=cd)
+            else:
+                y = conv2d_nb(p, upsample_nearest(x, 2), compute_dtype=cd,
+                              reflect=True)
+        elif (config.fast_convs and stride == 1
+              and p["w"].shape[0] >= 5):
+            # Full-res large-kernel convs (stem 9x9, out 9x9): the lane-
+            # starved layers where the phase decomposition pays. The 3x3
+            # trunk convs already run full-lane (Cout=128) and would only
+            # inflate taps.
+            y = conv2d_s2d(p, x, compute_dtype=cd, reflect=True)
+        else:
+            y = conv2d_nb(p, x, stride=stride, compute_dtype=cd, reflect=True)
         if modes.get(name) == "row":
             y = row_reduce(y)
         return y + p["b"].astype(cd)
@@ -131,10 +156,8 @@ def _forward(params: Params, batch: jnp.ndarray, config: StyleNetConfig,
             h = norm_relu(f"res{i}_an", cv(f"res{i}_a", x))
             h = instance_norm(params[f"res{i}_bn"], cv(f"res{i}_b", h))
             x = x + h
-    x = upsample_nearest(x, 2)
-    x = norm_relu("up1_norm", cv("up1", x))
-    x = upsample_nearest(x, 2)
-    x = norm_relu("up2_norm", cv("up2", x))
+    x = norm_relu("up1_norm", cv("up1", x, upsampled=True))
+    x = norm_relu("up2_norm", cv("up2", x, upsampled=True))
     x = cv("out", x)
     y = 0.5 * (jnp.tanh(x.astype(jnp.float32)) + 1.0)
     return y.astype(batch.dtype)

@@ -129,6 +129,23 @@ MEASURED_DEFAULTS = {
         "fallback": "shift",
         "label_to_impl": {"shift": "shift", "pallas_fused": "pallas"},
     },
+    # Exact MXU-utilization conv rewrites for the neural configs
+    # (models.layers.conv2d_s2d / upsample2_conv; static case in
+    # models.analysis). No backend pinned yet: the A/Bs are queued but no
+    # winner is committed — the factories run the reference lowering
+    # until one is.
+    "style_fast": {
+        "comparison": "style_fast_720p",
+        "winners": {},
+        "fallback": "ref",
+        "label_to_impl": {"ref": "ref", "fast": "fast"},
+    },
+    "espcn_fast": {
+        "comparison": "sr_fast_540p",
+        "winners": {},
+        "fallback": "ref",
+        "label_to_impl": {"ref": "ref", "fast": "fast"},
+    },
 }
 
 

@@ -29,7 +29,7 @@ from dvf_tpu.models.espcn import (
     param_pspecs,
     tp_inner_apply,
 )
-from dvf_tpu.ops.registry import register_filter
+from dvf_tpu.ops.registry import measured_default_for, register_filter
 
 
 @register_filter("super_resolution")
@@ -37,12 +37,26 @@ def super_resolution(
     params: Optional[Any] = None,
     scale: int = 2,
     seed: int = 0,
+    fast_convs: Optional[bool] = None,
+    dtype: Optional[str] = None,
 ) -> Filter:
     """``params=None`` → seeded random init (benchmark weights); pass a
     trained param pytree for real upscaling. ``specialize`` swaps in the
     Megatron-TP shard_map body when the mesh has a model axis > 1 (same
-    scheme as ``style_transfer``; see models.espcn.param_pspecs)."""
-    config = EspcnConfig(scale=scale)
+    scheme as ``style_transfer``; see models.espcn.param_pspecs).
+
+    ``fast_convs=None`` resolves the space-to-depth conv rewrite from the
+    measured sr_fast_540p A/B winner (MEASURED_DEFAULTS; "ref" until one
+    is committed); ``dtype`` pins the compute dtype as in style_transfer."""
+    if fast_convs is None:
+        fast_convs = measured_default_for("espcn_fast") == "fast"
+    if dtype is None:
+        dtype = "bfloat16"
+    if dtype not in ("bfloat16", "float32"):
+        raise ValueError(
+            f"dtype must be 'bfloat16' or 'float32', got {dtype!r}")
+    config = EspcnConfig(scale=scale, compute_dtype=jnp.dtype(dtype),
+                         fast_convs=bool(fast_convs))
 
     def fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
         return apply_espcn(state, batch, config), state

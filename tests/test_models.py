@@ -277,3 +277,101 @@ def test_sr_through_pipeline_delivers_upscaled_frames():
                      PipelineConfig(batch_size=8, queue_size=32)).run()
     assert stats["delivered"] == 16
     assert shapes and all(s == (64, 96, 3) for s in shapes)
+
+
+def test_fast_conv_rewrites_match_reference_lowering():
+    """conv2d_s2d (space-to-depth phase decomposition) and upsample2_conv
+    (phase-collapsed subpixel decoder) are EXACT rearrangements of the
+    reference convs — parity in f32 at tap-noise tolerance, reflect and
+    zero-pad borders both (models.analysis has the MXU-utilization case)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dvf_tpu.models.layers import (
+        conv2d_nb, conv2d_s2d, upsample2_conv, upsample_nearest)
+
+    rng = np.random.RandomState(0)
+    for k, cin, cout, h, w in [(9, 3, 5, 12, 16), (9, 32, 3, 20, 24),
+                               (3, 4, 6, 10, 14), (5, 3, 8, 16, 12)]:
+        p = {"w": jnp.asarray(rng.randn(k, k, cin, cout).astype(np.float32))}
+        x = jnp.asarray(rng.rand(2, h, w, cin).astype(np.float32))
+        for reflect in (True, False):
+            a = conv2d_nb(p, x, compute_dtype=jnp.float32, reflect=reflect)
+            b = conv2d_s2d(p, x, compute_dtype=jnp.float32, reflect=reflect)
+            assert float(jnp.abs(a - b).max()) < 1e-4, (k, cin, cout, reflect)
+    # Odd geometry falls back to the reference path (still correct).
+    p = {"w": jnp.asarray(rng.randn(9, 9, 3, 4).astype(np.float32))}
+    x = jnp.asarray(rng.rand(1, 13, 17, 3).astype(np.float32))
+    a = conv2d_nb(p, x, compute_dtype=jnp.float32, reflect=True)
+    b = conv2d_s2d(p, x, compute_dtype=jnp.float32, reflect=True)
+    assert float(jnp.abs(a - b).max()) == 0.0
+
+    for k, cin, cout, h, w in [(3, 5, 7, 9, 11), (3, 3, 3, 8, 8)]:
+        p = {"w": jnp.asarray(rng.randn(k, k, cin, cout).astype(np.float32))}
+        x = jnp.asarray(rng.rand(2, h, w, cin).astype(np.float32))
+        a = conv2d_nb(p, upsample_nearest(x, 2), compute_dtype=jnp.float32,
+                      reflect=True)
+        b = upsample2_conv(p, x, compute_dtype=jnp.float32)
+        assert float(jnp.abs(a - b).max()) < 1e-4, (k, cin, cout)
+    # k=5 has no exact low-res border mapping: must fall back, still exact.
+    p = {"w": jnp.asarray(rng.randn(5, 5, 4, 6).astype(np.float32))}
+    x = jnp.asarray(rng.rand(2, 10, 12, 4).astype(np.float32))
+    a = conv2d_nb(p, upsample_nearest(x, 2), compute_dtype=jnp.float32,
+                  reflect=True)
+    b = upsample2_conv(p, x, compute_dtype=jnp.float32)
+    assert float(jnp.abs(a - b).max()) == 0.0
+
+
+def test_style_net_fast_convs_parity():
+    """The whole style net with fast_convs on matches the reference
+    lowering (f32 pins the comparison to the rewrite, not rounding)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dvf_tpu.models.style_transfer import (
+        StyleNetConfig, apply_style_net, init_style_net)
+
+    ref_cfg = StyleNetConfig(base_channels=8, n_residual=2,
+                             compute_dtype=jnp.float32)
+    fast_cfg = StyleNetConfig(base_channels=8, n_residual=2,
+                              compute_dtype=jnp.float32, fast_convs=True)
+    params = init_style_net(jax.random.PRNGKey(0), ref_cfg)
+    x = jnp.asarray(np.random.RandomState(1).rand(2, 24, 32, 3)
+                    .astype(np.float32))
+    a = apply_style_net(params, x, ref_cfg)
+    b = apply_style_net(params, x, fast_cfg)
+    assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_espcn_fast_convs_parity():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dvf_tpu.models.espcn import EspcnConfig, apply_espcn, init_espcn
+
+    ref_cfg = EspcnConfig(compute_dtype=jnp.float32)
+    fast_cfg = EspcnConfig(compute_dtype=jnp.float32, fast_convs=True)
+    params = init_espcn(jax.random.PRNGKey(0), ref_cfg)
+    x = jnp.asarray(np.random.RandomState(1).rand(2, 18, 22, 3)
+                    .astype(np.float32))
+    a = apply_espcn(params, x, ref_cfg)
+    b = apply_espcn(params, x, fast_cfg)
+    assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_neural_filter_factory_knobs():
+    """fast_convs / dtype knobs resolve through the factories and the
+    measured-defaults table (no committed winner yet -> 'ref' lowering)."""
+    import pytest
+
+    from dvf_tpu.ops import get_filter
+
+    for name in ("style_transfer", "super_resolution"):
+        f = get_filter(name)                      # defaults: ref + bf16
+        f_fast = get_filter(name, fast_convs=True)
+        f_f32 = get_filter(name, dtype="float32")
+        assert f.name and f_fast.name and f_f32.name
+        with pytest.raises(ValueError, match="dtype"):
+            get_filter(name, dtype="float16")
