@@ -891,9 +891,11 @@ def main(argv=None) -> int:
                     help="with --display: compose panes but open no window")
     sp.add_argument("--display-backend", choices=("cv2", "gl"),
                     default="cv2",
-                    help="pane composition: cv2 window (interactive) or "
-                         "the reference's GL texture-blit path rendered "
-                         "offscreen via surfaceless EGL (headless-capable)")
+                    help="pane composition: cv2 window (interactive; ESC "
+                         "stops the stream) or the reference's GL "
+                         "texture-blit path rendered offscreen via "
+                         "surfaceless EGL (headless-capable; no window and "
+                         "no ESC — stop an infinite source with Ctrl-C)")
     sp.add_argument("--fail-fast", action="store_true",
                     help="abort on the first error instead of containing it")
     sp.add_argument("--quiet", action="store_true", help="no 5s telemetry prints")

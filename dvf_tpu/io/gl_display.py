@@ -75,52 +75,67 @@ class GLRenderer:
         major, minor = ctypes.c_long(), ctypes.c_long()
         if not EGL.eglInitialize(self._dpy, major, minor):
             raise GLUnavailable("eglInitialize failed (surfaceless Mesa)")
-        EGL.eglBindAPI(EGL.EGL_OPENGL_API)
-        attribs = (ctypes.c_int * 5)(EGL.EGL_SURFACE_TYPE, 0,
-                                     EGL.EGL_RENDERABLE_TYPE,
-                                     EGL.EGL_OPENGL_BIT, EGL.EGL_NONE)
-        cfgs = (EGL.EGLConfig * 1)()
-        n = ctypes.c_long()
-        if not EGL.eglChooseConfig(self._dpy, attribs, cfgs, 1, n) or not n.value:
-            raise GLUnavailable("no EGL config for surfaceless OpenGL")
-        self._ctx = EGL.eglCreateContext(self._dpy, cfgs[0],
-                                         EGL.EGL_NO_CONTEXT, None)
-        if not self._ctx:
-            raise GLUnavailable("eglCreateContext failed")
-        if not EGL.eglMakeCurrent(self._dpy, EGL.EGL_NO_SURFACE,
-                                  EGL.EGL_NO_SURFACE, self._ctx):
-            raise GLUnavailable("eglMakeCurrent failed "
-                                "(EGL_KHR_surfaceless_context missing?)")
+        # ADVICE r4: every failure past eglInitialize must eglTerminate
+        # before re-raising — cmd_serve probes a throwaway GLRenderer on
+        # every gl-backend start, and a partial GL stack (config/context/
+        # makeCurrent/FBO failures) would otherwise leak one EGL display
+        # per attempt.
+        try:
+            EGL.eglBindAPI(EGL.EGL_OPENGL_API)
+            attribs = (ctypes.c_int * 5)(EGL.EGL_SURFACE_TYPE, 0,
+                                         EGL.EGL_RENDERABLE_TYPE,
+                                         EGL.EGL_OPENGL_BIT, EGL.EGL_NONE)
+            cfgs = (EGL.EGLConfig * 1)()
+            n = ctypes.c_long()
+            if not EGL.eglChooseConfig(self._dpy, attribs, cfgs, 1, n) or not n.value:
+                raise GLUnavailable("no EGL config for surfaceless OpenGL")
+            self._ctx = EGL.eglCreateContext(self._dpy, cfgs[0],
+                                             EGL.EGL_NO_CONTEXT, None)
+            if not self._ctx:
+                raise GLUnavailable("eglCreateContext failed")
+            if not EGL.eglMakeCurrent(self._dpy, EGL.EGL_NO_SURFACE,
+                                      EGL.EGL_NO_SURFACE, self._ctx):
+                raise GLUnavailable("eglMakeCurrent failed "
+                                    "(EGL_KHR_surfaceless_context missing?)")
 
-        # Two streaming textures (live, processed) + one FBO-attached
-        # color texture as the composition canvas.
-        self._tex = [GL.glGenTextures(1) for _ in range(2)]
-        for t in self._tex:
-            GL.glBindTexture(GL.GL_TEXTURE_2D, t)
-            # LINEAR: the reference scales panes to the window; filtered
-            # sampling is what a window blit does.
-            GL.glTexParameteri(GL.GL_TEXTURE_2D, GL.GL_TEXTURE_MIN_FILTER,
-                               GL.GL_LINEAR)
-            GL.glTexParameteri(GL.GL_TEXTURE_2D, GL.GL_TEXTURE_MAG_FILTER,
-                               GL.GL_LINEAR)
-        self._fbo = GL.glGenFramebuffers(1)
-        GL.glBindFramebuffer(GL.GL_FRAMEBUFFER, self._fbo)
-        self._canvas_tex = GL.glGenTextures(1)
-        GL.glBindTexture(GL.GL_TEXTURE_2D, self._canvas_tex)
-        GL.glTexImage2D(GL.GL_TEXTURE_2D, 0, GL.GL_RGB, self.canvas_w,
-                        self.h, 0, GL.GL_RGB, GL.GL_UNSIGNED_BYTE, None)
-        GL.glFramebufferTexture2D(GL.GL_FRAMEBUFFER, GL.GL_COLOR_ATTACHMENT0,
-                                  GL.GL_TEXTURE_2D, self._canvas_tex, 0)
-        if (GL.glCheckFramebufferStatus(GL.GL_FRAMEBUFFER)
-                != GL.GL_FRAMEBUFFER_COMPLETE):
-            raise GLUnavailable("offscreen framebuffer incomplete")
-        GL.glEnable(GL.GL_TEXTURE_2D)
-        # Release the context from the constructing thread: blit_pair
-        # re-binds per call (the pipeline may construct on one thread and
-        # deliver on another), and a context left current here would make
-        # that bind fail with EGL_BAD_ACCESS.
-        EGL.eglMakeCurrent(self._dpy, EGL.EGL_NO_SURFACE, EGL.EGL_NO_SURFACE,
-                           EGL.EGL_NO_CONTEXT)
+            # Two streaming textures (live, processed) + one FBO-attached
+            # color texture as the composition canvas.
+            self._tex = [GL.glGenTextures(1) for _ in range(2)]
+            for t in self._tex:
+                GL.glBindTexture(GL.GL_TEXTURE_2D, t)
+                # LINEAR: the reference scales panes to the window; filtered
+                # sampling is what a window blit does.
+                GL.glTexParameteri(GL.GL_TEXTURE_2D, GL.GL_TEXTURE_MIN_FILTER,
+                                   GL.GL_LINEAR)
+                GL.glTexParameteri(GL.GL_TEXTURE_2D, GL.GL_TEXTURE_MAG_FILTER,
+                                   GL.GL_LINEAR)
+            self._fbo = GL.glGenFramebuffers(1)
+            GL.glBindFramebuffer(GL.GL_FRAMEBUFFER, self._fbo)
+            self._canvas_tex = GL.glGenTextures(1)
+            GL.glBindTexture(GL.GL_TEXTURE_2D, self._canvas_tex)
+            GL.glTexImage2D(GL.GL_TEXTURE_2D, 0, GL.GL_RGB, self.canvas_w,
+                            self.h, 0, GL.GL_RGB, GL.GL_UNSIGNED_BYTE, None)
+            GL.glFramebufferTexture2D(GL.GL_FRAMEBUFFER,
+                                      GL.GL_COLOR_ATTACHMENT0,
+                                      GL.GL_TEXTURE_2D, self._canvas_tex, 0)
+            if (GL.glCheckFramebufferStatus(GL.GL_FRAMEBUFFER)
+                    != GL.GL_FRAMEBUFFER_COMPLETE):
+                raise GLUnavailable("offscreen framebuffer incomplete")
+            GL.glEnable(GL.GL_TEXTURE_2D)
+            # Release the context from the constructing thread: blit_pair
+            # re-binds per call (the pipeline may construct on one thread
+            # and deliver on another), and a context left current here
+            # would make that bind fail with EGL_BAD_ACCESS.
+            EGL.eglMakeCurrent(self._dpy, EGL.EGL_NO_SURFACE,
+                               EGL.EGL_NO_SURFACE, EGL.EGL_NO_CONTEXT)
+        except Exception:
+            try:
+                EGL.eglMakeCurrent(self._dpy, EGL.EGL_NO_SURFACE,
+                                   EGL.EGL_NO_SURFACE, EGL.EGL_NO_CONTEXT)
+                EGL.eglTerminate(self._dpy)
+            except Exception:  # noqa: BLE001 — already failing; don't mask
+                pass
+            raise
         self._closed = False
 
     # ------------------------------------------------------------------

@@ -118,3 +118,80 @@ class Tracer:
     def __len__(self) -> int:
         with self._lock:
             return len(self._events)
+
+
+# ---------------------------------------------------------------------------
+# Host + device trace merging (§5.1: one UI, one FILE)
+# ---------------------------------------------------------------------------
+
+
+def merge_with_device_trace(
+    host_path: str,
+    device_trace_dir: str,
+    out_path: str,
+    device_epoch_us: int,
+    max_events: int = 20000,
+) -> Optional[str]:
+    """Fuse the host frame-lifecycle trace with a ``jax.profiler`` device
+    trace into ONE Chrome-trace file that opens as a single Perfetto
+    session — host lanes (capture → dispatch → deliver) above the
+    XLA/device lanes, on one aligned clock.
+
+    ``device_epoch_us`` aligns the clocks: the device trace's timestamps
+    are relative to ``jax.profiler.start_trace``, the host's to
+    ``Tracer.start_time`` — the pipeline records the profiler's start on
+    the host clock (``Tracer.device_epoch``) and passes the difference.
+
+    Filtering: the profiler's Python-tracer spam (names prefixed ``$``,
+    hundreds of thousands of interpreter-frame events) is dropped; if the
+    remainder still exceeds ``max_events``, the longest-duration events
+    win (they carry the picture; the tail is noise at frame scale).
+    Device pids are offset by +10000 so they can never collide with the
+    host's small track ids."""
+    import glob
+    import gzip
+    import os
+
+    candidates = sorted(glob.glob(os.path.join(
+        device_trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not candidates:
+        return None
+    try:
+        with open(host_path) as f:
+            host = json.load(f)
+        with gzip.open(candidates[-1], "rt") as f:
+            dev = json.load(f)
+    except (OSError, EOFError, json.JSONDecodeError):
+        # EOFError: gzip truncation (profiler killed mid-write) — the
+        # merge is best-effort teardown garnish and must never fail a
+        # run whose frames were all delivered.
+        return None
+
+    PID_OFF = 10000
+    meta, events = [], []
+    for e in dev.get("traceEvents", []):
+        ph = e.get("ph")
+        if ph == "M":
+            e = dict(e, pid=e.get("pid", 0) + PID_OFF)
+            if e.get("name") == "process_name":
+                nm = (e.get("args") or {}).get("name", "")
+                e["args"] = {"name": f"device{nm}"}
+            meta.append(e)
+        elif ph == "X" and not str(e.get("name", "")).startswith("$"):
+            events.append(e)
+    if len(events) > max_events:
+        events.sort(key=lambda e: e.get("dur", 0), reverse=True)
+        events = events[:max_events]
+    for e in events:
+        e["pid"] = e.get("pid", 0) + PID_OFF
+        e["ts"] = e.get("ts", 0) + device_epoch_us
+
+    doc = {
+        "traceEvents": host.get("traceEvents", []) + meta + events,
+        "displayTimeUnit": "ms",
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    print(f"[trace] merged host+device trace → {out_path} "
+          f"({len(events)} device events kept)", file=sys.stderr)
+    return out_path

@@ -167,7 +167,15 @@ def box_filter(x: jnp.ndarray, win: int) -> jnp.ndarray:
     This is cv2's Farneback default window (``flags=0`` runs a box blur
     over the structure-tensor images; the Gaussian window is opt-in via
     OPTFLOW_FARNEBACK_GAUSSIAN) — the parity surface behind
-    ``flow_warp(win_type="box")`` and ``box_blur(impl="cumsum")``."""
+    ``flow_warp(win_type="box")`` and ``box_blur(impl="cumsum")``.
+
+    Precision: the float32 running sums reach O(H) before the hi-lo
+    difference, but XLA lowers ``cumsum`` as an associative scan, so the
+    rounding error grows ~O(log H), not O(H) — measured 2.2e-5 max
+    deviation vs the FMA formulation at 720p (win=5), ~200× below one
+    uint8 quantum. test_box_filter_matches_uniform_sep_conv_720p_scale
+    bounds it at full geometry so a lowering change can't silently
+    regress it."""
     if win % 2 != 1 or win < 1:
         raise ValueError(f"win must be odd and positive, got {win}")
     r = win // 2

@@ -423,6 +423,10 @@ class Pipeline:
             import jax
 
             jax.profiler.start_trace(self.config.device_trace_dir)
+            # Host-clock epoch of the profiler session: what aligns the
+            # device trace's relative timestamps with the host tracer's
+            # in the merged export (obs.trace.merge_with_device_trace).
+            self._device_trace_epoch = time.time()
             device_tracing = True
         threads = [
             threading.Thread(target=self._ingest, name="dvf-ingest", daemon=True),
@@ -467,7 +471,24 @@ class Pipeline:
         if hasattr(self.queue, "close"):
             self.queue.close()  # ring transport: release shm + codec pool
         if self.tracer.enabled:
-            self.tracer.export()
+            host_trace = self.tracer.export()
+            if host_trace and device_tracing:
+                # §5.1's "merge in one UI", made literal: one file with
+                # the host frame-lifecycle lanes above the device lanes,
+                # clocks aligned via the recorded profiler epoch.
+                from dvf_tpu.obs.trace import merge_with_device_trace
+
+                try:
+                    merge_with_device_trace(
+                        host_trace, self.config.device_trace_dir,
+                        "dvf_merged_timing.pftrace",
+                        int((self._device_trace_epoch
+                             - self.tracer.start_time) * 1e6))
+                except Exception as e:  # noqa: BLE001 — teardown garnish:
+                    # a merge failure (unwritable CWD, odd profiler
+                    # output) must not fail a run that delivered.
+                    print(f"[trace] merged export failed: {e!r}",
+                          file=sys.stderr)
         return self.stats()
 
     def stats(self) -> dict:

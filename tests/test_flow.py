@@ -258,6 +258,24 @@ def test_box_filter_matches_uniform_sep_conv():
         box_filter(x, 4)
 
 
+def test_box_filter_matches_uniform_sep_conv_720p_scale():
+    """ADVICE r4: the cumsum running sums reach O(H) before differencing,
+    and the small-geometry test above couldn't bound the drift at the
+    geometry the filter is advertised for. At 720p the measured deviation
+    is ~2e-5 (XLA's cumsum is an associative scan — ~O(log H) error);
+    assert an order of magnitude of headroom below one uint8 half-step so
+    a lowering change can't silently regress it."""
+    from dvf_tpu.ops.conv import box_filter, sep_conv2d
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.random((1, 720, 1280, 3), dtype=np.float32))
+    k = jnp.ones((5,), jnp.float32) / 5.0
+    want = sep_conv2d(x, k, k)
+    got = box_filter(x, 5)
+    diff = float(jnp.abs(got - want).max())
+    assert diff < 2e-4, f"cumsum drift {diff} at 720p"
+
+
 def test_box_window_flow_recovers_translation(rng):
     """The box-window variant (cv2's flags=0 default) estimates the same
     uniform translation the Gaussian-window path does."""

@@ -154,6 +154,52 @@ def test_device_trace_capture(tmp_path):
     assert any(f.is_file() for f in found), "no device trace written"
 
 
+def test_merge_with_device_trace(tmp_path):
+    """One merged .pftrace: host lifecycle events + device events on an
+    aligned clock, python-tracer spam ($-names) dropped, device pids
+    offset past the host track ids."""
+    import gzip
+    import json
+
+    from dvf_tpu.obs.trace import Tracer, merge_with_device_trace
+
+    tracer = Tracer(enabled=True)
+    tracer.instant("frame_captured", ts=tracer.start_time + 0.001)
+    tracer.complete("batch_complete", tracer.start_time + 0.002,
+                    tracer.start_time + 0.004, track=1)
+    host_path = str(tmp_path / "host.pftrace")
+    tracer.export(host_path)
+
+    prof = tmp_path / "dev" / "plugins" / "profile" / "2026_01_01_00_00_00"
+    prof.mkdir(parents=True)
+    dev_events = [
+        {"ph": "M", "pid": 701, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 701, "tid": 1, "name": "fusion.3",
+         "ts": 500, "dur": 800},
+        {"ph": "X", "pid": 701, "tid": 1, "name": "$builtins isinstance",
+         "ts": 600, "dur": 5},
+    ]
+    with gzip.open(prof / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": dev_events}, f)
+
+    out = merge_with_device_trace(
+        host_path, str(tmp_path / "dev"), str(tmp_path / "merged.pftrace"),
+        device_epoch_us=1500)
+    assert out is not None
+    doc = json.load(open(out))
+    names = [e.get("name") for e in doc["traceEvents"]]
+    assert "frame_captured" in names and "fusion.3" in names
+    assert "$builtins isinstance" not in names       # spam dropped
+    fusion = next(e for e in doc["traceEvents"] if e["name"] == "fusion.3")
+    assert fusion["ts"] == 2000                      # 500 + epoch 1500
+    assert fusion["pid"] == 10701                    # offset past host ids
+    devproc = next(e for e in doc["traceEvents"]
+                   if e.get("ph") == "M" and e.get("pid") == 10701
+                   and e["name"] == "process_name")
+    assert devproc["args"]["name"].startswith("device")
+
+
 class TestEngineMesh:
     def test_data_parallel_mesh(self):
         """8 virtual CPU devices, batch sharded over the data axis."""
