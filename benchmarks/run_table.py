@@ -303,6 +303,11 @@ def leg_fresh(entry: dict, leg: str, min_fresh: str, quick: bool = False,
     d = entry[leg]
     if not isinstance(d, dict) or "error" in d:
         return False
+    # A leg hand-marked stale_code (captured before a code change to the
+    # path it measured) is stale regardless of stamp — the next session
+    # re-measures it and the replacement leg clears the mark.
+    if d.get("stale_code"):
+        return False
     if (d.get("quick", entry.get("quick", False)) != quick
             or d.get("forced_cpu", entry.get("forced_cpu", False)) != forced_cpu):
         return False
@@ -389,6 +394,7 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             return f"{v} †"
         return str(v)
 
+    stale_notes = []
     for name, _ in TABLE:
         r = doc["configs"].get(name)
         if not r:
@@ -397,6 +403,14 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         d, e = r.get("device", {}), r.get("e2e", {})
         roof = d.get("hbm_roofline_frac")
         mfu = d.get("mfu")
+        # ¶ = the device leg's number predates a code change to the very
+        # path it measured (reason recorded in the leg's stale_code field;
+        # a re-measure replaces the leg wholesale, clearing the mark) —
+        # the device-side analog of the e2e legs' §.
+        dev_mark = ""
+        if d.get("stale_code"):
+            dev_mark = " ¶"
+            stale_notes.append(f"{name}: {d['stale_code']}")
         stamp = ((d.get("captured_utc") if isinstance(d, dict) else "")
                  or r.get("captured_utc") or "")[:16].replace("T", " ")
         # ‡ = verified-congested upper bound; § = measured by a
@@ -410,7 +424,8 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         else:
             mark = ""
         lines.append(
-            f"| {name} | {d.get('value', 'ERR')} | {d.get('ms_per_frame', '—')} "
+            f"| {name} | {str(d.get('value', 'ERR')) + dev_mark} "
+            f"| {d.get('ms_per_frame', '—')} "
             f"| {_fmt_roof(roof)} "
             f"| {mfu if mfu is not None else '—'} "
             f"| {e.get('value', 'ERR') if e else '—'} "
@@ -454,6 +469,12 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         "for the memory-bound filter families; MFU = achieved FLOP rate / "
         "197 bf16 TFLOP/s — the right model for the neural configs "
         "(style/SR). Both computed only on TPU.")
+    if stale_notes:
+        lines.append(
+            "\n¶ = device number captured before a code change to the "
+            "measured path — kept (best available) but owed a re-measure "
+            "at the next healthy window: "
+            + "; ".join(stale_notes) + ".")
     for cname, comp in doc["impl_comparisons"].items():
         lines += [
             "",

@@ -636,3 +636,23 @@ def test_bench_wall_budget_zero_is_one_shot(tmp_path, monkeypatch, capsys):
     lines = _json_lines(capsys.readouterr().out)
     assert len(lines) == 1
     assert lines[0]["fallback"] is True and "provisional" not in lines[0]
+
+
+def test_stale_code_device_mark_and_freshness():
+    """A device leg carrying stale_code renders with the ¶ mark + footnote
+    and is never considered fresh, so the next session re-measures it."""
+    rt = _load_run_table_module()
+
+    doc = {"configs": {
+        "gauss9_1080p": {
+            "device": {"value": 1685.5, "stale_code": "pre-Mosaic capture",
+                       "captured_utc": "2026-07-31T01:42"},
+            "e2e": {"value": 1.0, "p50_ms": 5.0, "lat_delivery_fps": 2.0,
+                    "lat_congested": False,
+                    "captured_utc": "2026-07-31T01:42"}},
+    }, "impl_comparisons": {}, "updated_utc": "2026-07-31T01:42"}
+    md = rt.render_md(doc, forced_cpu=False)
+    row = next(ln for ln in md.splitlines() if ln.startswith("| gauss9"))
+    assert "1685.5 ¶" in row
+    assert "pre-Mosaic capture" in md
+    assert not rt.leg_fresh(doc["configs"]["gauss9_1080p"], "device", "")
