@@ -411,6 +411,13 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
         if d.get("stale_code"):
             dev_mark = " ¶"
             stale_notes.append(f"{name}: {d['stale_code']}")
+        e2e_mark = ""
+        if isinstance(e, dict) and e.get("stale_code"):
+            # leg_fresh honors stale_code on ANY leg — the render must
+            # too, or a hand-marked e2e leg would present a known-stale
+            # number as current until its re-measure lands.
+            e2e_mark = " ¶"
+            stale_notes.append(f"{name} (e2e): {e['stale_code']}")
         stamp = ((d.get("captured_utc") if isinstance(d, dict) else "")
                  or r.get("captured_utc") or "")[:16].replace("T", " ")
         # ‡ = verified-congested upper bound; § = measured by a
@@ -428,8 +435,8 @@ def render_md(doc: dict, forced_cpu: bool) -> str:
             f"| {d.get('ms_per_frame', '—')} "
             f"| {_fmt_roof(roof)} "
             f"| {mfu if mfu is not None else '—'} "
-            f"| {e.get('value', 'ERR') if e else '—'} "
-            f"| {str(e.get('p50_ms', '—')) + mark if e else '—'} "
+            f"| {str(e.get('value', 'ERR')) + e2e_mark if e else '—'} "
+            f"| {str(e.get('p50_ms', '—')) + mark + e2e_mark if e else '—'} "
             f"| {str(e.get('p99_ms', '—')) + mark if e else '—'} | {stamp} |"
         )
     def _legacy_e2e(r):
@@ -641,7 +648,22 @@ def main(argv=None) -> int:
         leg.update(captured_utc=_now(), quick=args.quick,
                    forced_cpu=args.cpu, code_rev=rev, iters=iters_c,
                    frames=frames_c, wall_s=round(time.time() - t_leg, 1))
-        entry[which] = leg
+        prior = entry.get(which)
+        if ("error" in leg and isinstance(prior, dict)
+                and "value" in prior):
+            # A failed RE-measure (tunnel died mid-leg) must not clobber
+            # the kept best-available number and its provenance (e.g. a
+            # stale_code-marked capture): keep the prior leg, record the
+            # failed attempt beside it. The leg stays stale by whatever
+            # made it re-run (stale_code / old stamp), so the next
+            # session retries it.
+            kept = dict(prior)
+            kept["last_retry_error"] = {
+                "error": leg["error"], "captured_utc": leg["captured_utc"],
+                "code_rev": rev}
+            entry[which] = kept
+        else:
+            entry[which] = leg
         # Migrate any entry-level (pre-leg-schema) provenance down into
         # the OTHER leg before clearing it: the untouched leg must keep
         # its stamp/mode (it may still be fresh), and the entry must not

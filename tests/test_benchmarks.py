@@ -656,3 +656,48 @@ def test_stale_code_device_mark_and_freshness():
     assert "1685.5 ¶" in row
     assert "pre-Mosaic capture" in md
     assert not rt.leg_fresh(doc["configs"]["gauss9_1080p"], "device", "")
+
+
+def test_failed_remeasure_keeps_best_available_leg(tmp_path, monkeypatch):
+    """A stale_code-marked leg re-runs; if the re-measure ERRORS (tunnel
+    died mid-leg), the kept best-available number and its provenance must
+    survive, with the failed attempt recorded beside them."""
+    import json
+
+    rt = _load_run_table_module()
+    json_path = tmp_path / "BENCH_TABLE.json"
+    # flow_720p: a TABLE config with no same-named COMPARISONS entry, so
+    # --only runs exactly one (mocked) device leg and no impl A/Bs.
+    json_path.write_text(json.dumps({"configs": {
+        "flow_720p": {"device": {
+            "value": 1685.5, "stale_code": "pre-Mosaic capture",
+            "captured_utc": "2026-07-31T01:42"}}},
+        "impl_comparisons": {}}))
+    monkeypatch.setattr(rt, "bench_config",
+                        lambda *a, **k: {"error": "rc=-9: tunnel died"})
+    monkeypatch.setattr(rt, "probe_backend",
+                        lambda *a, **k: {"backend": "tpu"})
+    rc = rt.main(["--out-dir", str(tmp_path), "--only", "flow_720p",
+                  "--legs", "device", "--min-fresh", "2026-07-31T15:45"])
+    assert rc == 0
+    doc = json.loads(json_path.read_text())
+    leg = doc["configs"]["flow_720p"]["device"]
+    assert leg["value"] == 1685.5                  # best-available kept
+    assert leg["stale_code"] == "pre-Mosaic capture"
+    assert "tunnel died" in leg["last_retry_error"]["error"]
+
+
+def test_e2e_stale_code_renders_marked():
+    rt = _load_run_table_module()
+    doc = {"configs": {
+        "flow_720p": {
+            "device": {"value": 37.9, "captured_utc": "2026-07-31T01:44"},
+            "e2e": {"value": 4.8, "p50_ms": 9.0, "lat_delivery_fps": 2.0,
+                    "lat_congested": False, "stale_code": "pre-dedup",
+                    "captured_utc": "2026-07-31T01:27"}},
+    }, "impl_comparisons": {}, "updated_utc": "2026-07-31T01:44"}
+    md = rt.render_md(doc, forced_cpu=False)
+    row = next(ln for ln in md.splitlines() if ln.startswith("| flow"))
+    assert "4.8 ¶" in row and "9.0 ¶" in row
+    assert "pre-dedup" in md
+    assert not rt.leg_fresh(doc["configs"]["flow_720p"], "e2e", "")
