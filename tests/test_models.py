@@ -375,3 +375,60 @@ def test_neural_filter_factory_knobs():
         assert f.name and f_fast.name and f_f32.name
         with pytest.raises(ValueError, match="dtype"):
             get_filter(name, dtype="float16")
+
+
+def test_tp_shard_map_forward_with_fast_convs():
+    """The fast-conv rewrites must compose with Megatron TP: conv2d_s2d
+    regroups Cin/Cout into phase blocks PER SHARD (the gather is over the
+    shard's own slice) and upsample2_conv's tap collapse is linear in the
+    kernel, so the explicit-psum shard_map forward must match the
+    replicated fast forward AND the replicated reference forward."""
+    import dataclasses
+
+    from dvf_tpu.models.style_transfer import tp_inner_apply
+
+    fast = dataclasses.replace(SMALL, fast_convs=True)
+    params = init_style_net(jax.random.PRNGKey(0), SMALL)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    want_ref = apply_style_net(params, x, SMALL)
+    want_fast = apply_style_net(params, x, fast)
+
+    mesh = make_mesh(MeshConfig(model=2))
+    specs = param_pspecs(SMALL)
+    inner = tp_inner_apply(fast)
+    got = jax.jit(jax.shard_map(
+        lambda p, b: inner(p, b),
+        mesh=mesh,
+        in_specs=(specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    ))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want_fast),
+                               atol=2e-2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want_ref),
+                               atol=2e-2)
+
+
+def test_espcn_tp_shard_map_forward_with_fast_convs():
+    import dataclasses
+
+    from dvf_tpu.models.espcn import (
+        EspcnConfig, apply_espcn, init_espcn, param_pspecs as e_pspecs,
+        tp_inner_apply as e_tp)
+
+    cfg = EspcnConfig()
+    fast = dataclasses.replace(cfg, fast_convs=True)
+    params = init_espcn(jax.random.PRNGKey(0), cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, 16, 24, 3))
+    want = apply_espcn(params, x, cfg)
+
+    mesh = make_mesh(MeshConfig(model=2))
+    inner = e_tp(fast)
+    got = jax.jit(jax.shard_map(
+        lambda p, b: inner(p, b),
+        mesh=mesh,
+        in_specs=(e_pspecs(cfg), P()),
+        out_specs=P(),
+        check_vma=False,
+    ))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2)
