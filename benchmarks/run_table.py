@@ -541,6 +541,11 @@ def main(argv=None) -> int:
                          "change only moves the device numbers — "
                          "'--legs device' refreshes those without burning "
                          "window time re-streaming the link-bound e2e legs")
+    ap.add_argument("--skip-comparisons", action="store_true",
+                    help="config legs only — lets a caller sequence the "
+                         "window (device rows, then e2e rows, THEN the "
+                         "A/B phase) instead of this script's fixed "
+                         "device→comparisons→e2e order")
     ap.add_argument("--render-only", action="store_true",
                     help="re-render BENCH_TABLE.md from the persisted JSON "
                          "without measuring anything — picks up caption/"
@@ -602,7 +607,7 @@ def main(argv=None) -> int:
             return False
         return True
 
-    comparisons = {
+    comparisons = {} if args.skip_comparisons else {
         k: v for k, v in COMPARISONS.items() if not only or k in only}
     if args.quick:
         # Quick mode shrinks shapes — rename the keys so tiny-shape numbers

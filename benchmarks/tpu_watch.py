@@ -6,10 +6,15 @@ flips on a timescale of hours, with healthy windows of ~20 minutes
 rolling one die; this daemon rolls it continuously:
 
     probe (bounded, ~75 s)  — dead → sleep and re-probe
-                            — healthy → immediately:
-        1. python bench.py            (headline; persists TPU_BENCH_R5.json)
-        2. python benchmarks/run_table.py --min-fresh <start>
-                                      (incremental; fills only missing rows)
+                            — healthy → the window plan, in VERDICT
+    priority order (each step incremental + probe-gated):
+        1. python bench.py                    (headline; TPU_BENCH_R5.json)
+        2. run_table --legs device --skip-comparisons
+        3. run_table --only gauss9_1080p,gauss3_1080p   (same-window A/B)
+        4. run_table --legs e2e --skip-comparisons      (v3 latency rows)
+        5. pallas_compile_check               (lowering attribution)
+        6. run_table                          (remaining comparisons)
+        7. neural_layers                      (per-layer attribution)
 
 Both children are the probe-gated harnesses, so a window that closes
 mid-run costs one bounded timeout and the already-landed rows persist.
@@ -93,45 +98,60 @@ def main(argv=None) -> int:
         log(f"bench.py rc={rc} backend={line.get('backend')} "
             f"value={line.get('value')} fallback={line.get('fallback')}")
 
-        # AOT lowering guard at full table geometry (seconds, data-free),
-        # covering every kernel AND the tile-sweep variants. Advisory: the
-        # table still runs either way (its other rows are unaffected and
-        # errored legs persist their own diagnostics) — this log line is
-        # what makes a sweep-leg ERR immediately attributable to lowering
-        # vs. a dead tunnel.
-        # 10 cases × ~20-40 s per uncached tunnel compile: the first
-        # healthy window pays up to ~400 s (the persistent compile cache
-        # makes later windows near-free, and warms the same cache the
-        # A/B legs reuse).
-        rc, out, err = run_cmd(
-            [sys.executable, "benchmarks/pallas_compile_check.py"],
-            env, 600.0, cwd=REPO)
-        # rc semantics (pallas_compile_check.py): 0 = all lowered on TPU,
-        # 1 = a kernel FAILED to lower, 3 = clean trace but the backend
-        # came up CPU (tunnel died between probe and check — not a
-        # lowering verdict at all); anything else = harness error/timeout.
-        level = {0: "", 1: " *** LOWERING FAILURE ***",
-                 3: " (backend fell back to CPU — no lowering verdict)"}.get(
-                     rc, " (harness error)")
-        log(f"pallas_compile_check rc={rc}{level} {last_json_line(out)}")
-
-        # Then the table: incremental, probe-gated per row; rc=2 = tunnel
-        # died mid-table (fine — finished rows persisted).
-        rc, out, err = run_cmd(
-            [sys.executable, "benchmarks/run_table.py",
-             "--min-fresh", args.min_fresh], env, 3600.0, cwd=REPO)
-        log(f"run_table rc={rc} last: {last_json_line(out)}")
-
-        # Per-layer neural timing (VERDICT r5: attribute style_720p's gap
-        # between measured ms/frame and its roofline sum to layers, and
-        # measure the exact fast-conv rewrites block by block). ~24 small
-        # jits: the first window pays tunnel compiles (persistent cache
-        # makes later windows cheap), so it runs AFTER run_table banked
-        # the table evidence. rc=3 = backend fell back to CPU mid-window.
-        n_rc, n_out, n_err = run_cmd(
-            [sys.executable, "benchmarks/neural_layers.py"],
-            env, 1500.0, cwd=REPO)
-        log(f"neural_layers rc={n_rc} last: {last_json_line(n_out)}")
+        # The window plan runs in VERDICT priority order so a short
+        # window banks the highest-ranked evidence first; every step is
+        # incremental + probe-gated, so a table step exiting rc=2 (tunnel
+        # died) aborts the remaining steps and the next window resumes
+        # where this one stopped (fresh rows skip).
+        #
+        #   1. device rows, no A/Bs     (seconds each; incl. the ¶-stale
+        #      gauss9/flow re-measures)
+        #   2. gauss A/Bs               (VERDICT #2: gauss9 device row +
+        #      A/B in the SAME window, identical geometry)
+        #   3. all 8 v3 e2e rows        (VERDICT #3; link-bound, slow)
+        #   4. lowering guard           (attribution + compile-cache warm
+        #      for the sweep legs; rc: 0 = all lowered on TPU, 1 = a
+        #      kernel FAILED to lower, 3 = backend came up CPU mid-window,
+        #      others = harness error/timeout)
+        #   5. remaining comparisons    (tile sweeps, flow, neural A/Bs)
+        #   6. per-layer neural timing  (VERDICT #5: attribute the 3.7x
+        #      lowering gap layer by layer; ~24 small jits, first window
+        #      pays the tunnel compiles, the persistent cache makes later
+        #      windows cheap)
+        table = [sys.executable, "benchmarks/run_table.py",
+                 "--min-fresh", args.min_fresh]
+        rc = 0
+        table_rcs = []
+        for label, cmd, budget in (
+            ("table-device",
+             table + ["--legs", "device", "--skip-comparisons"], 1200.0),
+            ("table-gauss-ab",
+             table + ["--only", "gauss9_1080p,gauss3_1080p"], 1200.0),
+            ("table-e2e",
+             table + ["--legs", "e2e", "--skip-comparisons"], 3600.0),
+            ("pallas_compile_check",
+             [sys.executable, "benchmarks/pallas_compile_check.py"], 600.0),
+            ("table-comparisons", table, 3600.0),
+            ("neural_layers",
+             [sys.executable, "benchmarks/neural_layers.py"], 1500.0),
+        ):
+            rc, out, err = run_cmd(cmd, env, budget, cwd=REPO)
+            note = ""
+            if label == "pallas_compile_check":
+                note = {0: "", 1: " *** LOWERING FAILURE ***",
+                        3: " (backend came up CPU — no verdict)"}.get(
+                            rc, " (harness error)")
+            log(f"{label} rc={rc}{note} last: {last_json_line(out)}")
+            if label.startswith("table"):
+                table_rcs.append(rc)
+                if rc == 2:
+                    log("tunnel died mid-plan — deferring remaining steps "
+                        "to the next window")
+                    break
+        # `rc` below (train gating / full-capture sleep) must reflect the
+        # TABLE's fate, not whichever step ran last (neural_layers exits
+        # 3 when the backend comes up CPU).
+        rc = 2 if 2 in table_rcs else max(table_rcs, default=0)
 
         # Opportunistic: train the ≥256 px style checkpoint on-chip while
         # the window is open (VERDICT r3 item 5 — the committed demo is a
