@@ -125,8 +125,12 @@ def main(argv=None) -> int:
         for label, cmd, budget in (
             ("table-device",
              table + ["--legs", "device", "--skip-comparisons"], 1200.0),
+            # --legs device: the gauss e2e legs belong to the dedicated
+            # e2e step below, not ahead of it (device legs are fresh from
+            # the previous step, so this runs exactly the two A/Bs).
             ("table-gauss-ab",
-             table + ["--only", "gauss9_1080p,gauss3_1080p"], 1200.0),
+             table + ["--only", "gauss9_1080p,gauss3_1080p",
+                      "--legs", "device"], 1200.0),
             ("table-e2e",
              table + ["--legs", "e2e", "--skip-comparisons"], 3600.0),
             ("pallas_compile_check",
