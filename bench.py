@@ -82,6 +82,7 @@ from benchtools import (
     probe_backend,
     run_cmd as _run,
     tail as _tail,
+    window_plan,
 )
 
 
@@ -493,20 +494,31 @@ def main(argv=None) -> int:
             continue
         print(json.dumps(out), flush=True)
         exit_rc[0] = 0
-        # Spend what's left of window+budget on the benchmark table (the
-        # round's owed v3 e2e rows / A/Bs), then re-print so the TPU line
-        # stays last. run_table is incremental + probe-gated: a closing
-        # window costs one bounded timeout.
-        table_budget = deadline - time.perf_counter() - 60.0
-        if table_budget > 300.0:
-            _log(f"running run_table with {table_budget:.0f}s budget")
-            rc, t_out, _ = _run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "benchmarks", "run_table.py"),
-                 "--min-fresh", ROUND5_MIN_FRESH],
-                env, table_budget)
-            _log(f"run_table rc={rc} last: {last_json_line(t_out)}")
+        # Spend what's left of window+budget on the benchmark table in
+        # the SAME evidence-priority order as the watcher's window plan
+        # (device rows → gauss A/Bs → the owed v3 e2e rows → remaining
+        # comparisons → per-layer neural timing): if this is the round's
+        # only healthy window, the e2e rows must not starve behind the
+        # A/B phase. Each step is incremental + probe-gated; rc=2 =
+        # tunnel died, stop burning the rest of the budget. The TPU line
+        # is re-printed afterwards so it stays last.
+        here = os.path.dirname(os.path.abspath(__file__))
+        for label, cmd, cap in window_plan(sys.executable, here,
+                                           ROUND5_MIN_FRESH):
+            remaining = deadline - time.perf_counter() - 60.0
+            if remaining < 300.0:
+                _log(f"budget exhausted before {label}; stopping the spend")
+                break
+            # Per-step cap (from the shared plan): a slow early step must
+            # not eat the whole remaining budget and starve the e2e rows.
+            step_budget = min(remaining, cap)
+            _log(f"running {label} ({step_budget:.0f}s of "
+                 f"{remaining:.0f}s left)")
+            rc, t_out, _ = _run(cmd, env, step_budget)
+            _log(f"{label} rc={rc} last: {last_json_line(t_out)}")
+            if label.startswith("table") and rc == 2:
+                _log("tunnel died mid-spend; stopping")
+                break
         print(json.dumps(out), flush=True)
         return 0
     _log(f"wall budget exhausted after {probes} long-wait probes — the "

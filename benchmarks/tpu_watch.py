@@ -41,6 +41,7 @@ from benchtools import (  # noqa: E402
     last_json_line,
     probe_backend,
     run_cmd,
+    window_plan,
 )
 
 
@@ -98,47 +99,16 @@ def main(argv=None) -> int:
         log(f"bench.py rc={rc} backend={line.get('backend')} "
             f"value={line.get('value')} fallback={line.get('fallback')}")
 
-        # The window plan runs in VERDICT priority order so a short
-        # window banks the highest-ranked evidence first; every step is
-        # incremental + probe-gated, so a table step exiting rc=2 (tunnel
-        # died) aborts the remaining steps and the next window resumes
-        # where this one stopped (fresh rows skip).
-        #
-        #   1. device rows, no A/Bs     (seconds each; incl. the ¶-stale
-        #      gauss9/flow re-measures)
-        #   2. gauss A/Bs               (VERDICT #2: gauss9 device row +
-        #      A/B in the SAME window, identical geometry)
-        #   3. all 8 v3 e2e rows        (VERDICT #3; link-bound, slow)
-        #   4. lowering guard           (attribution + compile-cache warm
-        #      for the sweep legs; rc: 0 = all lowered on TPU, 1 = a
-        #      kernel FAILED to lower, 3 = backend came up CPU mid-window,
-        #      others = harness error/timeout)
-        #   5. remaining comparisons    (tile sweeps, flow, neural A/Bs)
-        #   6. per-layer neural timing  (VERDICT #5: attribute the 3.7x
-        #      lowering gap layer by layer; ~24 small jits, first window
-        #      pays the tunnel compiles, the persistent cache makes later
-        #      windows cheap)
-        table = [sys.executable, "benchmarks/run_table.py",
-                 "--min-fresh", args.min_fresh]
-        rc = 0
+        # The shared window plan (benchtools.window_plan — one copy for
+        # this watcher and bench.py's round-end spend) runs in VERDICT
+        # priority order so a short window banks the highest-ranked
+        # evidence first; every step is incremental + probe-gated, so a
+        # table step exiting rc=2 (tunnel died) defers the remaining
+        # steps to the next window, which resumes where this one stopped
+        # (fresh rows skip).
         table_rcs = []
-        for label, cmd, budget in (
-            ("table-device",
-             table + ["--legs", "device", "--skip-comparisons"], 1200.0),
-            # --legs device: the gauss e2e legs belong to the dedicated
-            # e2e step below, not ahead of it (device legs are fresh from
-            # the previous step, so this runs exactly the two A/Bs).
-            ("table-gauss-ab",
-             table + ["--only", "gauss9_1080p,gauss3_1080p",
-                      "--legs", "device"], 1200.0),
-            ("table-e2e",
-             table + ["--legs", "e2e", "--skip-comparisons"], 3600.0),
-            ("pallas_compile_check",
-             [sys.executable, "benchmarks/pallas_compile_check.py"], 600.0),
-            ("table-comparisons", table, 3600.0),
-            ("neural_layers",
-             [sys.executable, "benchmarks/neural_layers.py"], 1500.0),
-        ):
+        for label, cmd, budget in window_plan(sys.executable, REPO,
+                                              args.min_fresh):
             rc, out, err = run_cmd(cmd, env, budget, cwd=REPO)
             note = ""
             if label == "pallas_compile_check":

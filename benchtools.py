@@ -65,6 +65,43 @@ def git_rev(repo_dir: Optional[str] = None) -> str:
         return "unknown"
 
 
+def window_plan(python: str, repo_dir: str, min_fresh: str):
+    """The healthy-window capture plan, in VERDICT evidence-priority
+    order — ONE copy shared by the watcher (tpu_watch.py) and bench.py's
+    round-end spend so the two can never bank evidence in different
+    orders. Yields (label, cmd, per_step_cap_s); every step is
+    incremental + probe-gated, and a table step exiting rc=2 means the
+    tunnel died (callers stop the plan).
+
+        1. device rows, no A/Bs   (seconds each; incl. ¶-stale re-measures)
+        2. gauss A/Bs             (same window as the gauss9 device row)
+        3. all 8 v3 e2e rows      (link-bound, slow)
+        4. lowering guard         (attribution + compile-cache warm;
+                                   rc: 0 ok, 1 LOWERING FAILURE, 3 came up
+                                   CPU, others harness error)
+        5. remaining comparisons  (tile sweeps, flow, neural A/Bs)
+        6. per-layer neural timing
+    """
+    bench_dir = os.path.join(repo_dir, "benchmarks")
+    table = [python, os.path.join(bench_dir, "run_table.py"),
+             "--min-fresh", min_fresh]
+    return [
+        ("table-device",
+         table + ["--legs", "device", "--skip-comparisons"], 1200.0),
+        ("table-gauss-ab",
+         table + ["--only", "gauss9_1080p,gauss3_1080p",
+                  "--legs", "device"], 1200.0),
+        ("table-e2e",
+         table + ["--legs", "e2e", "--skip-comparisons"], 3600.0),
+        ("pallas_compile_check",
+         [python, os.path.join(bench_dir, "pallas_compile_check.py")],
+         600.0),
+        ("table-comparisons", table, 3600.0),
+        ("neural_layers",
+         [python, os.path.join(bench_dir, "neural_layers.py")], 1500.0),
+    ]
+
+
 def probe_backend(env, timeout: float, cwd=None) -> Optional[dict]:
     """Run one bounded ``bench_child --mode probe``; the parsed JSON line
     ({"backend": ..., "n_devices": ..., "probe_sum": ...}) or None.
