@@ -88,21 +88,36 @@ def measured_default(winners: Dict[str, str], fallback: str) -> str:
 #   fallback      — impl for backends with no committed A/B
 #   label_to_impl — A/B harness impl labels (benchmarks/run_table.py
 #                   COMPARISONS) → the factory's impl argument values
+#   as_of         — backend → captured_utc of the committed A/B this
+#                   backend's declaration was transcribed from (absent =
+#                   none committed yet; keyed like winners, since the two
+#                   backends' captures land at different times). The test
+#                   is STRICT against that capture; an A/B auto-landed by
+#                   the watcher/driver AFTER as_of that agrees passes,
+#                   one that contradicts SKIPS with a fold-me message
+#                   (the suite must not go red on autonomous data nobody
+#                   was around to fold in)
 MEASURED_DEFAULTS = {
     "bilateral": {
         "comparison": "bilateral_1080p",
+        "as_of": {"tpu": "2026-07-31T04:01:32.529568+00:00",
+                  "cpu": "2026-07-30T17:25:47.284731+00:00"},
         "winners": {"tpu": "pallas", "cpu": "jnp"},
         "fallback": "jnp",
         "label_to_impl": {"jnp": "jnp", "pallas": "pallas"},
     },
     "sobel_bilateral": {
         "comparison": "sobel_bilateral_1080p",
+        "as_of": {"tpu": "2026-07-31T04:02:11.015286+00:00",
+                  "cpu": "2026-07-30T17:26:32.012594+00:00"},
         "winners": {"tpu": "pallas", "cpu": "pallas"},
         "fallback": "chain",
         "label_to_impl": {"jnp_chain": "chain", "pallas_fused": "pallas"},
     },
     "flow_warp": {
         "comparison": "flow_warp_720p",
+        "as_of": {"tpu": "2026-07-31T04:05:28.041167+00:00",
+                  "cpu": "2026-07-30T17:27:19.651675+00:00"},
         "winners": {"tpu": "pallas", "cpu": "gather"},
         "fallback": "gather",
         "label_to_impl": {"gather": "gather", "pallas_warp": "pallas"},
@@ -117,6 +132,8 @@ MEASURED_DEFAULTS = {
     # that capture is also consistent with a dying tunnel).
     "gaussian_blur_k9": {
         "comparison": "gauss9_1080p",
+        "as_of": {"tpu": "2026-07-31T04:07:56.417105+00:00",
+                  "cpu": "2026-07-30T17:29:24.105196+00:00"},
         "winners": {"tpu": "shift", "cpu": "pallas"},
         "fallback": "shift",
         "label_to_impl": {"shift": "shift", "depthwise": "depthwise",
@@ -125,6 +142,8 @@ MEASURED_DEFAULTS = {
     # ksize < 9 branch: shift on both measured backends (gauss3_1080p).
     "gaussian_blur_small": {
         "comparison": "gauss3_1080p",
+        "as_of": {"tpu": "2026-07-31T04:08:23.317984+00:00",
+                  "cpu": "2026-07-31T04:59:07.526136+00:00"},
         "winners": {"tpu": "shift", "cpu": "shift"},
         "fallback": "shift",
         "label_to_impl": {"shift": "shift", "pallas_fused": "pallas"},
@@ -136,12 +155,14 @@ MEASURED_DEFAULTS = {
     # until one is.
     "style_fast": {
         "comparison": "style_fast_720p",
+        "as_of": {},
         "winners": {},
         "fallback": "ref",
         "label_to_impl": {"ref": "ref", "fast": "fast"},
     },
     "espcn_fast": {
         "comparison": "sr_fast_540p",
+        "as_of": {},
         "winners": {},
         "fallback": "ref",
         "label_to_impl": {"ref": "ref", "fast": "fast"},

