@@ -1,0 +1,65 @@
+"""Launch the REFERENCE's own InverterWorker, unmodified, as a process.
+
+Used by benchmarks/reference_headtohead.py. The reference imports
+``turbojpeg`` (PyTurboJPEG), which is not installed in this image; we
+inject an API-compatible shim backed by dvf_tpu's in-repo libjpeg-turbo
+codec (``transport/jpeg_shim.cpp``) BEFORE importing the reference
+modules — same underlying codec library the reference would use, and the
+reference's code runs byte-for-byte unmodified (imported from
+/root/reference, never copied).
+
+Usage: python ref_worker_launcher.py DISTRIBUTE_PORT COLLECT_PORT
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+sys.path.insert(0, REPO)
+
+
+def install_turbojpeg_shim() -> None:
+    from dvf_tpu.transport.codec import make_codec
+
+    codec = make_codec()
+
+    class TurboJPEG:  # noqa: D401 — PyTurboJPEG's class name
+        def __init__(self, lib_path=None):
+            self._codec = codec
+
+        def encode(self, frame, quality=90):
+            return self._codec.encode(frame)
+
+        def decode(self, data):
+            return self._codec.decode(data)
+
+    mod = types.ModuleType("turbojpeg")
+    mod.TurboJPEG = TurboJPEG
+    sys.modules["turbojpeg"] = mod
+
+
+def main() -> int:
+    distribute_port, collect_port = int(sys.argv[1]), int(sys.argv[2])
+    install_turbojpeg_shim()
+    sys.path.insert(0, REF)  # inverter.py does `from worker import Worker`
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_inverter", os.path.join(REF, "inverter.py"))
+    ref = importlib.util.module_from_spec(spec)
+    # Their per-frame "Processing frame N" print would dominate a 1-core
+    # benchmark with terminal I/O; send stdout to devnull — the worker
+    # logic is untouched.
+    sys.stdout = open(os.devnull, "w")
+    spec.loader.exec_module(ref)
+    worker = ref.InverterWorker("localhost", distribute_port, collect_port)
+    worker.start()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,3 +117,26 @@ def probe_backend(env, timeout: float, cwd=None) -> Optional[dict]:
            "--init-timeout", str(max(10.0, timeout - 15.0))]
     rc, out, err = run_cmd(cmd, env, timeout, cwd=cwd)
     return last_json_line(out)
+
+
+def free_port() -> int:
+    """An OS-assigned localhost TCP port (reference wire-protocol tests
+    and the head-to-head bench both bind throwaway ZMQ pairs)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def load_reference_module(filename: str, ref_dir: str = "/root/reference"):
+    """Import one of the reference's modules from its read-only checkout
+    (never copied). Returns the loaded module."""
+    import importlib.util
+
+    path = os.path.join(ref_dir, filename)
+    spec = importlib.util.spec_from_file_location(
+        "ref_" + filename.removesuffix(".py"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

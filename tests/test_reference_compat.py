@@ -10,7 +10,6 @@ display-cursor reorder — is the reference's unmodified code; only the
 worker process is ours.
 """
 
-import importlib.util
 import os
 import threading
 import time
@@ -24,18 +23,15 @@ REF = "/root/reference/distributor.py"
 
 
 def _load_reference_distributor():
-    spec = importlib.util.spec_from_file_location("ref_distributor", REF)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.Distributor
+    from benchtools import load_reference_module
+
+    return load_reference_module("distributor.py").Distributor
 
 
 def _free_port():
-    import socket
+    from benchtools import free_port
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    return free_port()
 
 
 @pytest.mark.skipif(not os.path.exists(REF), reason="reference not present")
@@ -186,3 +182,30 @@ def test_reference_distributor_drives_tpu_worker_jpeg(rng):
     for idx, out in got.items():
         err = np.abs(out.astype(int) - (255 - frames[idx]).astype(int)).mean()
         assert err < 8, (idx, err)  # two JPEG round-trips of loss
+
+
+@pytest.mark.skipif(not os.path.exists(REF), reason="reference not present")
+def test_reference_headtohead_mechanics(tmp_path):
+    """The configs[0] parity-baseline bench runs end to end: reference's
+    unmodified Distributor + InverterWorker subprocess measured by its
+    own trace accounting, ours at the same geometry, speedups computed.
+    (Tiny duration — a mechanics check, not the committed numbers.)"""
+    import json as _json
+    import subprocess
+    import sys
+
+    out = tmp_path / "H2H"
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                      "reference_headtohead.py"),
+         "--seconds", "2", "--out", str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=240, cwd=str(tmp_path),
+    )
+    assert p.returncode == 0, p.stderr[-800:]
+    doc = _json.loads((tmp_path / "H2H.json").read_text())
+    assert doc["reference"]["frames"] > 0
+    assert doc["dvf_tpu_cpu_jpeg_wire"]["fps"] > 0
+    assert doc["speedup_raw_wire"] is not None
+    assert os.path.exists(str(out) + ".md")
