@@ -594,6 +594,12 @@ def test_bench_long_wait_budget_exhausted(tmp_path, monkeypatch, capsys):
         "captured_utc": "2026-07-31T01:05:47+00:00", "code_rev": "abc1234",
         "result": {"metric": "1080p_invert_device_fps", "value": 46001.1},
         "device_frames": 19200}))
+    import os
+    import shutil as _sh
+
+    _sh.copy(os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                          "REFERENCE_HEADTOHEAD.json"),
+             tmp_path / "REFERENCE_HEADTOHEAD.json")
     (tmp_path / "tpu_watch.log").write_text(
         "[2026-07-31T01:01:02Z] probe: HEALTHY (fake) — window #1\n"
         "[2026-07-31T01:04:10Z] bench.py rc=-9 backend=None value=None "
@@ -620,6 +626,9 @@ def test_bench_long_wait_budget_exhausted(tmp_path, monkeypatch, capsys):
     assert prov["value"] == 46001.1
     assert prov["code_rev"] == "abc1234"
     assert "46001.1" in prov["watch_log_line"]
+    # The tunnel-immune parity-baseline evidence rides along too.
+    h2h = final["reference_headtohead"]
+    assert h2h["speedup_raw_wire"] == 10.49 and h2h["reference_fps"] == 106.3
 
 
 def test_bench_wall_budget_zero_is_one_shot(tmp_path, monkeypatch, capsys):
