@@ -46,28 +46,32 @@ sys.path.insert(0, REPO)
 from benchtools import free_port, git_rev, load_reference_module  # noqa: E402
 
 
-def bench_reference(height: int, width: int, seconds: float,
-                    n_workers: int) -> dict:
-    """Drive the reference's unmodified Distributor + InverterWorker."""
+import contextlib
+
+
+@contextlib.contextmanager
+def _reference_stack(height: int, width: int, n_workers: int = 1):
+    """Start the reference's unmodified Distributor + InverterWorker
+    subprocess(es); yields (dist, jpeg_frame). Tears down the workers,
+    reports a dead worker's stderr tail, runs the reference's cleanup,
+    and removes its CWD-relative trace export."""
+    import tempfile
+
     import numpy as np
 
     from benchmarks.ref_worker_launcher import install_turbojpeg_shim
 
     install_turbojpeg_shim()
     mod = load_reference_module("distributor.py", REF)
-
     from dvf_tpu.transport.codec import make_codec
 
     rng = np.random.RandomState(0)
-    frame = rng.randint(0, 255, (height, width, 3), np.uint8)
-    jpeg = make_codec().encode(frame)
-
+    jpeg = make_codec().encode(
+        rng.randint(0, 255, (height, width, 3), np.uint8))
     p_dist, p_coll = free_port(), free_port()
     dist = mod.Distributor(distribute_port=p_dist, collect_port=p_coll,
                            frame_delay=5, enable_trace_export=True)
     dist.start()
-    import tempfile
-
     stderr_log = tempfile.TemporaryFile()
     workers = [
         subprocess.Popen(
@@ -78,12 +82,46 @@ def bench_reference(height: int, width: int, seconds: float,
         for _ in range(n_workers)
     ]
     try:
-        # Warmup: let the worker connect and process a few frames.
-        t_end = time.time() + 2.0
-        while time.time() < t_end:
-            dist.add_frame_for_distribution(jpeg, time.time())
-            dist.update_display_frame()
-            time.sleep(0.002)
+        yield dist, jpeg
+    finally:
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        dist.cleanup()
+        # The reference's cleanup() exports its trace to a hardcoded
+        # CWD-relative path (distributor.py:374-376) — don't leave the
+        # stray artifact behind.
+        try:
+            os.remove("webcam_frame_timing.pftrace")
+        except OSError:
+            pass
+        if any(w.returncode not in (0, -15) for w in workers):
+            stderr_log.seek(0)
+            tail = stderr_log.read()[-800:].decode(errors="replace")
+            print(f"[h2h] reference worker stderr tail:\n{tail}",
+                  file=sys.stderr)
+        stderr_log.close()
+
+
+def _warmup(dist, jpeg, seconds: float = 2.0) -> None:
+    """Stream frames so the worker connects AND pays its cold path
+    (first decode/encode, first READY round-trip) before measurement."""
+    t_end = time.time() + seconds
+    while time.time() < t_end:
+        dist.add_frame_for_distribution(jpeg, time.time())
+        dist.update_display_frame()
+        time.sleep(0.002)
+
+
+def bench_reference(height: int, width: int, seconds: float,
+                    n_workers: int) -> dict:
+    """Drive the reference's unmodified Distributor + InverterWorker."""
+    with _reference_stack(height, width, n_workers) as (dist, jpeg):
+        _warmup(dist, jpeg)
         n0 = len(dist.frame_timings)
         t0 = time.time()
         t_end = t0 + seconds
@@ -112,28 +150,69 @@ def bench_reference(height: int, width: int, seconds: float,
             "worker_p50_ms": round(durs[len(durs) // 2] * 1e3, 2) if durs
             else None,
         }
-    finally:
-        for w in workers:
-            w.terminate()
-        for w in workers:
-            try:
-                w.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                w.kill()
-        dist.cleanup()
-        # The reference's cleanup() exports its trace to a hardcoded
-        # CWD-relative path (distributor.py:374-376) — don't leave the
-        # stray artifact behind.
-        try:
-            os.remove("webcam_frame_timing.pftrace")
-        except OSError:
-            pass
-        if any(w.returncode not in (0, -15) for w in workers):
-            stderr_log.seek(0)
-            tail = stderr_log.read()[-800:].decode(errors="replace")
-            print(f"[h2h] reference worker stderr tail:\n{tail}",
-                  file=sys.stderr)
-        stderr_log.close()
+
+
+def bench_reference_latency(height: int, width: int, seconds: float,
+                            target_fps: float) -> dict:
+    """Capture→worker-end transit of the reference at a throttled offer
+    rate (≈half its measured throughput, so its stream is uncongested).
+
+    Matched per frame_index from its OWN trace events: the 'i'
+    frame_captured timestamp at add (distributor.py:63-73,191) to the 'X'
+    end_time the worker self-reports (worker.py:59). GENEROUS to the
+    reference: the interval excludes collect-socket receipt and the
+    frame_delay display-cursor wait, while ours below is full
+    capture→DELIVERED through the reorder buffer."""
+    with _reference_stack(height, width, 1) as (dist, jpeg):
+        _warmup(dist, jpeg)
+        n0 = len(dist.frame_timings)
+        period = 1.0 / target_fps
+        t_next = time.time()
+        t_end = t_next + seconds
+        while time.time() < t_end:
+            dist.add_frame_for_distribution(jpeg, time.time())
+            dist.update_display_frame()
+            t_next += period
+            time.sleep(max(0.0, t_next - time.time()))
+        time.sleep(0.5)  # let in-flight results land
+        evs = dist.frame_timings[n0:]
+        captured = {e["frame_index"]: e["timestamp"] for e in evs
+                    if e.get("event_ph") == "i"}
+        transits = sorted(
+            e["end_time"] - captured[e["frame_index"]] for e in evs
+            if e.get("event_ph") == "X" and e.get("frame_index") in captured)
+        if not transits:
+            return {"error": "no matched frames"}
+        return {
+            "target_fps": target_fps,
+            "frames": len(transits),
+            "p50_ms": round(transits[len(transits) // 2] * 1e3, 2),
+            "p99_ms": round(
+                transits[min(len(transits) - 1,
+                             int(len(transits) * 0.99))] * 1e3, 2),
+        }
+
+
+def bench_ours_latency(height: int, width: int, n_frames: int,
+                       target_fps: float) -> dict:
+    """Full capture→delivered transit through our pipeline at the same
+    offered rate, same codec work (ring transport, JPEG wire), verified
+    uncongested by the v3 discipline (congestion → automatic backoff)."""
+    from dvf_tpu.benchmarks import bench_e2e_latency
+    from dvf_tpu.ops import get_filter
+
+    # batch_size=1: the latency-optimal config at sub-capacity rates (no
+    # assembly wait) — and symmetric with the reference, which processes
+    # one frame per worker request. Throughput rows above use batch 8.
+    r = bench_e2e_latency(get_filter("invert"), n_frames, 1, height, width,
+                          target_fps=target_fps, transport="ring",
+                          wire="jpeg")
+    return {"target_fps": r.get("target_fps"),
+            "frames": r.get("frames"),
+            "p50_ms": round(r["p50_ms"], 2),
+            "p99_ms": round(r["p99_ms"], 2),
+            "congested": r.get("congested"),
+            "delivery_fps": r.get("delivery_fps")}
 
 
 def bench_ours(height: int, width: int, seconds: float, wire: str) -> dict:
@@ -187,6 +266,24 @@ def main(argv=None) -> int:
         return 1
     ours_jpeg = bench_ours(args.height, args.width, args.seconds, "jpeg")
     ours_raw = bench_ours(args.height, args.width, args.seconds, "raw")
+    # Latency leg at a matched offered rate: half the reference's measured
+    # throughput, so BOTH streams run uncongested.
+    lat_rate = max(5.0, round(ref["fps"] / 2.0))
+    ref_lat = bench_reference_latency(args.height, args.width,
+                                      args.seconds, lat_rate)
+    if "error" in ref_lat:
+        # Same guard as the throughput leg: never overwrite the good
+        # committed artifact with a dead-worker run.
+        print(json.dumps({"error": "reference latency leg failed",
+                          "detail": ref_lat}), flush=True)
+        return 1
+    ours_lat = bench_ours_latency(args.height, args.width,
+                                  max(16, int(lat_rate * args.seconds)),
+                                  lat_rate)
+    # bench_e2e_latency may BACK OFF (halve the rate) if our stream
+    # congests — the comparison is only "matched rate" when it didn't.
+    rates_matched = (not ours_lat.get("congested")
+                     and ours_lat.get("target_fps") == lat_rate)
 
     doc = {
         "captured_utc": datetime.datetime.now(
@@ -198,6 +295,12 @@ def main(argv=None) -> int:
         "reference": ref,
         "dvf_tpu_cpu_jpeg_wire": ours_jpeg,
         "dvf_tpu_cpu_raw_wire": ours_raw,
+        "latency_at_matched_rate": {
+            "offered_fps": lat_rate,
+            "rates_matched": rates_matched,
+            "reference_capture_to_worker_end": ref_lat,
+            "dvf_tpu_capture_to_delivered": ours_lat,
+        },
         "speedup_same_codec": round(ours_jpeg["fps"] / ref["fps"], 2)
         if ref["fps"] else None,
         "speedup_raw_wire": round(ours_raw["fps"] / ref["fps"], 2)
@@ -219,6 +322,18 @@ def main(argv=None) -> int:
         f"{ours_jpeg['fps']} | **{doc['speedup_same_codec']}x** |\n"
         f"| dvf_tpu (CPU backend, raw/shm ring wire — the design point) | "
         f"{ours_raw['fps']} | **{doc['speedup_raw_wire']}x** |\n\n"
+        + (f"Latency at a matched {lat_rate:.0f} fps offered rate (both "
+           "uncongested): " if rates_matched else
+           f"Latency (NOT rate-matched — ours backed off to "
+           f"{ours_lat.get('target_fps')} fps or congested; reference at "
+           f"{lat_rate:.0f} fps): ")
+        + "reference capture→worker-end p50 "
+        f"{ref_lat.get('p50_ms')} ms / p99 {ref_lat.get('p99_ms')} ms "
+        "(generous: excludes collect receipt and its frame_delay display "
+        "wait); dvf_tpu full capture→DELIVERED through the reorder "
+        f"buffer p50 {ours_lat.get('p50_ms')} ms / p99 "
+        f"{ours_lat.get('p99_ms')} ms (congested="
+        f"{ours_lat.get('congested')}).\n\n"
         "The reference runs its own code end to end (imported from "
         "/root/reference, never copied): ROUTER fan-out, latest-wins "
         "slot, PULL collect, reorder buffer, with PyTurboJPEG provided "
