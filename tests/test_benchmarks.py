@@ -626,9 +626,17 @@ def test_bench_long_wait_budget_exhausted(tmp_path, monkeypatch, capsys):
     assert prov["value"] == 46001.1
     assert prov["code_rev"] == "abc1234"
     assert "46001.1" in prov["watch_log_line"]
-    # The tunnel-immune parity-baseline evidence rides along too.
+    # The tunnel-immune parity-baseline evidence rides along too — same
+    # values as the committed artifact (don't pin numbers: the artifact
+    # regenerates).
+    import json as _json
+
+    committed = _json.loads(
+        (tmp_path / "REFERENCE_HEADTOHEAD.json").read_text())
     h2h = final["reference_headtohead"]
-    assert h2h["speedup_raw_wire"] == 10.49 and h2h["reference_fps"] == 106.3
+    assert h2h["reference_fps"] == committed["reference"]["fps"]
+    assert h2h["speedup_raw_wire"] == committed["speedup_raw_wire"]
+    assert h2h["speedup_raw_wire"] > 0
 
 
 def test_bench_wall_budget_zero_is_one_shot(tmp_path, monkeypatch, capsys):
