@@ -285,3 +285,24 @@ def test_measured_per_backend_defaults():
     assert measured_default({"tpu": "a"}, fallback="b") == "b"
     with pytest.raises(ValueError, match="pallas"):
         get_filter("gaussian_blur", impl="palas")
+
+
+def test_median_blur_matches_cv2():
+    """median_blur == cv2.medianBlur(k=3) exactly (BORDER_REPLICATE,
+    median-of-9 sorting network; median commutes with the uint8<->float
+    mapping, so the float path reproduces the uint8 golden bit-exactly)."""
+    import cv2
+    import pytest
+
+    from dvf_tpu.ops import get_filter
+
+    rng = np.random.RandomState(3)
+    f = get_filter("median_blur")
+    for shape in [(48, 64), (31, 37)]:
+        img = rng.randint(0, 255, (*shape, 3), np.uint8)
+        want = cv2.medianBlur(img, 3)
+        got, _ = f(jnp.asarray(img[None], jnp.float32) / 255.0, None)
+        got8 = np.round(np.asarray(got[0]) * 255.0).astype(np.uint8)
+        np.testing.assert_array_equal(got8, want)
+    with pytest.raises(ValueError, match="ksize=3"):
+        get_filter("median_blur", ksize=5)
