@@ -118,6 +118,14 @@ def median_blur(ksize: int = 3) -> Filter:
     the float [0,1] path commutes exactly with the uint8 golden.
     Only ksize=3 is supported: the median-of-25 network for ksize=5 is
     ~5× the ops for a filter cv2 itself restricts to uint8 at that size.
+
+    ``halo=None`` (never spatially sharded): the halo machinery
+    substitutes reflect-101 rows at global frame borders
+    (parallel/halo.py) — correct for every other stencil here, but
+    cv2.medianBlur's border is EDGE-replicate, so a sharded run would
+    diverge from the unsharded golden on the outermost rows. The engine
+    replicates H instead (correct-first policy); at 19 min/max ops the
+    filter has nothing to gain from spatial sharding anyway.
     """
     if ksize != 3:
         raise ValueError(
@@ -146,4 +154,4 @@ def median_blur(ksize: int = 3) -> Filter:
         ex(4, 2)
         return v[4]
 
-    return stateless("median_blur(k=3)", fn, halo=1)
+    return stateless("median_blur(k=3)", fn, halo=None)

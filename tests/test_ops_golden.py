@@ -291,11 +291,6 @@ def test_median_blur_matches_cv2():
     """median_blur == cv2.medianBlur(k=3) exactly (BORDER_REPLICATE,
     median-of-9 sorting network; median commutes with the uint8<->float
     mapping, so the float path reproduces the uint8 golden bit-exactly)."""
-    import cv2
-    import pytest
-
-    from dvf_tpu.ops import get_filter
-
     rng = np.random.RandomState(3)
     f = get_filter("median_blur")
     for shape in [(48, 64), (31, 37)]:
