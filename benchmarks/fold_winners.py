@@ -59,10 +59,14 @@ def main() -> int:
             as_of = entry.get("as_of", {}).get(backend, "")
             fps = {k: v.get("fps") for k, v in comp.items()
                    if isinstance(v, dict) and "fps" in v}
-            state = ("OK" if declared == expected and stamp <= (as_of or stamp)
-                     else "OK (newer, agrees — bump as_of)"
-                     if declared == expected
-                     else "FOLD: flip winner + bump as_of")
+            if declared != expected:
+                state = "FOLD: flip winner + bump as_of"
+            elif not as_of:
+                state = "RECORD: agrees but no as_of — record provenance"
+            elif stamp <= as_of:
+                state = "OK"
+            else:
+                state = "OK (newer, agrees — bump as_of)"
             if state != "OK":
                 pending += 1
             print(f"{key}/{backend}: declared={declared!r} committed-winner="

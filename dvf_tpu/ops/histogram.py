@@ -80,6 +80,21 @@ def _equalize_u8_plane(plane_u8: jnp.ndarray, reduce_cdf=None,
     return out.reshape(b, h, w)
 
 
+def _dispatch_planes(x_u8: jnp.ndarray, on_gray: bool, apply_planes):
+    """Shared plane dispatch for the histogram family: ``on_gray`` runs
+    ``apply_planes`` on the luma and broadcasts (the cv2 golden mode);
+    otherwise channels fold into the batch axis so ONE traced chain
+    serves all C planes."""
+    if on_gray:
+        gray = (x_u8 if x_u8.shape[-1] == 1
+                else to_uint8(rgb_to_gray(to_float(x_u8))))
+        eq = apply_planes(gray[..., 0])[..., None]
+        return jnp.broadcast_to(eq, x_u8.shape)
+    b, h, w, c = x_u8.shape
+    planes = jnp.moveaxis(x_u8, -1, 1).reshape(b * c, h, w)
+    return jnp.moveaxis(apply_planes(planes).reshape(b, c, h, w), 1, -1)
+
+
 @register_filter("equalize")
 def equalize(on_gray: bool = False) -> Filter:
     """Global histogram equalization.
@@ -94,18 +109,8 @@ def equalize(on_gray: bool = False) -> Filter:
         u8 = batch.dtype == jnp.uint8
         x = to_uint8(batch)
         nt = None if h_total is None else h_total * x.shape[2]
-        if on_gray:
-            gray = x if x.shape[-1] == 1 else to_uint8(rgb_to_gray(to_float(x)))
-            eq = _equalize_u8_plane(gray[..., 0], reduce_cdf, nt)[..., None]
-            out = jnp.broadcast_to(eq, x.shape)
-        else:
-            # Channels fold into the batch axis: one traced histogram/LUT
-            # chain for all C planes instead of C duplicated subgraphs.
-            b, h, w, c = x.shape
-            planes = jnp.moveaxis(x, -1, 1).reshape(b * c, h, w)
-            out = jnp.moveaxis(
-                _equalize_u8_plane(planes, reduce_cdf, nt).reshape(b, c, h, w),
-                1, -1)
+        out = _dispatch_planes(
+            x, on_gray, lambda p: _equalize_u8_plane(p, reduce_cdf, nt))
         return out if u8 else to_float(out, batch.dtype)
 
     def fn(batch: jnp.ndarray) -> jnp.ndarray:
@@ -154,3 +159,118 @@ def equalize(on_gray: bool = False) -> Filter:
 
     return stateless(f"equalize(gray={on_gray})", fn, uint8_ok=True, halo=None,
                      specialize=specialize)
+
+
+# ---------------------------------------------------------------------------
+# CLAHE — contrast-limited ADAPTIVE histogram equalization
+# ---------------------------------------------------------------------------
+
+
+def _clahe_luts(tiles_flat: jnp.ndarray, tile_area: int,
+                clip_abs: int) -> jnp.ndarray:
+    """(T, P) int32 tile pixels → (T, 256) uint8 CLAHE LUTs, matching
+    cv2.CLAHE: per-tile histogram (sort + searchsorted, same
+    scatter-free trick as :func:`_plane_cdf`), clip at ``clip_abs``,
+    redistribute the excess exactly the way cv2 does (uniform batch +
+    strided residual), then the scaled cumulative LUT."""
+    cdf = _plane_cdf(tiles_flat)                       # (T, 256)
+    hist = jnp.diff(cdf, axis=1, prepend=0.0)
+    # Clip + uniform redistribution.
+    excess = jnp.sum(jnp.maximum(hist - clip_abs, 0.0), axis=1, keepdims=True)
+    hist = jnp.minimum(hist, float(clip_abs))
+    batch_add = jnp.floor(excess / 256.0)
+    residual = excess - batch_add * 256.0              # (T, 1), 0..255
+    hist = hist + batch_add
+    # cv2's residual pass: step = max(256 // residual, 1); bins 0, step,
+    # 2*step, ... each get +1 until the residual runs out.
+    step = jnp.maximum(jnp.floor(256.0 / jnp.maximum(residual, 1.0)), 1.0)
+    idx = jnp.arange(256, dtype=jnp.float32)[None, :]
+    gets_one = ((jnp.mod(idx, step) == 0.0)
+                & (jnp.floor(idx / step) < residual)
+                & (residual > 0.0))
+    hist = hist + gets_one.astype(jnp.float32)
+    lut = jnp.round(jnp.cumsum(hist, axis=1) * (255.0 / tile_area))
+    return jnp.clip(lut, 0.0, 255.0).astype(jnp.uint8)
+
+
+@register_filter("clahe")
+def clahe(clip_limit: float = 2.0, grid: int = 8,
+          on_gray: bool = False) -> Filter:
+    """Contrast-Limited Adaptive Histogram Equalization — cv2.createCLAHE
+    semantics (the standard low-light/contrast video enhancement).
+
+    Where ``equalize`` uses one whole-frame histogram, CLAHE builds a
+    ``grid``×``grid`` lattice of tile histograms, clips each at
+    ``clip_limit``×(uniform level) to bound noise amplification,
+    redistributes the clipped mass, and bilinearly interpolates the four
+    neighboring tile LUTs at every pixel.
+
+    TPU mapping: tile histograms fold into the batch axis of the same
+    sort+searchsorted cdf as ``equalize`` (no scatter-add — TPU has
+    none fast); clipping/redistribution is elementwise over (T, 256);
+    the interpolation is 4 image-sized gathers from the (grid, grid,
+    256) LUT lattice. Non-divisible geometries reflect-pad right/bottom
+    (what cv2 does) and crop. ``on_gray=False`` applies per RGB channel;
+    ``on_gray=True`` is the cv2 golden-test mode (single luma plane,
+    broadcast). halo=None: tiles are frame-global structure — the
+    engine replicates H rather than spatially sharding.
+    """
+    if grid < 1:
+        raise ValueError(f"grid must be >= 1, got {grid}")
+    if clip_limit <= 0:
+        raise ValueError(f"clip_limit must be > 0, got {clip_limit}")
+
+    def apply_planes(planes: jnp.ndarray) -> jnp.ndarray:
+        """(N, H, W) uint8 planes → CLAHE'd uint8 planes."""
+        n, h, w = planes.shape
+        hp = -(-h // grid) * grid
+        wp = -(-w // grid) * grid
+        x = planes
+        if hp != h or wp != w:
+            x = jnp.pad(x, ((0, 0), (0, hp - h), (0, wp - w)),
+                        mode="reflect")
+        th, tw = hp // grid, wp // grid
+        tile_area = th * tw
+        clip_abs = max(1, int(clip_limit * tile_area / 256.0))
+        u = x.astype(jnp.int32)
+        tiles = u.reshape(n, grid, th, grid, tw).transpose(0, 1, 3, 2, 4)
+        luts = _clahe_luts(tiles.reshape(n * grid * grid, tile_area),
+                           tile_area, clip_abs)
+        luts = luts.reshape(n, grid, grid, 256)
+
+        # cv2's interpolation lattice: tile-space coordinate of a pixel
+        # center is (p / tile) - 0.5; corners floor/ceil, clamped.
+        def corners(size, tile):
+            f = (jnp.arange(size, dtype=jnp.float32) / tile) - 0.5
+            lo = jnp.floor(f)
+            frac = f - lo
+            lo_i = jnp.clip(lo.astype(jnp.int32), 0, grid - 1)
+            hi_i = jnp.clip(lo.astype(jnp.int32) + 1, 0, grid - 1)
+            return lo_i, hi_i, frac
+
+        ty0, ty1, fy = corners(hp, th)
+        tx0, tx1, fx = corners(wp, tw)
+        bidx = jnp.arange(n)[:, None, None]
+
+        def look(ty, tx):
+            # (N, Hp, Wp) gather: LUT of tile (ty[y], tx[x]) at value u.
+            return luts[bidx, ty[None, :, None], tx[None, None, :],
+                        u].astype(jnp.float32)
+
+        fy_ = fy[None, :, None]
+        fx_ = fx[None, None, :]
+        out = ((1 - fy_) * (1 - fx_) * look(ty0, tx0)
+               + (1 - fy_) * fx_ * look(ty0, tx1)
+               + fy_ * (1 - fx_) * look(ty1, tx0)
+               + fy_ * fx_ * look(ty1, tx1))
+        out = jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
+        return out[:, :h, :w]
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        u8 = batch.dtype == jnp.uint8
+        x = to_uint8(batch)
+        out = _dispatch_planes(x, on_gray, apply_planes)
+        return out if u8 else to_float(out, batch.dtype)
+
+    return stateless(f"clahe(c={clip_limit},g={grid})", fn, uint8_ok=True,
+                     halo=None)
