@@ -13,6 +13,7 @@ from dvf_tpu.ops import conv  # noqa: F401,E402
 from dvf_tpu.ops import bilateral  # noqa: F401,E402
 from dvf_tpu.ops import flow  # noqa: F401,E402
 from dvf_tpu.ops import chains  # noqa: F401,E402
+from dvf_tpu.ops import canny  # noqa: F401,E402
 from dvf_tpu.ops import style  # noqa: F401,E402
 from dvf_tpu.ops import sr  # noqa: F401,E402
 from dvf_tpu.ops import histogram  # noqa: F401,E402
