@@ -153,17 +153,21 @@ MEASURED_DEFAULTS = {
     # models.analysis). No backend pinned yet: the A/Bs are queued but no
     # winner is committed — the factories run the reference lowering
     # until one is.
+    # CPU committed (full 720p/540p geometry, benchmarks/cpu/): "ref"
+    # wins both — the phase decomposition buys MXU lane utilization,
+    # which AVX has no analog of (style: 0.1 vs 0.1 tie; sr: 0.9 vs
+    # 0.4). TPU stays unpinned until the queued on-chip A/Bs land.
     "style_fast": {
         "comparison": "style_fast_720p",
-        "as_of": {},
-        "winners": {},
+        "as_of": {"cpu": '2026-07-31T19:11:01.991899+00:00'},
+        "winners": {"cpu": "ref"},
         "fallback": "ref",
         "label_to_impl": {"ref": "ref", "fast": "fast"},
     },
     "espcn_fast": {
         "comparison": "sr_fast_540p",
-        "as_of": {},
-        "winners": {},
+        "as_of": {"cpu": '2026-07-31T19:13:42.915897+00:00'},
+        "winners": {"cpu": "ref"},
         "fallback": "ref",
         "label_to_impl": {"ref": "ref", "fast": "fast"},
     },
