@@ -718,3 +718,35 @@ def test_e2e_stale_code_renders_marked():
     assert "4.8 ¶" in row and "9.0 ¶" in row
     assert "pre-dedup" in md
     assert not rt.leg_fresh(doc["configs"]["flow_720p"], "e2e", "")
+
+
+def test_window_plan_commands_are_runnable(tmp_path):
+    """A typo'd flag in benchtools.window_plan would burn a real tunnel
+    window (argparse exits 2 before any probe). Validate every step's
+    flags against the real scripts: run_table steps run with
+    --render-only against a dummy table (parses ALL flags, measures
+    nothing); other steps must at least accept --help."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    from benchtools import window_plan
+
+    (tmp_path / "BENCH_TABLE.json").write_text(_json.dumps({
+        "configs": {"invert_1080p": {
+            "device": {"value": 1.0, "captured_utc": "2026-07-31T01:00"}}},
+        "impl_comparisons": {}}))
+    plan = window_plan(sys.executable, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "2026-07-31T00:00")
+    labels = [label for label, _, _ in plan]
+    assert labels[0] == "table-device" and "table-e2e" in labels
+    for label, cmd, cap in plan:
+        assert cap > 0
+        if "run_table.py" in cmd[1]:
+            check = cmd + ["--render-only", "--out-dir", str(tmp_path)]
+        else:
+            check = cmd + ["--help"]
+        p = subprocess.run(check, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.PIPE, text=True, timeout=60)
+        assert p.returncode == 0, (label, p.stderr[-500:])
