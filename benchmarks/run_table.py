@@ -143,6 +143,19 @@ COMPARISONS = {
         ("tile40", "sobel_bilateral_pallas", {"tile_h": 40}),
         ("tile120", "sobel_bilateral_pallas", {"tile_h": 120}),
     ]),
+    # gauss9's committed A/B has the (post-Mosaic-fix) Pallas kernel at
+    # 186 fps vs shift's 1022 — either a sick-tunnel capture (its 0.043
+    # HBM fraction suggests so) or a real kernel deficiency. This sweep
+    # disambiguates in the same window the A/B re-runs: if some tile_h
+    # recovers the kernel to shift-competitive, the 186 was geometry, not
+    # the tunnel; if all tiles are slow, shift stays the default with a
+    # measured reason.
+    "gauss9_tile_1080p": (1080, 1920, 8, [
+        ("tile8", "gaussian_blur_pallas", {"ksize": 9, "tile_h": 8}),
+        ("tile24", "gaussian_blur_pallas", {"ksize": 9, "tile_h": 24}),
+        ("tile40", "gaussian_blur_pallas", {"ksize": 9, "tile_h": 40}),
+        ("tile120", "gaussian_blur_pallas", {"ksize": 9, "tile_h": 120}),
+    ]),
     # Exact conv rewrites for the neural configs (VERDICT r4 item 5):
     # space-to-depth phase decomposition on the lane-starved stem/out 9x9
     # convs + phase-collapsed subpixel decoder (models.layers.conv2d_s2d /
