@@ -36,7 +36,7 @@ numbers were captured hours earlier in the same round):
   item 1): one probe up front, then — if the tunnel is down — the CPU
   fallback measurement runs IMMEDIATELY and its JSON line is printed as a
   provisional result, after which the bench keeps probing on a ~5-minute
-  cadence across ``--wall-budget`` (default 2 h, env
+  cadence across ``--wall-budget`` (default 3 h, env
   ``DVF_BENCH_WALL_S``). The moment a window opens, the real TPU bench
   runs and its JSON line is printed after the provisional one.
 - **Output protocol: the LAST complete JSON line on stdout is the
@@ -359,7 +359,7 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-retries", type=int, default=1)
     ap.add_argument("--probe-retry-wait", type=float, default=30.0)
     ap.add_argument("--wall-budget", type=float,
-                    default=float(os.environ.get("DVF_BENCH_WALL_S", "7200")),
+                    default=float(os.environ.get("DVF_BENCH_WALL_S", "10800")),
                     help="total seconds to keep probing for a healthy "
                          "window after the provisional CPU fallback is "
                          "printed; 0 restores one-shot behavior (the "
