@@ -249,6 +249,103 @@ def _resolve_source(args, allow_shm: bool = True):
     )
 
 
+def _cmd_serve_multi(args, filt, engine) -> int:
+    """Local multi-stream demo: N synthetic client streams at different
+    frame rates multiplexed through ONE shared engine by the serving
+    frontend (serve.ServeFrontend) — each stream keeps its own frame
+    index space, drop-oldest ingress bound, and latency SLO; device
+    batches mix sessions every tick. Prints one JSON line: per-session
+    delivery/shed/latency stats plus the fleet aggregate p50/p99."""
+    import threading
+
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    if args.source != "synthetic":
+        print("error: --sessions > 1 runs the local multi-stream demo, "
+              "which is synthetic-source only (use the in-process "
+              "serve.ServeFrontend API for real streams)", file=sys.stderr)
+        return 2
+    if args.display:
+        print("error: --display is single-stream only", file=sys.stderr)
+        return 2
+
+    n = args.sessions
+    if args.max_sessions and args.max_sessions < n:
+        print(f"error: --max-sessions {args.max_sessions} < --sessions {n}: "
+              f"the demo opens every stream up front, so the cap must admit "
+              f"them all", file=sys.stderr)
+        return 2
+    config = ServeConfig(
+        batch_size=args.batch,
+        max_sessions=args.max_sessions if args.max_sessions else max(16, n),
+        queue_size=args.queue_size,
+        slo_ms=args.slo_ms,
+        frame_delay=args.frame_delay,
+        resilient=not args.fail_fast,
+    )
+    frontend = ServeFrontend(filt, config, engine=engine)
+
+    # Spread the streams across ~0.4×..1.6× the base rate: genuinely
+    # different per-tenant cadences, so batches interleave sessions
+    # rather than ticking in lockstep.
+    base = args.rate if args.rate > 0 else 30.0
+    rates = [base * 2.0 * (i + 1) / (n + 1) for i in range(n)]
+    delivered: dict = {}
+
+    def drive(sid: str, rate: float, seed: int) -> None:
+        src = SyntheticSource(height=args.height, width=args.width,
+                              n_frames=args.frames, rate=rate, seed=seed)
+        for frame, ts in src:
+            if frame is None:
+                break
+            # Cycle frames are immutable shared views — safe to submit
+            # without copying (StreamSession.submit references them).
+            frontend.submit(sid, frame, ts=ts)
+
+    with frontend:
+        sids = [frontend.open_stream(slo_ms=args.slo_ms) for _ in range(n)]
+        drivers = [
+            threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
+            for i, (sid, rate) in enumerate(zip(sids, rates))
+        ]
+        for t in drivers:
+            t.start()
+        while any(t.is_alive() for t in drivers):
+            for sid in sids:
+                delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
+            time.sleep(0.01)
+        for sid in sids:
+            frontend.close(sid, drain=True)  # graceful: serve the tail
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            for sid in sids:
+                delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
+            if frontend.open_count() == 0:  # not stats(): the full
+                break                      # percentile merge is per-report
+            time.sleep(0.01)
+        for sid in sids:
+            delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
+        stats = frontend.stats()
+
+    out = {
+        "sessions": {
+            sid: {k: s[k] for k in ("submitted", "delivered", "shed",
+                                    "slo_miss", "fps", "p50_ms", "p99_ms")}
+            for sid, s in stats["sessions"].items()
+        },
+        "rates": {sid: round(r, 2) for sid, r in zip(sids, rates)},
+        "polled": delivered,
+        "aggregate": stats["aggregate"],
+        "shed_total": stats["shed_total"],
+        "admission_rejections": stats["admission_rejections"],
+        "engine_batches": stats["engine_batches"],
+        "errors": stats["errors"],
+    }
+    print(json.dumps(out, default=float))
+    return 0
+
+
 def cmd_serve(args) -> int:
     _force_platform()
 
@@ -284,6 +381,11 @@ def cmd_serve(args) -> int:
     from dvf_tpu.runtime.engine import Engine
 
     engine = Engine(filt, mesh=_parse_mesh(args.mesh))
+    if args.sessions > 1:
+        # Multi-tenant path: N streams through one shared engine via the
+        # serving frontend (admission control, cross-session batching,
+        # per-stream SLOs) instead of the one-stream Pipeline.
+        return _cmd_serve_multi(args, filt, engine)
     source, frame_shape = _resolve_source(args)
 
     # Live serving is resilient (one bad frame never kills the stream,
@@ -925,6 +1027,19 @@ def main(argv=None) -> int:
                     help="with --transport ring: payload format on the ring "
                          "(jpeg = encode at capture, decode into the device "
                          "staging buffer — the reference's use_jpeg path)")
+    sp.add_argument("--sessions", type=int, default=1,
+                    help=">1: run the multi-stream serving demo — N "
+                         "synthetic client streams at different frame "
+                         "rates multiplexed through one shared engine "
+                         "(serve.ServeFrontend: cross-session batching, "
+                         "admission control, per-stream SLOs)")
+    sp.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="per-stream latency budget for --sessions mode; "
+                         "frames that blow it before reaching a device "
+                         "slot are shed, not processed")
+    sp.add_argument("--max-sessions", type=int, default=0,
+                    help="admission cap for --sessions mode "
+                         "(0 = max(16, --sessions))")
 
     cp = sub.add_parser(
         "camera",  # host-only (no jax): the --platform flag would be a no-op

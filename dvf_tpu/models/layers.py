@@ -191,8 +191,10 @@ def conv2d_s2d(
 def _upsample2_kernel(w: jnp.ndarray) -> jnp.ndarray:
     """Phase-collapse a (k, k, Cin, Cout) kernel across a preceding
     nearest-×2 upsample: taps of the full-res conv that read the same
-    low-res source pixel sum into one tap. Returns (kl, kl, Cin, 4·Cout)
-    for a VALID conv on the edge-padded low-res input."""
+    low-res source pixel sum into one tap. Returns a ``(kernel,
+    pad_radius)`` tuple — the (kl, kl, Cin, 4·Cout) kernel for a VALID
+    conv on the low-res input, and the edge-pad radius that input needs
+    (``-e0``, the magnitude of the most-negative low-res tap offset)."""
     k = w.shape[0]
     r = k // 2
     # Low-res tap offset e = floor((i + dy - r) / 2) for dy in [0, k).

@@ -55,6 +55,52 @@ class LatencyStats:
     def summary(self) -> Dict[str, float]:
         return {"fps": self.fps(), "count": self.count, **self.percentiles()}
 
+    @classmethod
+    def merged(cls, stats: "list[LatencyStats]",
+               qs=(50, 90, 99)) -> Dict[str, float]:
+        """Fleet-level summary across several recorders (the serving
+        frontend's per-session stats → one aggregate p50/p99 export).
+
+        Percentiles weight each recorder's samples by its decimation
+        stride, so a long-running stream that has been decimated 2:1
+        still counts each surviving sample for the ~stride deliveries it
+        represents. fps is total deliveries over the union time span —
+        the fleet's delivery rate, not a mean of per-stream rates.
+        """
+        stats = [s for s in stats if s.count]
+        if not stats:
+            return {"fps": 0.0, "count": 0,
+                    **{f"p{q}_ms": float("nan") for q in qs}}
+        # Snapshot each recorder's sample list ONCE (list() is atomic
+        # under the GIL): collect threads append — and decimate, swapping
+        # the list and doubling _stride — concurrently with this read.
+        # Pairing a snapshot with a stride read keeps samples/weights the
+        # same length; a stride doubled between the two reads only skews
+        # weighting transiently, never crashes.
+        snaps = []
+        for s in stats:
+            samples = list(s.samples_ms)
+            if samples:
+                snaps.append((np.asarray(samples), float(s._stride)))
+        if not snaps:  # count incremented before the first append lands
+            return {"fps": 0.0, "count": sum(s.count for s in stats),
+                    **{f"p{q}_ms": float("nan") for q in qs}}
+        samples = np.concatenate([a for a, _ in snaps])
+        weights = np.concatenate(
+            [np.full(len(a), stride) for a, stride in snaps])
+        order = np.argsort(samples)
+        cum = np.cumsum(weights[order])
+        out: Dict[str, float] = {}
+        for q in qs:
+            k = int(np.searchsorted(cum, q / 100.0 * cum[-1]))
+            out[f"p{q}_ms"] = float(samples[order][min(k, len(samples) - 1)])
+        t0 = min(s.t0 for s in stats)
+        t1 = max(s.t1 for s in stats)
+        count = sum(s.count for s in stats)
+        out["fps"] = (count - 1) / (t1 - t0) if count > 1 and t1 > t0 else 0.0
+        out["count"] = count
+        return out
+
 
 class RateLogger:
     """Periodic printer, like the reference's every-5s FPS prints

@@ -72,8 +72,17 @@ def _nms(mag: jnp.ndarray, gx: jnp.ndarray, gy: jnp.ndarray) -> jnp.ndarray:
     return (mag > n1) & (mag >= n2)
 
 
-def _hysteresis(strong: jnp.ndarray, weak: jnp.ndarray) -> jnp.ndarray:
-    """Fixpoint of s ← (dilate₈(s) ∧ weak) ∨ strong, batched."""
+def _hysteresis(strong: jnp.ndarray, weak: jnp.ndarray,
+                max_iters: int = 256) -> jnp.ndarray:
+    """Fixpoint of s ← (dilate₈(s) ∧ weak) ∨ strong, batched.
+
+    ``max_iters`` bounds the loop: iterations scale with the longest
+    weak-edge geodesic path, so a pathological frame (one serpentine
+    weak chain) could otherwise run thousands of full-frame dilation
+    passes inside one jitted call and stall a real-time pipeline. Edges
+    farther than the cap along a weak chain from any strong seed stay
+    unpromoted — cv2 parity is unaffected at any plausible depth.
+    """
 
     def dilate(s):
         return lax.reduce_window(
@@ -81,21 +90,23 @@ def _hysteresis(strong: jnp.ndarray, weak: jnp.ndarray) -> jnp.ndarray:
             [(0, 0), (1, 1), (1, 1)])
 
     def cond(state):
-        s, changed = state
-        return changed
+        _, changed, i = state
+        return changed & (i < max_iters)
 
     def body(state):
-        s, _ = state
+        s, _, i = state
         grown = (dilate(s) & weak) | strong
-        return grown, jnp.any(grown != s)
+        return grown, jnp.any(grown != s), i + 1
 
-    out, _ = lax.while_loop(cond, body, (strong, jnp.asarray(True)))
+    out, _, _ = lax.while_loop(
+        cond, body,
+        (strong, jnp.asarray(True), jnp.asarray(0, jnp.int32)))
     return out
 
 
 @register_filter("canny")
 def canny(threshold1: float = 100.0, threshold2: float = 200.0,
-          l2_gradient: bool = True) -> Filter:
+          l2_gradient: bool = True, max_iters: int = 256) -> Filter:
     """Canny edges on luma, broadcast to 3 channels (white on black).
 
     ``threshold1``/``threshold2`` follow cv2.Canny (low/high hysteresis
@@ -106,6 +117,9 @@ def canny(threshold1: float = 100.0, threshold2: float = 200.0,
     ``halo=None``: hysteresis connectivity is global (an edge chain may
     cross the whole frame), so spatial sharding would need an iterated
     halo exchange per fixpoint round — the engine replicates H instead.
+
+    ``max_iters`` caps the hysteresis fixpoint so worst-case frame
+    latency is bounded (see ``_hysteresis``).
     """
     lo, hi = sorted((float(threshold1), float(threshold2)))
 
@@ -120,7 +134,7 @@ def canny(threshold1: float = 100.0, threshold2: float = 200.0,
         ridge = _nms(mag, gx, gy)
         strong = ridge & (mag > hi)
         weak = ridge & (mag > lo)
-        edges = _hysteresis(strong, weak)
+        edges = _hysteresis(strong, weak, max_iters=max_iters)
         out = edges.astype(batch.dtype)[..., None]
         return jnp.broadcast_to(out, batch.shape)
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from dvf_tpu.api.filter import Filter, stateless
 from dvf_tpu.ops.registry import register_filter
 from dvf_tpu.utils.image import rgb_to_gray, to_float, to_uint8
+from dvf_tpu.utils.compat import shard_map
 
 
 def _plane_cdf(flat_i32: jnp.ndarray) -> jnp.ndarray:
@@ -139,7 +140,7 @@ def equalize(on_gray: bool = False) -> Filter:
                         h_total=h)
 
         def sharded_fn(batch, state):
-            out = jax.shard_map(
+            out = shard_map(
                 inner, mesh=mesh,
                 in_specs=spec,
                 out_specs=spec,

@@ -31,6 +31,7 @@ from dvf_tpu.models.style_transfer import (
     tp_inner_apply,
 )
 from dvf_tpu.ops.registry import measured_default_for, register_filter
+from dvf_tpu.utils.compat import shard_map
 
 
 @register_filter("style_transfer")
@@ -139,7 +140,7 @@ def style_transfer(
             batch_spec = P(None)
 
         def sharded_fn(batch: jnp.ndarray, state: Any) -> Tuple[jnp.ndarray, Any]:
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 inner,
                 mesh=mesh,
                 in_specs=(specs, batch_spec),

@@ -25,6 +25,7 @@ it is kept in-path so drop/delay semantics match the reference everywhere.
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import threading
 import time
@@ -68,7 +69,9 @@ class PipelineConfig:
     #   oldest-first either way.
     device_trace_dir: Optional[str] = None  # capture a jax.profiler device
     #   trace for the whole run into this dir — Perfetto-compatible, views
-    #   alongside the host-side frame-lifecycle trace (obs.trace) in one UI
+    #   alongside the host-side frame-lifecycle trace (obs.trace) in one
+    #   UI; with trace=True the merged host+device export
+    #   (dvf_merged_timing.pftrace) also lands in this dir
 
 
 class Pipeline:
@@ -479,9 +482,14 @@ class Pipeline:
                 from dvf_tpu.obs.trace import merge_with_device_trace
 
                 try:
+                    # Into device_trace_dir, beside the device trace it
+                    # merges — a CWD-relative path would scatter the
+                    # artifacts (or silently lose the merge in a
+                    # read-only CWD).
                     merge_with_device_trace(
                         host_trace, self.config.device_trace_dir,
-                        "dvf_merged_timing.pftrace",
+                        os.path.join(self.config.device_trace_dir,
+                                     "dvf_merged_timing.pftrace"),
                         int((self._device_trace_epoch
                              - self.tracer.start_time) * 1e6))
                 except Exception as e:  # noqa: BLE001 — teardown garnish:

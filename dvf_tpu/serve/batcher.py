@@ -1,0 +1,105 @@
+"""Continuous cross-session batcher.
+
+The single-stream pipeline fills each device batch from ONE queue
+(`runtime.pipeline._assemble`); when that stream is slow, the batch pads
+and TPU utilization collapses. This batcher generalizes the assembler
+across tenants: every tick it drains ready frames from *all* sessions and
+packs them into one fixed-signature device batch — slots tagged
+``(session_id, frame_index)``, short batches padded with a repeat of the
+last valid row exactly like the single-stream assembler (static shapes →
+one compilation; the ``valid`` count drops padded outputs on the way
+back).
+
+Scheduling policy (the genuinely new multi-tenant part):
+
+- **EDF across sessions.** Candidate slots are ordered by SLO deadline
+  (submit ts + the session's latency budget) and the earliest deadlines
+  win the batch. With equal SLOs this degrades to global FIFO by arrival
+  — fair by construction; a tighter-SLO stream gets priority exactly
+  proportional to how much less slack it has. Deadlines are monotonic
+  within a stream, so EDF always picks a per-session *prefix* and
+  per-session ordering is preserved end to end.
+- **Shed by SLO headroom when oversubscribed.** Losing slots stay queued
+  and age; once a frame's deadline passes before it reaches a device
+  slot it is shed (counted per session) rather than processed — device
+  time is never spent on a result the client's latency budget has
+  already written off. Undersubscribed systems never shed: every frame
+  makes the next batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from dvf_tpu.serve.session import Slot, StreamSession
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One tick's device batch: the staged array, how many rows are real,
+    and the (session, frame_index) tag per valid row."""
+
+    batch: np.ndarray
+    valid: int
+    slots: List[Slot]
+
+
+class ContinuousBatcher:
+    """Drains ready frames across sessions into fixed-signature batches."""
+
+    def __init__(self, batch_size: int):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = batch_size
+
+    def plan(
+        self,
+        sessions: Sequence[StreamSession],
+        now: float,
+        staging: Optional[np.ndarray] = None,
+    ) -> Optional[BatchPlan]:
+        """Assemble one batch from everything ready; None = nothing to do.
+
+        ``staging``: preallocated (batch_size, H, W, C) buffer to fill
+        (the frontend's per-inflight-slot pool); a fresh array is
+        allocated when omitted (tests).
+
+        Dispatch-thread only: touches the sessions' scheduler-owned
+        ``pending`` staging.
+        """
+        candidates: List[Slot] = []
+        for s in sessions:
+            s.drain_ingress()
+            s.shed_expired(now)  # counted on the session (stats() sums)
+            candidates.extend(s.pending)
+        if not candidates:
+            return None
+        # EDF: earliest SLO deadline first. Stable sort + per-session
+        # monotonic deadlines (a hard guarantee — submit clamps each
+        # deadline to at least the previous one, whatever client ts
+        # says) ⇒ the chosen set is a prefix of each session's pending
+        # deque, so popleft below removes exactly the chosen slots.
+        candidates.sort(key=lambda slot: slot.deadline)
+        chosen = candidates[: self.batch_size]
+        taken_per_session: dict = {}
+        for slot in chosen:
+            taken_per_session[slot.session] = (
+                taken_per_session.get(slot.session, 0) + 1)
+        for s, n in taken_per_session.items():
+            for _ in range(n):
+                s.pending.popleft()
+            s.claim_inflight(n)
+
+        valid = len(chosen)
+        if staging is None:
+            f0 = chosen[0].frame
+            staging = np.empty((self.batch_size, *f0.shape), dtype=f0.dtype)
+        for row, slot in enumerate(chosen):
+            np.copyto(staging[row], slot.frame)
+            slot.frame = None  # drop the client's buffer reference
+        for row in range(valid, self.batch_size):
+            np.copyto(staging[row], staging[valid - 1])
+        return BatchPlan(batch=staging, valid=valid, slots=chosen)

@@ -1,0 +1,70 @@
+"""Result router: demultiplex shared-engine batches back to sessions.
+
+The collect side of the serving frontend. A completed device batch
+carries frames from several tenants interleaved in slot order; the
+router walks the plan's ``(session, frame_index)`` tags, feeds each valid
+row to its session's reorder buffer, advances that session's display
+cursor, and emits whatever became ready to the session's out queue or
+sink. The padded tail rows (``row >= valid``) are dropped exactly like
+the single-stream collect path.
+
+Observability stays session-local here: every delivered frame is
+recorded in its session's ``LatencyStats``; the frontend-wide p50/p99
+export merges those per-stream samples on demand
+(``LatencyStats.merged``), so nothing is recorded twice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dvf_tpu.serve.batcher import BatchPlan
+
+
+class ResultRouter:
+    """Collect-thread component: batches in, per-session deliveries out."""
+
+    def __init__(self):
+        self.batches = 0
+        self.frames = 0
+        self.late_after_close = 0  # results for hard-closed sessions
+
+    def route(self, plan: BatchPlan, out: np.ndarray) -> int:
+        """Demux one completed batch; returns frames delivered.
+
+        Rows are copied out of the batch array: a view would keep the
+        whole (batch_size, H, W, C) result alive for as long as ONE
+        delivery sits unpolled — a slow-polling client could pin
+        out_queue_size full batches (batch_size× amplification) instead
+        of out_queue_size frames.
+        """
+        touched = []
+        for row, slot in enumerate(plan.slots[: plan.valid]):
+            s = slot.session
+            s.complete(slot, out[row].copy())
+            if s.state == "closed":
+                self.late_after_close += 1
+            elif s not in touched:
+                touched.append(s)
+        delivered = 0
+        for s in touched:
+            delivered += s.deliver_ready()
+        self.batches += 1
+        self.frames += plan.valid
+        return delivered
+
+    def discard(self, plan: BatchPlan) -> None:
+        """A device batch failed; release its sessions' in-flight claims
+        so a closing session can still finalize."""
+        per_session = {}
+        for slot in plan.slots[: plan.valid]:
+            per_session[slot.session] = per_session.get(slot.session, 0) + 1
+        for s, n in per_session.items():
+            s.discard_inflight(n)
+
+    def stats(self) -> dict:
+        return {
+            "batches": self.batches,
+            "frames": self.frames,
+            "late_after_close": self.late_after_close,
+        }

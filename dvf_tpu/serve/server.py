@@ -1,0 +1,512 @@
+"""The serving front door: N client streams, one shared engine.
+
+``ServeFrontend`` multiplexes independent client sessions onto a single
+``runtime.engine.Engine`` — the first genuinely multi-tenant execution
+path in the framework. Topology (one process, two service threads around
+the async device queue, mirroring the single-stream pipeline's shape):
+
+  clients ──submit──► per-session ingress (drop-oldest)
+                          │ dispatch thread: ContinuousBatcher (EDF +
+                          ▼ SLO shed) → one fixed-signature batch/tick
+                      Engine.submit  (shared; in-flight depth bounded)
+                          │ collect thread: materialize → ResultRouter
+                          ▼
+                      per-session reorder → out queue / sink ──poll──► clients
+
+Admission control is two-layered: ``max_sessions`` caps tenants at
+``open_stream`` (AdmissionError beyond) and ``max_inflight`` caps device
+batches in flight (bounding queueing delay for everyone — the per-batch
+analog of the single-stream pipeline's semaphore). Overload beyond that
+is absorbed by the per-session drop-oldest bounds and the batcher's
+SLO shedding, never by blocking a client.
+
+Only stateless filters are served: a stateful filter's temporal state
+would thread *across* batches whose rows belong to different tenants —
+cross-session state leakage by construction — so the frontend refuses
+them at build time.
+
+``ZmqStreamBridge`` binds one session to the reference app's socket pair
+using the exact READY-credit framing of ``transport.zmq_ingress`` — a
+reference-style client connects and sees one fast worker, while its
+frames share device batches with every other tenant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.metrics import LatencyStats
+from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.serve.batcher import ContinuousBatcher
+from dvf_tpu.serve.router import ResultRouter
+from dvf_tpu.serve.session import (
+    CLOSED,
+    AdmissionError,
+    ServeError,
+    SessionConfig,
+    StreamSession,
+)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    batch_size: int = 8
+    max_sessions: int = 16        # admission cap (open_stream)
+    max_inflight: int = 4         # device batches in flight (latency bound)
+    queue_size: int = 10          # per-session ingress bound
+    slo_ms: float = 1000.0        # default per-stream latency budget
+    frame_delay: int = 0          # per-session reorder cursor lag
+    reorder_capacity: int = 50
+    out_queue_size: int = 64      # per-session poll-side bound
+    max_retired: int = 64         # closed sessions kept poll-able; oldest
+    #   evicted beyond this (a churning long-lived server must not pin
+    #   every dead tenant's tail frames forever — release() drops one
+    #   explicitly once its client has drained)
+    tick_s: float = 0.002         # dispatch idle poll
+    resilient: bool = True        # one bad batch is dropped + counted;
+    #   serving keeps going (live-mode semantics, like Pipeline.resilient)
+
+
+class ServeFrontend:
+    """Multi-tenant serving frontend over one shared Engine."""
+
+    def __init__(
+        self,
+        filt: Filter,
+        config: Optional[ServeConfig] = None,
+        engine: Optional[Engine] = None,
+    ):
+        if filt.stateful:
+            raise ValueError(
+                f"filter {filt.name!r} is stateful; a shared batch "
+                f"interleaves rows from different sessions, so temporal "
+                f"state would leak across tenants — the serving frontend "
+                f"only multiplexes stateless filters")
+        self.filter = filt
+        self.config = config or ServeConfig()
+        self.engine = engine or Engine(filt)
+        self.batcher = ContinuousBatcher(self.config.batch_size)
+        self.router = ResultRouter()
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, StreamSession] = {}
+        self._retired: Dict[str, StreamSession] = {}   # closed; poll-able
+        self._ids = itertools.count()
+        self.admission_rejections = 0
+        self.errors = 0
+        self._frame_shape: Optional[tuple] = None  # pinned at first submit
+        self._frame_dtype = None
+        self._staging: Optional[List[np.ndarray]] = None
+        # Plain unbounded FIFO: depth is already bounded by the semaphore,
+        # and drop-oldest semantics here would silently leak a permit and
+        # the dropped batch's inflight claims.
+        self._inflight: "queue.Queue" = queue.Queue()
+        self._inflight_sem = threading.Semaphore(self.config.max_inflight)
+        self._stop = threading.Event()
+        self._dispatch_done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ServeFrontend":
+        if self._threads:
+            raise ServeError("frontend already started")
+        self._threads = [
+            threading.Thread(target=self._dispatch, name="dvf-serve-dispatch",
+                             daemon=True),
+            threading.Thread(target=self._collect, name="dvf-serve-collect",
+                             daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Graceful shutdown: stop batching new work, drain what's in
+        flight, deliver every session's tail, retire all sessions."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        with self._lock:
+            sessions = list(self._sessions.items())
+            for sid, s in sessions:
+                self._retire_locked(sid, s)
+            self._sessions.clear()
+        for _, s in sessions:
+            s.finalize()
+        if self._error is not None:
+            raise self._error
+
+    def __enter__(self) -> "ServeFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API ------------------------------------------------------
+
+    def open_stream(
+        self,
+        session_id: Optional[str] = None,
+        slo_ms: Optional[float] = None,
+        sink: Any = None,
+    ) -> str:
+        """Admit one new stream; returns its session id.
+
+        Raises ``AdmissionError`` at the ``max_sessions`` cap — overload
+        is refused at the door, not absorbed as unbounded queueing."""
+        cfg = SessionConfig(
+            queue_size=self.config.queue_size,
+            slo_ms=slo_ms if slo_ms is not None else self.config.slo_ms,
+            frame_delay=self.config.frame_delay,
+            reorder_capacity=self.config.reorder_capacity,
+            out_queue_size=self.config.out_queue_size,
+        )
+        with self._lock:
+            if len(self._sessions) >= self.config.max_sessions:
+                self.admission_rejections += 1
+                raise AdmissionError(
+                    f"session limit reached ({self.config.max_sessions} "
+                    f"open); close a stream or raise max_sessions")
+            sid = session_id if session_id is not None else f"s{next(self._ids)}"
+            if sid in self._sessions or sid in self._retired:
+                raise ServeError(f"session id {sid!r} already exists")
+            self._sessions[sid] = StreamSession(sid, cfg, sink=sink)
+        return sid
+
+    def submit(self, session_id: str, frame: np.ndarray,
+               ts: Optional[float] = None, tag: Any = None) -> int:
+        """Enqueue one frame on a stream; returns its per-stream index."""
+        if self._frame_shape is None:
+            with self._lock:
+                if self._frame_shape is None:
+                    self._frame_shape = frame.shape
+                    self._frame_dtype = frame.dtype
+        if frame.shape != self._frame_shape or frame.dtype != self._frame_dtype:
+            raise ValueError(
+                f"frame {frame.shape}/{frame.dtype} does not match this "
+                f"frontend's pinned signature {self._frame_shape}/"
+                f"{self._frame_dtype} (one compiled program serves all "
+                f"sessions — geometry is per-frontend, not per-stream)")
+        return self._session(session_id).submit(frame, ts=ts, tag=tag)
+
+    def poll(self, session_id: str, max_items: Optional[int] = None) -> list:
+        """Pop completed ``Delivery`` records for one stream (works on
+        retired sessions until their tail is drained)."""
+        return self._session(session_id).poll(max_items)
+
+    def close(self, session_id: str, drain: bool = True) -> None:
+        """Per-session teardown. ``drain=True`` (graceful) serves what's
+        queued and in flight first; the dispatch thread retires the
+        session once it has drained. Other sessions are untouched."""
+        self._session(session_id).close(drain=drain)
+
+    def open_count(self) -> int:
+        """Number of non-retired sessions — cheap (no percentile work),
+        for polling loops that just watch for drain/retirement."""
+        with self._lock:
+            return len(self._sessions)
+
+    def release(self, session_id: str) -> None:
+        """Forget a retired session (its undrained tail is dropped).
+        Call once the client has polled everything it wants — retired
+        sessions are otherwise only evicted by the max_retired bound."""
+        with self._lock:
+            if session_id in self._sessions:
+                raise ServeError(
+                    f"session {session_id!r} is still open; close() it first")
+            self._retired.pop(session_id, None)
+
+    def _session(self, session_id: str) -> StreamSession:
+        with self._lock:
+            s = self._sessions.get(session_id) or self._retired.get(session_id)
+        if s is None:
+            raise KeyError(f"unknown session {session_id!r}")
+        return s
+
+    def _retire_locked(self, sid: str, session: StreamSession) -> None:
+        """Move one session to the retired map, evicting oldest beyond
+        the retention bound (dicts iterate in insertion order)."""
+        self._retired[sid] = session
+        while len(self._retired) > self.config.max_retired:
+            self._retired.pop(next(iter(self._retired)))
+
+    # -- service threads -------------------------------------------------
+
+    def _staging_for(self, seq: int) -> np.ndarray:
+        """Per-inflight-slot staging pool, exactly like the single-stream
+        pipeline's: max_inflight + 1 buffers means the one being rewritten
+        always belongs to an already-collected batch."""
+        shape = (self.config.batch_size, *self._frame_shape)
+        if self._staging is None or self._staging[0].shape != shape:
+            self._staging = [
+                np.empty(shape, dtype=self._frame_dtype)
+                for _ in range(self.config.max_inflight + 1)
+            ]
+        return self._staging[seq % len(self._staging)]
+
+    def _fail(self, e: BaseException) -> None:
+        if self._error is None:
+            self._error = e
+        self._stop.set()
+
+    def _contain(self, e: BaseException, where: str) -> bool:
+        if self.config.resilient and isinstance(e, Exception):
+            self.errors += 1
+            print(f"[serve:{where}] error (continuing): {e!r}",
+                  file=sys.stderr, flush=True)
+            return True
+        self._fail(e)
+        return False
+
+    def _finalize_drained(self) -> None:
+        """Retire closing sessions with nothing left queued or in flight
+        (dispatch thread — it owns the pending deques being checked)."""
+        with self._lock:
+            done = [(sid, s) for sid, s in self._sessions.items()
+                    if s.drained()]
+            for sid, s in done:
+                self._sessions.pop(sid)
+                self._retire_locked(sid, s)
+        for _, s in done:
+            s.finalize()
+
+    def _dispatch(self) -> None:
+        seq = 0
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    sessions = [s for s in self._sessions.values()
+                                if s.state != CLOSED]
+                plan = None
+                if sessions and self._frame_shape is not None:
+                    plan = self.batcher.plan(
+                        sessions, time.time(), staging=self._staging_for(seq))
+                self._finalize_drained()
+                if plan is None:
+                    time.sleep(self.config.tick_s)
+                    continue
+                # Bounded in-flight depth; poll so shutdown can't wedge on
+                # a dead collect thread. Acquired before engine.submit —
+                # the permit is what makes staging-buffer reuse safe.
+                while not self._inflight_sem.acquire(timeout=0.1):
+                    if self._stop.is_set():
+                        self.router.discard(plan)
+                        return
+                t0 = time.time()
+                try:
+                    result = self.engine.submit(plan.batch)
+                    try:
+                        result.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                except Exception as e:  # noqa: BLE001 — drop this batch
+                    self._inflight_sem.release()
+                    self.router.discard(plan)
+                    if not self._contain(e, "dispatch"):
+                        return
+                    continue
+                seq += 1
+                self._inflight.put((plan, result, t0))
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+        finally:
+            self._dispatch_done.set()
+
+    def _collect(self) -> None:
+        try:
+            while True:
+                try:
+                    plan, result, _t0 = self._inflight.get(timeout=0.05)
+                except queue.Empty:
+                    if self._dispatch_done.is_set() and self._inflight.empty():
+                        break
+                    continue
+                try:
+                    out = np.asarray(result)  # waits for the device
+                except Exception as e:  # noqa: BLE001 — poisoned batch
+                    self._inflight_sem.release()
+                    self.router.discard(plan)
+                    if not self._contain(e, "collect"):
+                        return
+                    continue
+                self._inflight_sem.release()
+                self.router.route(plan, out)
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-session stats plus the fleet aggregate p50/p99 export."""
+        with self._lock:
+            live = dict(self._sessions)
+            retired = dict(self._retired)
+        every = {**retired, **live}
+        session_stats = {sid: s.stats() for sid, s in every.items()}
+        return {
+            "sessions": session_stats,
+            "open_sessions": len(live),
+            "retired_sessions": len(retired),
+            "admission_rejections": self.admission_rejections,
+            # Sum of the per-session counters (covers deadline sheds AND
+            # hard-close discards) so the aggregate always reconciles
+            # with the per-stream rows it sits beside; sessions evicted
+            # from the retention bound leave the sum.
+            "shed_total": sum(s["shed"] for s in session_stats.values()),
+            "errors": self.errors,
+            "engine_batches": self.engine.stats.batches,
+            "engine_frames": self.engine.stats.frames,
+            **self.router.stats(),
+            "aggregate": LatencyStats.merged(
+                [s.latency for s in every.values()]),
+        }
+
+
+class ZmqStreamBridge:
+    """One reference-style client ↔ one frontend session, over the wire
+    framing of ``transport.zmq_ingress`` (READY credits on a DEALER, raw
+    results on a PUSH — behaviorally a very fast single worker).
+
+    The remote app keeps its own frame index space; each frame's remote
+    index rides through the session as the slot ``tag`` and is echoed
+    back in the result message, so the app's reorder buffer works
+    unmodified while the session uses its private index space internally.
+    """
+
+    def __init__(
+        self,
+        frontend: ServeFrontend,
+        host: str = "localhost",
+        distribute_port: int = 5555,
+        collect_port: int = 5556,
+        use_jpeg: bool = True,
+        raw_size: int = 512,
+        jpeg_quality: int = 90,
+        poll_ms: int = 10,
+        slo_ms: Optional[float] = None,
+    ):
+        import zmq
+
+        from dvf_tpu.transport.codec import make_codec
+        from dvf_tpu.transport.zmq_ingress import READY
+
+        self._zmq = zmq
+        self._ready = READY
+        self.frontend = frontend
+        self.session_id = frontend.open_stream(slo_ms=slo_ms)
+        self.codec = make_codec(quality=jpeg_quality)
+        self.use_jpeg = use_jpeg
+        self.raw_size = raw_size
+        self.poll_ms = poll_ms
+        self.errors = 0
+        self.ctx = zmq.Context()
+        self.dealer = self.ctx.socket(zmq.DEALER)
+        self.dealer.connect(f"tcp://{host}:{distribute_port}")
+        self.push = self.ctx.socket(zmq.PUSH)
+        self.push.setsockopt(zmq.SNDTIMEO, 1000)
+        self.push.connect(f"tcp://{host}:{collect_port}")
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _decode(self, payload: bytes) -> np.ndarray:
+        if self.use_jpeg:
+            h, w = self.codec.probe(payload)
+            out = np.empty((h, w, 3), np.uint8)
+            self.codec.decode_batch([payload], out=out[None])
+            return out
+        return np.frombuffer(payload, np.uint8).reshape(
+            self.raw_size, self.raw_size, 3)
+
+    def run(self, max_frames: Optional[int] = None) -> None:
+        """Credit-pump loop: READY credits out, frames in, deliveries
+        back. Same per-iteration containment as TpuZmqWorker.run."""
+        import collections
+        import os
+
+        from dvf_tpu.transport.zmq_ingress import parse_frame_reply, result_msg
+
+        pid = str(os.getpid()).encode()
+        credits = 0
+        served = 0
+        budget = self.frontend.config.queue_size
+        # Deliveries popped from the session but not yet on the wire: a
+        # send timeout (stalled PULL peer) must re-try them next
+        # iteration, not discard frames that survived every other
+        # drop-bound in the system.
+        out_pending: "collections.deque" = collections.deque()
+        while not self._stop.is_set():
+            in_send = False  # containment scope: True only while the
+            #   head out_pending delivery is being encoded/sent
+            try:
+                while credits < budget:
+                    try:
+                        self.dealer.send(self._ready, flags=self._zmq.NOBLOCK)
+                    except self._zmq.Again:
+                        break
+                    credits += 1
+                if self.dealer.poll(self.poll_ms):
+                    parts = self.dealer.recv_multipart()
+                    credits = max(0, credits - 1)
+                    parsed = parse_frame_reply(parts)
+                    if parsed is None:
+                        self.errors += 1
+                    else:
+                        remote_idx, payload = parsed
+                        self.frontend.submit(
+                            self.session_id, self._decode(payload),
+                            tag=(remote_idx, time.time()))
+                else:
+                    credits = max(0, credits - 1)  # credit decay, see
+                    #   transport.zmq_ingress._run_loop
+                out_pending.extend(self.frontend.poll(self.session_id))
+                while out_pending:
+                    d = out_pending[0]
+                    in_send = True  # head delivery is now the one at risk
+                    remote_idx, t0 = d.tag
+                    payload = (self.codec.encode_batch([d.frame])[0]
+                               if self.use_jpeg else d.frame.tobytes())
+                    try:
+                        self.push.send_multipart(result_msg(
+                            remote_idx, pid, t0, time.time(), payload))
+                    except self._zmq.Again:
+                        break  # peer stalled: keep the tail, retry later
+                    out_pending.popleft()
+                    served += 1
+                    in_send = False
+                if max_frames is not None and served >= max_frames:
+                    break
+            except Exception as e:  # noqa: BLE001 — per-iteration containment
+                self.errors += 1
+                if in_send and out_pending:
+                    # The head delivery's OWN encode/send raised (never
+                    # zmq.Again — that breaks out above): drop that one
+                    # frame so containment cannot spin on it forever.
+                    # Errors from the ingest half of the iteration leave
+                    # out_pending untouched — a queued good frame must
+                    # not pay for a corrupt incoming payload.
+                    out_pending.popleft()
+                print(f"[ZmqStreamBridge] error (continuing): {e!r}",
+                      file=sys.stderr)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.frontend.close(self.session_id, drain=False)
+        except KeyError:
+            pass
+        self.codec.close()
+        self.dealer.close(0)
+        self.push.close(0)
+        self.ctx.term()

@@ -25,6 +25,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dvf_tpu.utils.compat import shard_map
+
 from dvf_tpu.models.espcn import (
     EspcnConfig,
     apply_espcn,
@@ -200,7 +202,7 @@ def make_train_step(
             metrics,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(specs, P(dp_axes)),

@@ -35,8 +35,17 @@ def load_native(
     (right for millisecond ops like JPEG codec work that a thread pool
     should truly parallelize).
     """
+    # -lrt: glibc < 2.34 keeps shm_open/shm_unlink in librt; on newer
+    # glibc the flag is accepted and harmless, so link it unconditionally
+    # rather than probing the libc version.
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+           "-o", lib, *extra_flags, "-lrt"]
+    # Hash the build recipe along with the source: a flag change (like
+    # adding -lrt) must invalidate cached .so files on exactly the
+    # machines whose old build it fixes, not wait for a source edit.
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()
+        digest = hashlib.sha256(
+            f.read() + b"\0" + "\0".join(cmd).encode()).hexdigest()
     sidecar = lib + ".srchash"
     with _BUILD_LOCK:
         stale = not (os.path.exists(lib) and os.path.exists(sidecar))
@@ -44,8 +53,6 @@ def load_native(
             with open(sidecar) as f:
                 stale = f.read().strip() != digest
         if stale:
-            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
-                   "-o", lib, *extra_flags]
             subprocess.run(cmd, check=True, capture_output=True, text=True)
             with open(sidecar, "w") as f:
                 f.write(digest)

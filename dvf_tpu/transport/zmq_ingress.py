@@ -33,6 +33,33 @@ from dvf_tpu.api.filter import Filter
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.transport.codec import JpegGeometryError, make_codec
 
+# ---------------------------------------------------------------------------
+# Wire framing, shared with the multi-stream serving frontend
+# (serve.server.ZmqStreamBridge): the worker request token, the app's
+# frame reply, and the result message — one place owns the byte layout.
+
+READY = b"READY"  # work-request token (worker.py:39)
+
+
+def parse_frame_reply(parts: list) -> Optional[tuple]:
+    """App → worker frame reply ``[frame_index_ascii, frame_bytes]``
+    (distributor.py:236-238) → ``(index, payload)``; None if malformed
+    (wrong part count, non-integer index)."""
+    if len(parts) != 2:
+        return None
+    try:
+        return int(parts[0].decode()), parts[1]
+    except ValueError:
+        return None
+
+
+def result_msg(index: int, pid: bytes, t0: float, t1: float,
+               payload: bytes) -> list:
+    """Worker → app result ``[frame_index, pid, start_time, end_time,
+    payload]``, metadata stringified (worker.py:63-67)."""
+    return [str(index).encode(), pid, str(t0).encode(), str(t1).encode(),
+            payload]
+
 
 class TpuZmqWorker:
     """TPU-backed worker endpoint for the reference's socket pair.
@@ -181,11 +208,7 @@ class TpuZmqWorker:
         t1 = time.time()
         payloads = self._encode(out[:valid])
         for idx, payload in zip(indices, payloads):
-            self.push.send_multipart([
-                str(idx).encode(), pid,
-                str(t0).encode(), str(t1).encode(),
-                payload,
-            ])
+            self.push.send_multipart(result_msg(idx, pid, t0, t1, payload))
         self.frames_processed += valid
         self.batches += 1
 
@@ -218,7 +241,7 @@ class TpuZmqWorker:
                 # we just retry next iteration.
                 while credits < self.batch_size:
                     try:
-                        self.dealer.send(b"READY", flags=self._zmq.NOBLOCK)
+                        self.dealer.send(READY, flags=self._zmq.NOBLOCK)
                     except self._zmq.Again:
                         break
                     credits += 1
@@ -230,20 +253,17 @@ class TpuZmqWorker:
                     # frames would leak that credit forever and starve the
                     # READY replenishment loop above.
                     credits = max(0, credits - 1)
-                    if len(parts) == 2:
-                        try:
-                            idx = int(parts[0].decode())
-                        except ValueError:
-                            self.errors += 1
-                        else:
-                            if self._ring is not None:
-                                self._ring.push(parts[1], idx, time.time())
-                            else:
-                                pending.append((idx, parts[1]))
-                            if first_recv_t is None:
-                                first_recv_t = time.perf_counter()
-                    else:
+                    parsed = parse_frame_reply(parts)
+                    if parsed is None:
                         self.errors += 1
+                    else:
+                        idx, payload = parsed
+                        if self._ring is not None:
+                            self._ring.push(payload, idx, time.time())
+                        else:
+                            pending.append((idx, payload))
+                        if first_recv_t is None:
+                            first_recv_t = time.perf_counter()
                 else:
                     # Credits DECAY on every poll timeout. The reference
                     # distributor consumes one READY per ~poll iteration

@@ -1,0 +1,38 @@
+"""jax version compatibility shims.
+
+The framework targets the modern top-level ``jax.shard_map`` API
+(``check_vma=...``); older toolchains (jax 0.4.x, the pinned container
+image) only ship ``jax.experimental.shard_map.shard_map`` with the
+pre-rename ``check_rep=...`` keyword. One adapter owns the difference so
+every call site can use the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name):
+    """Static mesh-axis size inside a manual (shard_map) region:
+    ``jax.lax.axis_size`` where it exists, else the pre-API spelling
+    (``jax.core.axis_frame``, which returns the size on 0.4.x)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    import jax.core as core
+
+    frame = core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def shard_map(f, **kw):
+    """``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+    check_vma=...)`` on any supported jax version."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in kw:
+        # Renamed (replication → varying-manual-axes) between versions;
+        # same role: disable the static replication checker.
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map(f, **kw)

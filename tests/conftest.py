@@ -23,7 +23,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # Older jax without the config option: the XLA_FLAGS
+        # force_host_platform_device_count above already applies.
+        pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

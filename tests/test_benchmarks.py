@@ -471,6 +471,9 @@ def test_latency_backoff_invariants_property(monkeypatch):
     monotonically, frame counts never increase (floored at
     min(16, original)), the returned numbers are the LAST attempt's, and
     the congested flag matches that attempt's verdict."""
+    import pytest
+
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings
     from hypothesis import strategies as st
 

@@ -18,6 +18,9 @@ reference):
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from dvf_tpu.sched.reorder import ReorderBuffer

@@ -1,0 +1,470 @@
+"""Multi-stream serving frontend: N tenant sessions, one shared engine.
+
+The acceptance surface of the serve subsystem on CPU: concurrent
+synthetic sessions at different frame rates multiplexed through one
+shared Engine, with per-session in-order delivery, zero cross-session
+frame leakage, SLO-based shedding under oversubscription, admission
+control at the session cap, and clean per-session teardown while other
+streams keep flowing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dvf_tpu.ops import get_filter
+from dvf_tpu.serve import (
+    AdmissionError,
+    ServeConfig,
+    ServeFrontend,
+    SessionClosedError,
+)
+
+H, W = 16, 24
+
+
+def tagged_frame(session_no: int, frame_no: int) -> np.ndarray:
+    """A frame whose content encodes (session, index): row 0 carries the
+    session number, row 1 the frame number — invert maps v → 255 - v, so
+    any cross-session or cross-index mixup is detectable per pixel."""
+    f = np.full((H, W, 3), 7, np.uint8)
+    f[0] = session_no
+    f[1] = frame_no % 251
+    return f
+
+
+def drain(frontend, sids, deliveries, deadline_s=30.0, until_closed=False):
+    """Poll every session until all streams are retired (or quiescent)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        moved = 0
+        for sid in sids:
+            got = frontend.poll(sid)
+            deliveries.setdefault(sid, []).extend(got)
+            moved += len(got)
+        stats = frontend.stats()
+        if until_closed:
+            if stats["open_sessions"] == 0:
+                break
+        else:
+            sess = stats["sessions"]
+            done = all(
+                sess[sid]["delivered"] + sess[sid]["shed"]
+                + sess[sid]["failed"] + sess[sid]["dropped_at_ingress"]
+                >= sess[sid]["submitted"]
+                and sess[sid]["inflight"] == 0
+                for sid in sids)
+            if done and moved == 0:
+                break
+        time.sleep(0.005)
+    # Final sweep: anything that landed between the last poll and the
+    # quiescence snapshot.
+    for sid in sids:
+        deliveries.setdefault(sid, []).extend(frontend.poll(sid))
+
+
+class TestMultiSessionCorrectness:
+    def test_four_sessions_ordered_no_leakage(self):
+        """≥4 concurrent streams at different rates through one engine:
+        every session sees exactly its own frames, in order, exactly
+        once, with correct numerics."""
+        n_sessions, n_frames = 4, 24
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=4, queue_size=1000, slo_ms=60_000.0),
+        )
+        deliveries: dict = {}
+        with fe:
+            sids = [fe.open_stream() for _ in range(n_sessions)]
+
+            def drive(k: int) -> None:
+                period = 0.001 * (k + 1)  # different per-stream cadence
+                for j in range(n_frames):
+                    fe.submit(sids[k], tagged_frame(k, j))
+                    time.sleep(period)
+
+            threads = [threading.Thread(target=drive, args=(k,))
+                       for k in range(n_sessions)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            drain(fe, sids, deliveries)
+            stats = fe.stats()
+
+        for k, sid in enumerate(sids):
+            got = deliveries[sid]
+            # Exactly once, in order (huge queues + huge SLO: no drops).
+            assert [d.index for d in got] == list(range(n_frames)), (
+                f"session {k}: indices {[d.index for d in got]}")
+            for d in got:
+                expected = 255 - tagged_frame(k, d.index)
+                np.testing.assert_array_equal(
+                    d.frame, expected,
+                    err_msg=f"session {k} frame {d.index}: wrong content "
+                            f"(cross-session leakage?)")
+        assert stats["shed_total"] == 0
+        # One shared engine compiled once, batches mixed across sessions.
+        assert fe.engine.stats.compile_count == 1
+        assert stats["engine_batches"] >= n_sessions * n_frames / 4 / 2
+
+    def test_per_session_index_spaces_independent(self):
+        """Both sessions' first frame is index 0 — private index spaces."""
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        deliveries: dict = {}
+        with fe:
+            a, b = fe.open_stream(), fe.open_stream()
+            assert fe.submit(a, tagged_frame(0, 0)) == 0
+            assert fe.submit(b, tagged_frame(1, 0)) == 0
+            assert fe.submit(b, tagged_frame(1, 1)) == 1
+            drain(fe, [a, b], deliveries)
+        assert [d.index for d in deliveries[a]] == [0]
+        assert [d.index for d in deliveries[b]] == [0, 1]
+
+
+class TestSloShedding:
+    def test_sheds_under_oversubscription(self):
+        """A throttled engine + tight SLOs: frames that blow their budget
+        before reaching a device slot are shed, not processed — and the
+        frontend keeps delivering fresh frames throughout."""
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=2, max_inflight=1, queue_size=500,
+                        slo_ms=60.0),
+        )
+        orig_submit = fe.engine.submit
+
+        def slow_submit(batch):
+            time.sleep(0.03)  # ~15 fps device vs ~hundreds offered
+            return orig_submit(batch)
+
+        fe.engine.submit = slow_submit
+        deliveries: dict = {}
+        with fe:
+            sids = [fe.open_stream() for _ in range(4)]
+            for j in range(40):
+                for k, sid in enumerate(sids):
+                    fe.submit(sid, tagged_frame(k, j))
+                time.sleep(0.002)
+            drain(fe, sids, deliveries, deadline_s=20.0)
+            stats = fe.stats()
+
+        assert stats["shed_total"] > 0, "oversubscription never shed"
+        total_delivered = sum(len(v) for v in deliveries.values())
+        assert total_delivered > 0, "shedding starved delivery entirely"
+        for sid in sids:
+            s = stats["sessions"][sid]
+            assert (s["delivered"] + s["shed"] + s["failed"]
+                    + s["dropped_at_ingress"] == s["submitted"]), s
+            # Order survives shedding (gaps allowed, regressions not).
+            idxs = [d.index for d in deliveries[sid]]
+            assert idxs == sorted(idxs)
+
+    def test_no_shedding_when_undersubscribed(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=4, queue_size=100,
+                                       slo_ms=60_000.0))
+        deliveries: dict = {}
+        with fe:
+            sid = fe.open_stream()
+            for j in range(12):
+                fe.submit(sid, tagged_frame(0, j))
+            drain(fe, [sid], deliveries)
+            assert fe.stats()["shed_total"] == 0
+        assert len(deliveries[sid]) == 12
+
+
+class TestAdmissionControl:
+    def test_session_cap_rejects(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(max_sessions=2))
+        a = fe.open_stream()
+        fe.open_stream()
+        with pytest.raises(AdmissionError):
+            fe.open_stream()
+        assert fe.stats()["admission_rejections"] == 1
+        # Closing one readmits (the cap counts OPEN sessions).
+        fe.close(a, drain=False)
+        fe._finalize_drained()
+        fe.open_stream()
+
+    def test_duplicate_session_id_rejected(self):
+        from dvf_tpu.serve import ServeError
+
+        fe = ServeFrontend(get_filter("invert"))
+        fe.open_stream(session_id="cam0")
+        with pytest.raises(ServeError, match="already exists"):
+            fe.open_stream(session_id="cam0")
+
+    def test_stateful_filter_rejected(self):
+        """Temporal state would thread across tenants' batch rows."""
+        filt = get_filter("flow_warp", levels=1, win_size=7, n_iters=1,
+                          flow_scale=1)
+        with pytest.raises(ValueError, match="stateful"):
+            ServeFrontend(filt)
+
+    def test_geometry_mismatch_rejected(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2))
+        with fe:
+            sid = fe.open_stream()
+            fe.submit(sid, tagged_frame(0, 0))
+            with pytest.raises(ValueError, match="pinned signature"):
+                fe.submit(sid, np.zeros((H + 4, W, 3), np.uint8))
+
+
+class TestSessionTeardown:
+    def test_close_one_session_others_keep_flowing(self):
+        n_frames = 16
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=4, queue_size=1000, slo_ms=60_000.0),
+        )
+        deliveries: dict = {}
+        with fe:
+            sids = [fe.open_stream() for _ in range(3)]
+            # First half everywhere, then close stream 0 mid-flight.
+            for j in range(n_frames // 2):
+                for k, sid in enumerate(sids):
+                    fe.submit(sid, tagged_frame(k, j))
+            fe.close(sids[0], drain=True)
+            with pytest.raises(SessionClosedError):
+                fe.submit(sids[0], tagged_frame(0, 99))
+            for j in range(n_frames // 2, n_frames):
+                for k, sid in enumerate(sids[1:], start=1):
+                    fe.submit(sid, tagged_frame(k, j))
+            drain(fe, sids, deliveries)
+            stats = fe.stats()
+
+        # Graceful close: everything queued before close was delivered.
+        assert [d.index for d in deliveries[sids[0]]] == list(range(n_frames // 2))
+        assert stats["sessions"][sids[0]]["state"] == "closed"
+        # Survivors were untouched: full ordered streams.
+        for k, sid in enumerate(sids[1:], start=1):
+            assert [d.index for d in deliveries[sid]] == list(range(n_frames))
+            for d in deliveries[sid]:
+                np.testing.assert_array_equal(
+                    d.frame, 255 - tagged_frame(k, d.index))
+
+    def test_retired_retention_bound_and_release(self):
+        """Closed sessions stay poll-able only up to max_retired (oldest
+        evicted), and release() forgets one explicitly."""
+        from dvf_tpu.serve import ServeError
+
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(max_sessions=100, max_retired=2))
+        ids = []
+        for _ in range(4):
+            sid = fe.open_stream()
+            fe.close(sid, drain=False)
+            fe._finalize_drained()
+            ids.append(sid)
+        assert fe.stats()["retired_sessions"] == 2
+        with pytest.raises(KeyError):
+            fe.poll(ids[0])         # oldest: evicted by the bound
+        assert fe.poll(ids[-1]) == []   # newest: still poll-able
+        fe.release(ids[-1])
+        with pytest.raises(KeyError):
+            fe.poll(ids[-1])
+        open_sid = fe.open_stream()
+        with pytest.raises(ServeError, match="still open"):
+            fe.release(open_sid)
+
+    def test_stop_finalizes_all_sessions(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        fe.start()
+        sid = fe.open_stream()
+        for j in range(6):
+            fe.submit(sid, tagged_frame(0, j))
+        # Let the engine finish what it can, then stop: the tail in the
+        # reorder buffer must be flushed out, not dropped.
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if fe.stats()["sessions"][sid]["inflight"] == 0 and \
+                    len(fe._session(sid).ingress) == 0 and \
+                    not fe._session(sid).pending:
+                break
+            time.sleep(0.005)
+        fe.stop()
+        got = fe.poll(sid)
+        assert [d.index for d in got] == list(range(6))
+        assert fe.stats()["sessions"][sid]["state"] == "closed"
+
+
+class TestTenantIsolation:
+    def test_raising_sink_contained_per_tenant(self):
+        """One tenant's dying sink must not kill the shared frontend:
+        its frames are dropped and counted, the other stream flows."""
+        class ExplodingSink:
+            def emit(self, index, frame, ts):
+                raise RuntimeError("boom")
+
+            def close(self):
+                pass
+
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, queue_size=100,
+                                       slo_ms=60_000.0))
+        deliveries: dict = {}
+        with fe:
+            bad = fe.open_stream(sink=ExplodingSink())
+            good = fe.open_stream()
+            for j in range(8):
+                fe.submit(bad, tagged_frame(0, j))
+                fe.submit(good, tagged_frame(1, j))
+            drain(fe, [good], deliveries)
+            stats = fe.stats()
+        assert [d.index for d in deliveries[good]] == list(range(8))
+        assert stats["sessions"][bad]["sink_errors"] == 8
+        assert stats["errors"] == 0  # contained at the session, not fatal
+
+    def test_non_monotonic_ts_keeps_order_exact_once(self):
+        """Client capture timestamps can jitter backwards; deadlines are
+        clamped monotonic so EDF never duplicates or drops a frame."""
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, queue_size=100,
+                                       slo_ms=60_000.0))
+        deliveries: dict = {}
+        with fe:
+            sid = fe.open_stream()
+            base = time.time()
+            jitter = [0.0, -2.5, 1.0, -4.0, 0.5, -1.0]
+            for j, dt in enumerate(jitter):
+                fe.submit(sid, tagged_frame(0, j), ts=base + dt)
+            drain(fe, [sid], deliveries)
+        assert [d.index for d in deliveries[sid]] == list(range(len(jitter)))
+
+
+class TestObservability:
+    def test_per_session_and_aggregate_latency_export(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, queue_size=100,
+                                       slo_ms=60_000.0))
+        deliveries: dict = {}
+        with fe:
+            sids = [fe.open_stream() for _ in range(2)]
+            for j in range(8):
+                for k, sid in enumerate(sids):
+                    fe.submit(sid, tagged_frame(k, j))
+            drain(fe, sids, deliveries)
+            stats = fe.stats()
+        for sid in sids:
+            s = stats["sessions"][sid]
+            assert s["count"] == 8
+            assert s["p50_ms"] > 0 and s["p99_ms"] >= s["p50_ms"]
+        agg = stats["aggregate"]
+        assert agg["count"] == 16
+        assert agg["p50_ms"] > 0 and agg["p99_ms"] >= agg["p50_ms"]
+        # The merged percentiles select actual samples (no interpolation),
+        # so they must land inside the union of per-session extremes.
+        lo = min(min(stats["sessions"][s]["p50_ms"] for s in sids),
+                 min(min(fe._session(s).latency.samples_ms) for s in sids))
+        hi = max(max(fe._session(s).latency.samples_ms) for s in sids)
+        assert lo <= agg["p50_ms"] <= agg["p99_ms"] <= hi + 1e-9
+
+    def test_merged_latency_stats_weighting(self):
+        from dvf_tpu.obs.metrics import LatencyStats
+
+        a, b = LatencyStats(), LatencyStats()
+        for v in (1.0, 2.0, 3.0):
+            a.record(v / 1e3)
+        for v in (100.0,):
+            b.record(v / 1e3)
+        m = LatencyStats.merged([a, b])
+        assert m["count"] == 4
+        assert 1.0 <= m["p50_ms"] <= 3.0
+        assert m["p99_ms"] == 100.0
+        assert LatencyStats.merged([])["count"] == 0
+
+
+def test_zmq_bridge_reference_framing():
+    """A reference-style app (ROUTER fan-out + PULL collect, the exact
+    distributor.py framing) drives one frontend session through the
+    ZmqStreamBridge: READY-credit requests in, results echoing the APP's
+    frame indices out, while the session rides the shared batcher."""
+    zmq = pytest.importorskip("zmq")
+
+    from benchtools import free_port
+    from dvf_tpu.serve import ZmqStreamBridge
+
+    p_dist, p_coll = free_port(), free_port()
+    ctx = zmq.Context()
+    router = ctx.socket(zmq.ROUTER)
+    router.bind(f"tcp://127.0.0.1:{p_dist}")
+    pull = ctx.socket(zmq.PULL)
+    pull.bind(f"tcp://127.0.0.1:{p_coll}")
+
+    fe = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=2, queue_size=100, slo_ms=60_000.0),
+    )
+    n, size = 6, 16  # the reference's raw wire is square (inverter.py:34)
+    rng = np.random.default_rng(3)
+    frames = {100 + j: rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+              for j in range(n)}
+    got = {}
+    try:
+        with fe:
+            bridge = ZmqStreamBridge(
+                fe, host="127.0.0.1", distribute_port=p_dist,
+                collect_port=p_coll, use_jpeg=False, raw_size=size)
+            bt = threading.Thread(target=bridge.run,
+                                  kwargs={"max_frames": n}, daemon=True)
+            bt.start()
+            pending = sorted(frames)  # app-side index space starts at 100
+            deadline = time.time() + 20.0
+            while len(got) < n and time.time() < deadline:
+                # App side: answer each READY with one [idx, bytes] frame.
+                if router.poll(10):
+                    ident, payload = router.recv_multipart()
+                    assert payload == b"READY"
+                    if pending:
+                        idx = pending.pop(0)
+                        router.send_multipart(
+                            [ident, str(idx).encode(), frames[idx].tobytes()])
+                while pull.poll(0):
+                    idx_b, _pid, _t0, _t1, result = pull.recv_multipart()
+                    got[int(idx_b.decode())] = np.frombuffer(
+                        result, np.uint8).reshape(size, size, 3)
+            bridge.stop()
+            bt.join(timeout=5.0)
+            bridge.close()
+    finally:
+        router.close(0)
+        pull.close(0)
+        ctx.term()
+
+    assert sorted(got) == sorted(frames), "bridge lost or renumbered frames"
+    for idx, frame in got.items():
+        np.testing.assert_array_equal(frame, 255 - frames[idx])
+
+
+def test_cli_serve_multi_demo(capsys):
+    """`dvf serve --sessions 4` runs the local multi-stream demo end to
+    end: 4 synthetic streams at different rates through one shared
+    engine, one JSON line out."""
+    import json
+
+    from dvf_tpu.cli import main
+
+    rc = main([
+        "serve", "--sessions", "4", "--filter", "invert",
+        "--height", str(H), "--width", str(W), "--frames", "12",
+        "--rate", "120", "--batch", "4", "--queue-size", "1000",
+        "--slo-ms", "60000", "--quiet", "--platform", "cpu",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out["sessions"]) == 4
+    assert len(set(out["rates"].values())) == 4  # genuinely different rates
+    for sid, s in out["sessions"].items():
+        assert s["submitted"] == 12
+        assert s["delivered"] == 12          # big queues + big SLO: lossless
+        assert out["polled"][sid] == 12
+    assert out["aggregate"]["count"] == 48
+    assert out["admission_rejections"] == 0
+    assert out["errors"] == 0
