@@ -13,7 +13,13 @@ JSON line on stdout:
 
     {"backend": ..., "n_devices": N, "device_fps": ..., "ms_per_frame": ...,
      "h2d_mbps": ..., "d2h_mbps": ..., "link_roofline_fps": ...,
-     "e2e_fps": ..., "roofline_frac": ..., "p50_ms": ..., "p99_ms": ...}
+     "e2e_fps": ..., "roofline_frac": ..., "p50_ms": ..., "p99_ms": ...,
+     "ingest": "streamed"|"monolithic", "overlap_efficiency": ...}
+
+(``d2h_mbps`` times MATERIALIZED bytes — copy into a host destination
+after block_until_ready — see benchmarks.bench_transfer; ``ingest`` /
+``overlap_efficiency`` report the streamed shard-level transfer path and
+how much H2D it hid under decode/compute, obs.metrics.IngestStats.)
 
 Measurement design is in dvf_tpu/benchmarks.py. The reference's own
 measurement mechanisms are the FPS prints in webcam_app.py:88-95,152-163
@@ -97,6 +103,14 @@ def main(argv=None) -> int:
                     help="pipeline collect mode for the e2e phases; inline "
                          "measured ~12%% faster on CPU (151 vs 135 fps at "
                          "1080p) — one fewer thread on the GIL")
+    ap.add_argument("--ingest", choices=("streamed", "monolithic"),
+                    default="streamed",
+                    help="e2e batch staging path: streamed overlaps "
+                         "per-shard H2D with decode and the previous "
+                         "batch's compute; monolithic is the classic "
+                         "decode-all → one blocking device_put baseline")
+    ap.add_argument("--ingest-depth", type=int, default=4,
+                    help="streamed ingest: max shard transfers in flight")
     ap.add_argument("--mode", choices=("probe", "headline", "device", "e2e"),
                     default="headline")
     ap.add_argument("--no-decomp", action="store_true",
@@ -224,7 +238,7 @@ def main(argv=None) -> int:
                 filt, sorted({1, 2, args.lat_batch}), args.height,
                 args.width, reps=25 if backend == "tpu" else 5)
         result["stage_decomp_ms"] = decomp
-        lat_key = str(args.lat_batch)
+        lat_key = f"batch_{args.lat_batch}"
         if lat_key in decomp:
             result["compute_p50_ms"] = decomp[lat_key]["compute_ms"]
         _log(f"decomposition done: {json.dumps(decomp)}")
@@ -255,17 +269,27 @@ def main(argv=None) -> int:
         with _heartbeat_during("e2e throughput"):
             r = bench_e2e_streaming(filt, n_frames, args.e2e_batch,
                                     args.height, args.width,
-                                    collect_mode=args.collect_mode)
+                                    collect_mode=args.collect_mode,
+                                    ingest=args.ingest,
+                                    ingest_depth=args.ingest_depth)
         result.update(
             e2e_fps=round(r["fps"], 1),
             e2e_frames=r["frames"],
             e2e_wall_s=round(r["wall_s"], 2),
             e2e_batch=args.e2e_batch,
             collect_mode=args.collect_mode,
+            # The transfer path the run actually took (streamed degrades
+            # to monolithic on replicated shard layouts) and the fraction
+            # of per-batch H2D cost it hid under decode/compute.
+            ingest=r["ingest"],
+            ingest_depth=r["ingest_depth"],
+            overlap_efficiency=r["overlap_efficiency"],
             roofline_frac=round(r["fps"] / roof, 3) if roof else None,
         )
         _log(f"e2e done: {result['e2e_fps']} fps "
-             f"({result['roofline_frac']} of link roofline)")
+             f"({result['roofline_frac']} of link roofline, "
+             f"ingest={result['ingest']} "
+             f"overlap_eff={result['overlap_efficiency']})")
 
         # Rate-controlled latency: 0.8× measured throughput, queue ≈ batch —
         # p50 is transit, not queue depth (VERDICT r2 item 3).
@@ -276,7 +300,9 @@ def main(argv=None) -> int:
         with _heartbeat_during("e2e latency"):
             rl = bench_e2e_latency(filt, n_lat, args.lat_batch,
                                    args.height, args.width, target,
-                                   collect_mode=args.collect_mode)
+                                   collect_mode=args.collect_mode,
+                                   ingest=args.ingest,
+                                   ingest_depth=args.ingest_depth)
         result.update(
             p50_ms=round(rl["p50_ms"], 2),
             p99_ms=round(rl["p99_ms"], 2),

@@ -185,6 +185,7 @@ def bench_stage_decomposition(
         frames = [rng.integers(0, 255, size=(height, width, 3), dtype=np.uint8)
                   for _ in range(b)]
         staging = np.empty(shape, np.uint8)
+        d2h_dst = None  # sized from the result (geometry-changing filters)
         legs = {"staging_ms": [], "h2d_ms": [], "compute_ms": [], "d2h_ms": []}
         for rep in range(reps):
             t0 = time.perf_counter()
@@ -201,13 +202,23 @@ def bench_stage_decomposition(
             legs["h2d_ms"].append((t2 - t1) * 1e3)
             legs["compute_ms"].append((t3 - t2) * 1e3)
             if rep < transfer_reps:
-                host = np.asarray(y)
+                # Materialized bytes, not a possibly-zero-copy view —
+                # same rationale as bench_transfer's D2H timer.
+                if d2h_dst is None:
+                    d2h_dst = np.empty(y.shape, y.dtype)
+                    t3 = time.perf_counter()  # exclude the one-time alloc
+                np.copyto(d2h_dst, np.asarray(y))
                 legs["d2h_ms"].append((time.perf_counter() - t3) * 1e3)
-                del host
         p50 = {k: round(float(np.percentile(v, 50)), 4) for k, v in legs.items()}
         p50["total_ms"] = round(sum(p50.values()), 4)
         p50["per_frame_compute_ms"] = round(p50["compute_ms"] / b, 4)
-        out[str(b)] = p50
+        # Self-describing keys (BENCH rounds ≤ 5 published opaque "1"/
+        # "2"/"4"), with the measured transfer mode recorded in-band:
+        # these legs time the serialized whole-batch path by construction
+        # (that is what the latency model decomposes); the streamed
+        # per-shard path's hiding shows up in overlap_efficiency instead.
+        p50["transfer_mode"] = "whole_batch"
+        out[f"batch_{b}"] = p50
     return out
 
 
@@ -217,15 +228,27 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
     Returns MB/s both directions plus the fixed per-transfer cost
     (estimated from a tiny D2H), so callers can compute the link roofline
     for any frame geometry: fps_ceiling = 1 / (bytes·(1/h2d + 1/d2h) + c).
+
+    D2H measures MATERIALIZED bytes: the device result is copied into a
+    preallocated host destination after ``block_until_ready``, because
+    ``np.asarray`` alone can be a zero-copy view of the backend's buffer
+    (CPU backend; any runtime that caches the host value) — which is how
+    BENCH_r05 published a 1,929,603 MB/s "link": the timer clocked a view
+    construction, not a transfer, and the fixed-cost correction then
+    shaved 90% off the near-zero denominator. The destination memcpy is
+    part of the timed cost by design — it is exactly what the pipeline's
+    collect path pays to hand frames to a sink.
     """
     import jax
     import numpy as np
 
     shape = (batch_size, height, width, 3)
     host = np.random.default_rng(0).integers(0, 255, size=shape, dtype=np.uint8)
+    dst = np.empty(shape, np.uint8)       # materialization target
     dev = jax.device_put(host)
     dev.block_until_ready()
     bump = jax.jit(lambda a: a + 1)
+    tiny_dst = np.empty((1, 8, width, 3), np.uint8)
 
     h2d, d2h = [], []
     for _ in range(reps):
@@ -235,14 +258,14 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
         y = bump(dev)  # fresh result each rep — no cached host copy
         y.block_until_ready()
         t0 = time.perf_counter()
-        np.asarray(y)
+        np.copyto(dst, np.asarray(y))
         d2h.append(time.perf_counter() - t0)
     fixed = []
     for _ in range(reps):
         tiny = bump(jax.device_put(host[:1, :8]))
         tiny.block_until_ready()
         t0 = time.perf_counter()
-        np.asarray(tiny)
+        np.copyto(tiny_dst, np.asarray(tiny))
         fixed.append(time.perf_counter() - t0)
     # min over reps, and never let the correction exceed 90% of the bulk
     # time: one hiccup on a flaky link must not produce an absurd d2h_mbps
@@ -255,12 +278,14 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
         "d2h_mbps": mb / (min(d2h) - fixed_s),
         "d2h_fixed_ms": fixed_s * 1e3,
         "batch_mb": mb,
+        "d2h_measures": "materialized_copy",  # provenance of the number
     }
 
 
 def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
                   queue_size, collect_mode="thread", transport="python",
-                  wire="raw", mesh=None) -> dict:
+                  wire="raw", mesh=None, ingest="streamed",
+                  ingest_depth=4) -> dict:
     import numpy as np
 
     from dvf_tpu.io.sinks import NullSink
@@ -287,6 +312,8 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
             frame_delay=0,
             max_inflight=max_inflight,
             collect_mode=collect_mode,
+            ingest=ingest,
+            ingest_depth=ingest_depth,
         ),
         engine=engine,
         queue=queue,
@@ -301,6 +328,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
             queue.close()
     wall = time.perf_counter() - t0
     pct = sink.latency_percentiles()
+    ingest_stats = stats.get("ingest", {})
     return {
         "fps": sink.count / wall if wall > 0 else 0.0,
         # Steady-state delivery rate, first→last delivery (LatencyStats
@@ -313,6 +341,13 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
         "p50_ms": pct.get("p50", float("nan")),
         "p99_ms": pct.get("p99", float("nan")),
         "dropped": stats.get("dropped_at_ingest", 0),
+        # The transfer path actually taken ("streamed" may degrade to
+        # "monolithic" on replicated layouts) + how much of the per-batch
+        # H2D cost it hid under decode/compute (obs.metrics.IngestStats).
+        "ingest": ingest_stats.get("mode", ingest),
+        "ingest_depth": ingest_depth,
+        "overlap_efficiency": ingest_stats.get("overlap_efficiency"),
+        "ingest_stats": ingest_stats,
     }
 
 
@@ -329,6 +364,8 @@ def bench_e2e_streaming(
     transport: str = "python",
     wire: str = "raw",
     mesh=None,
+    ingest: str = "streamed",
+    ingest_depth: int = 4,
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
@@ -347,6 +384,7 @@ def bench_e2e_streaming(
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
         collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
+        ingest=ingest, ingest_depth=ingest_depth,
     )
 
 
@@ -401,6 +439,8 @@ def bench_e2e_latency(
     transport: str = "python",
     wire: str = "raw",
     mesh=None,
+    ingest: str = "streamed",
+    ingest_depth: int = 4,
     max_backoffs: int = 2,
     max_retry_stream_s: float = 400.0,
 ) -> dict:
@@ -440,7 +480,7 @@ def bench_e2e_latency(
             batch_size, height, width, max_inflight,
             queue_size=batch_size,
             collect_mode=collect_mode, transport=transport, wire=wire,
-            mesh=mesh,
+            mesh=mesh, ingest=ingest, ingest_depth=ingest_depth,
         )
         congested = stream_congested(r["delivery_fps"], target_fps,
                                      r["dropped"], r["frames"])

@@ -283,6 +283,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         slo_ms=args.slo_ms,
         frame_delay=args.frame_delay,
         resilient=not args.fail_fast,
+        ingest=args.ingest,
+        ingest_depth=args.ingest_depth,
     )
     frontend = ServeFrontend(filt, config, engine=engine)
 
@@ -400,6 +402,8 @@ def cmd_serve(args) -> int:
         telemetry_interval_s=0.0 if args.quiet else 5.0,
         device_trace_dir=args.device_trace,
         collect_mode=args.collect_mode,
+        ingest=args.ingest,
+        ingest_depth=args.ingest_depth,
     )
 
     queue = None
@@ -524,6 +528,8 @@ def cmd_worker(args) -> int:
         use_jpeg=not args.no_jpeg,
         raw_size=args.target_size,
         delay_s=args.delay,
+        ingest=args.ingest,
+        ingest_depth=args.ingest_depth,
     )
     print(
         f"TPU worker serving {filt.name} on "
@@ -616,7 +622,9 @@ def cmd_bench(args) -> int:
         r = bench_e2e_streaming(filt, args.frames, batch, h, w,
                                 collect_mode=args.collect_mode,
                                 transport=args.transport, wire=args.wire,
-                                mesh=_parse_mesh(args.mesh))
+                                mesh=_parse_mesh(args.mesh),
+                                ingest=args.ingest,
+                                ingest_depth=args.ingest_depth)
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -625,6 +633,11 @@ def cmd_bench(args) -> int:
             "collect_mode": args.collect_mode,
             "transport": args.transport,
             "wire": args.wire,
+            # Effective transfer path + hidden-H2D fraction (None when
+            # the backend exposes no overlap or monolithic ran).
+            "ingest": r["ingest"],
+            "ingest_depth": r["ingest_depth"],
+            "overlap_efficiency": r["overlap_efficiency"],
         }
         if args.lat_frames != 0 and r["fps"] > 0:
             # p50/p99 from a SEPARATE rate-controlled leg (source at 0.8×
@@ -644,7 +657,9 @@ def cmd_bench(args) -> int:
             rl = bench_e2e_latency(filt, lat_frames, batch, h, w, target,
                                    collect_mode=args.collect_mode,
                                    transport=args.transport, wire=args.wire,
-                                   mesh=_parse_mesh(args.mesh))
+                                   mesh=_parse_mesh(args.mesh),
+                                   ingest=args.ingest,
+                                   ingest_depth=args.ingest_depth)
             out.update(
                 p50_ms=round(rl["p50_ms"], 3),
                 p99_ms=round(rl["p99_ms"], 3),
@@ -963,6 +978,23 @@ def main(argv=None) -> int:
                       help="force the jax platform (e.g. cpu); equivalent "
                            "to DVF_FORCE_PLATFORM=NAME")
 
+    # Shared by every subcommand with a batch-ingest hot path (serve,
+    # worker, bench): the streamed shard-level assembler vs the classic
+    # monolithic staging, and its in-flight transfer window.
+    ing = argparse.ArgumentParser(add_help=False)
+    ing.add_argument("--ingest", choices=("streamed", "monolithic"),
+                     default="streamed",
+                     help="batch staging path: 'streamed' decodes into "
+                          "per-device-shard slabs and ships each shard "
+                          "the moment its rows fill (H2D overlaps decode "
+                          "and the previous batch's compute); "
+                          "'monolithic' is the classic decode-all → one "
+                          "blocking device_put escape hatch")
+    ing.add_argument("--ingest-depth", type=int, default=4,
+                     help="streamed ingest: max shard transfers in "
+                          "flight before staging blocks on the oldest "
+                          "(also the per-device sub-chunk granularity)")
+
     fp = sub.add_parser("filters", help="list registered filters")
     fp.add_argument("-v", "--verbose", action="store_true",
                     help="include each filter's one-line description")
@@ -972,7 +1004,7 @@ def main(argv=None) -> int:
     dp_.add_argument("--probe-timeout", type=float, default=60.0,
                      help="seconds before declaring the backend unreachable")
 
-    sp = sub.add_parser("serve", parents=[plat], help="run the pipeline")
+    sp = sub.add_parser("serve", parents=[plat, ing], help="run the pipeline")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
     sp.add_argument("--source", default="synthetic",
@@ -1060,7 +1092,8 @@ def main(argv=None) -> int:
                          "consumer to attach and drain before unlinking "
                          "the shm ring (serve cold-start can take ~10 s)")
 
-    wp = sub.add_parser("worker", parents=[plat], help="ZMQ worker for the reference app")
+    wp = sub.add_parser("worker", parents=[plat, ing],
+                        help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")
     wp.add_argument("--filter-config", default=None)
     wp.add_argument("--host", default="localhost")
@@ -1113,7 +1146,8 @@ def main(argv=None) -> int:
                      help="after training, report held-out PSNR vs the "
                           "nearest-neighbor baseline (unseen seed + geometry)")
 
-    bp = sub.add_parser("bench", parents=[plat], help="run a benchmark config")
+    bp = sub.add_parser("bench", parents=[plat, ing],
+                        help="run a benchmark config")
     bp.add_argument("--config", choices=sorted(BENCH_CONFIGS), default="invert_1080p")
     bp.add_argument("--iters", type=int, default=200)
     bp.add_argument("--frames", type=int, default=512, help="--e2e mode")

@@ -11,7 +11,17 @@ ui.perfetto.dev alongside `jax.profiler` device traces.
 
 Event names follow the frame lifecycle through this framework:
 frame_captured → batch_assembled → device_dispatch → batch_complete →
-frame_delivered.
+frame_delivered; the streamed ingest path (runtime/ingest.py) adds a
+transfer lane with per-shard spans:
+
+- ``ingest_h2d`` — one span per shard chunk's ``device_put`` issue
+  (args: the batch-row range and bytes shipped);
+- ``ingest_stage`` — the whole host-staging window of one batch (args:
+  the cumulative host-copy/decode time inside it);
+- ``ingest_overlap`` — first shard put → batch assembly complete: the
+  window in which transfers ran under decode of later shards and device
+  compute of the previous batch. Reading the lane against the device
+  lane in the merged export shows the stall the streaming removed.
 """
 
 from __future__ import annotations
@@ -21,6 +31,12 @@ import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+# Streamed-ingest span names (runtime/ingest.py emits these; one place
+# owns the strings so trace consumers can match on them).
+INGEST_H2D = "ingest_h2d"
+INGEST_STAGE = "ingest_stage"
+INGEST_OVERLAP = "ingest_overlap"
 
 
 class Tracer:

@@ -1,2 +1,3 @@
 from dvf_tpu.runtime.engine import Engine  # noqa: F401
+from dvf_tpu.runtime.ingest import ShardedBatchAssembler  # noqa: F401
 from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig  # noqa: F401

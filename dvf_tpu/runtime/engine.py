@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import sys
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -65,6 +66,11 @@ class Engine:
         self._state: Any = None
         self._sharding = None  # chosen per batch signature in compile()
         self._replicated = replicated(self.mesh)
+        self.h2d_block_ms: Optional[float] = None  # calibrated blocking
+        #   whole-batch device_put at the compiled signature (measured on
+        #   compile()'s warmup put) — the un-overlapped transfer cost the
+        #   streamed ingest path's overlap_efficiency is judged against
+        #   (obs.metrics.IngestStats)
 
     # ------------------------------------------------------------------
 
@@ -191,16 +197,43 @@ class Engine:
         self.stats.compile_count += 1
         # Warm the compile cache so the first real batch doesn't eat compile
         # time; the warmup consumes (donates) the state, so rebuild it —
-        # stateful filters must still see a pristine first batch.
-        dummy = jax.device_put(np.zeros(batch_shape, dtype=dtype), self._sharding)
+        # stateful filters must still see a pristine first batch. A second
+        # put at the same signature is the H2D calibration sample: one
+        # blocking whole-batch transfer, measured AFTER the first put has
+        # paid any backend/allocator warmup (timing the first put
+        # over-reports the steady-state cost by an order of magnitude on
+        # some backends, which would mislead the streamed-ingest
+        # cheap-transfer fallback).
+        zeros = np.zeros(batch_shape, dtype=dtype)
+        warm = jax.device_put(zeros, self._sharding)
+        jax.block_until_ready(warm)
+        del warm
+        t0 = time.perf_counter()
+        dummy = jax.device_put(zeros, self._sharding)
+        jax.block_until_ready(dummy)
+        self.h2d_block_ms = (time.perf_counter() - t0) * 1e3
         out, _ = self._step(dummy, self._state)
         out.block_until_ready()
         self._state = fresh_state()
 
     # ------------------------------------------------------------------
 
+    def ensure_compiled(self, batch_shape: Tuple[int, ...],
+                        dtype=np.uint8) -> None:
+        """Compile for a signature if not already (idempotent) — the
+        streamed-ingest assembler calls this before reading
+        ``input_sharding`` to lay out its per-shard staging slabs."""
+        self.compile(tuple(batch_shape), dtype)
+
+    @property
+    def input_sharding(self):
+        """The batch sharding the compiled step actually expects (set by
+        compile(); may differ from the naive batch_sharding when the
+        halo router replicated H). None before the first compile."""
+        return self._sharding
+
     def submit(self, batch: np.ndarray) -> jax.Array:
-        """Dispatch one batch; returns the (async) on-device result.
+        """Dispatch one host batch; returns the (async) on-device result.
 
         The filter state (if any) is threaded internally across calls —
         device-resident, never copied to host (SURVEY.md §7 hard part 4).
@@ -213,14 +246,26 @@ class Engine:
         self.stats.frames += batch.shape[0]
         return y
 
-    def run_device_resident(self, batch: jax.Array) -> jax.Array:
-        """Like submit, but input already on device (benchmark inner loop)."""
+    def submit_resident(self, batch: jax.Array) -> jax.Array:
+        """Serving entry for an already-device-resident batch: the
+        streamed ingest path (runtime/ingest.py) shipped the shards while
+        they decoded and assembled the mesh array itself, so the internal
+        ``device_put`` of :meth:`submit` is skipped — the transfer cost
+        it would serialize here was already hidden under decode and the
+        previous batch's compute. State threading, donation, and stats
+        are identical to :meth:`submit`.
+        """
         if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
             self.compile(batch.shape, np.dtype(batch.dtype))
         y, self._state = self._step(batch, self._state)
         self.stats.batches += 1
         self.stats.frames += batch.shape[0]
         return y
+
+    def run_device_resident(self, batch: jax.Array) -> jax.Array:
+        """Alias of :meth:`submit_resident` kept for the benchmark inner
+        loops, which predate the serving-path name."""
+        return self.submit_resident(batch)
 
     def cost_analysis(self) -> Optional[dict]:
         """XLA's own cost model for the compiled step: total FLOPs and HBM

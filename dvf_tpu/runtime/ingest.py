@@ -1,0 +1,435 @@
+"""Streamed shard-level batch ingest: overlap decode, H2D, and compute.
+
+The BENCH_r05 stage decomposition showed the hot path is ingest-bound,
+not compute-bound: the pipeline assembled the ENTIRE batch on the host
+(decode-all → stage-all) and then shipped it as one monolithic,
+serializing ``device_put`` before compute could start — host staging up
+to 3.5 ms/batch and H2D 3.7–7.0 ms against 0.6–1.2 ms of per-frame
+compute, with the link at 13% of its roofline. This module closes that
+gap with the classic decoupled access-execute / latency-hiding move
+(TVM, arXiv:1802.04799): frames decode directly into *per-device-shard*
+staging slabs, and each shard is ``device_put`` the moment its rows fill,
+so the H2D of shard *i* overlaps the decode of shard *i+1* and the device
+compute of batch *k−1*. The finished batch is assembled with
+``jax.make_array_from_single_device_arrays`` and handed to
+``Engine.submit_resident`` — the engine's internal ``device_put`` is
+skipped entirely.
+
+Timeline, monolithic vs streamed (one batch of 4 shards):
+
+    monolithic   decode ████████████ → H2D ████████ → compute ████
+    streamed     decode ███░███░███░███░
+                 H2D       ████ ████ ████ ████          (per shard,
+                 compute ░░░░ batch k−1 ░░░░░░░         overlapped)
+
+Shard granularity follows the engine's input sharding:
+
+- the batch axis is partitioned over devices (data DP) → one slab per
+  device batch-shard, sub-chunked up to ``depth`` pieces so transfers
+  start before a whole shard decodes (a single-device mesh streams the
+  same way: ``depth`` row-chunks concatenated on device — one cheap HBM
+  copy buys the host↔device overlap);
+- H additionally sharded (space axis) → per-device slabs carry that
+  device's H slice; a decoded frame scatters its H slices across slabs;
+- any *replicated* placement (batch smaller than the data axis, a model
+  axis, an explicitly replicated spec) falls back to the monolithic
+  whole-batch ``device_put``: XLA broadcasts a replicated transfer
+  device-side, which per-device host puts cannot beat. The effective
+  mode is recorded in the ingest stats either way.
+
+Slot discipline is the pipeline's staging-pool contract unchanged: the
+caller provides a monotonically increasing slot id per batch and
+guarantees (via its in-flight bound) that a slot is only revisited after
+its batch has been collected — by which point the device step has
+consumed the slabs, so rewriting them is safe even if the backend
+aliased host memory.
+
+``depth`` is the dispatch-depth knob (``--ingest-depth``): how many
+shard transfers may be in flight before the assembler blocks on the
+oldest — bounding both the host memory pinned by outstanding transfers
+and the burstiness of the H2D queue.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dvf_tpu.obs.metrics import IngestStats
+from dvf_tpu.obs.trace import INGEST_H2D, INGEST_OVERLAP, INGEST_STAGE
+
+INGEST_MODES = ("streamed", "monolithic")
+
+# Below this calibrated blocking-put cost (Engine.h2d_block_ms, measured
+# at compile), the fixed per-batch streaming overhead — shard-put
+# dispatches, the on-device chunk concat, mesh-array assembly — exceeds
+# anything overlap can hide, so the assembler stays monolithic (measured
+# on the CPU backend: 128×128×8 streams at 480 fps vs 2507 monolithic
+# because the whole blocking put costs ~0.1 ms). A 1080p batch on any
+# real link clears this easily (3–8 ms on PCIe, hundreds on the bench
+# tunnel). Tests that exercise the streaming machinery at tiny sizes
+# monkeypatch this to 0.
+MIN_STREAM_H2D_MS = 2.0
+
+
+def _span(slc: slice, dim: int) -> Tuple[int, int]:
+    start, stop, step = slc.indices(dim)
+    if step != 1:
+        raise ValueError(f"non-unit stride in shard index: {slc}")
+    return start, stop
+
+
+class _Chunk:
+    """One contiguous row range of the batch and its per-tail slabs.
+
+    ``tails`` maps a hashable key (the shard's H/W/C index) to the numpy
+    slice tuple selecting that portion of a frame; ``targets`` lists the
+    (device, tail_key) puts this chunk owes. Slabs live per *slot* (the
+    caller's staging-pool index) so an in-flight chunk is never rewritten.
+    """
+
+    __slots__ = ("start", "stop", "tails", "targets", "slabs", "frame_like")
+
+    def __init__(self, start: int, stop: int):
+        self.start = start
+        self.stop = stop
+        self.tails: Dict[tuple, tuple] = {}
+        self.targets: List[Tuple[Any, tuple]] = []
+        self.slabs: List[Dict[tuple, np.ndarray]] = []  # per slot
+        self.frame_like = False  # single tail covering the full frame
+
+    @property
+    def rows(self) -> int:
+        return self.stop - self.start
+
+
+class ShardedBatchAssembler:
+    """Stages batches into per-shard slabs and streams them to devices.
+
+    One assembler per (batch signature, sharding); ``begin(slot)`` yields
+    a :class:`BatchBuilder` for one batch. ``mode="monolithic"`` is the
+    escape hatch (``--ingest=monolithic``): one whole-batch host buffer
+    per slot, handed back for the engine's classic ``submit`` path —
+    byte-for-byte the pre-streaming behavior.
+    """
+
+    def __init__(
+        self,
+        batch_shape: Tuple[int, ...],
+        dtype,
+        sharding=None,
+        mode: str = "streamed",
+        depth: int = 4,
+        slots: int = 5,
+        tracer=None,
+        track: int = 0,
+        stats: Optional[IngestStats] = None,
+    ):
+        if mode not in INGEST_MODES:
+            raise ValueError(f"ingest mode must be one of {INGEST_MODES}, "
+                             f"got {mode!r}")
+        if depth < 1:
+            raise ValueError("ingest depth must be >= 1")
+        self.batch_shape = tuple(batch_shape)
+        self.dtype = np.dtype(dtype)
+        self.sharding = sharding
+        self.mode = mode
+        self.depth = depth
+        self.slots = max(1, slots)
+        self.tracer = tracer
+        self.track = track
+        self.stats = stats if stats is not None else IngestStats(
+            requested_mode=mode, depth=depth)
+        self._chunks: List[_Chunk] = []
+        self._chunk_of_row: List[int] = []
+        self._device_order: List[Any] = []
+        self._mono_pool: Optional[List[np.ndarray]] = None
+        self._scratch: Optional[np.ndarray] = None  # general-path decode buf
+        self.effective_mode = self._plan()
+        self.stats.effective_mode = self.effective_mode
+        self.stats.pool_allocs += 1
+
+    # -- layout planning -------------------------------------------------
+
+    def _plan(self) -> str:
+        """Derive the chunk layout from the sharding; returns the mode
+        actually used ("monolithic" when streaming cannot help)."""
+        if self.mode == "monolithic" or self.sharding is None:
+            return self._plan_monolithic()
+        cal = self.stats.h2d_block_ms
+        if cal is not None and cal < MIN_STREAM_H2D_MS:
+            return self._plan_monolithic(reason="cheap_transfer")
+        b = self.batch_shape[0]
+        try:
+            idx_map = self.sharding.devices_indices_map(self.batch_shape)
+        except Exception:  # noqa: BLE001 — exotic sharding: stay correct
+            return self._plan_monolithic(reason="unsupported_sharding")
+        frame_shape = self.batch_shape[1:]
+        groups: Dict[Tuple[int, int], List[tuple]] = {}
+        try:
+            for dev, idx in idx_map.items():
+                b0, b1 = _span(idx[0], b)
+                tail = tuple(idx[1:])
+                key = tuple(_span(sl, dim)
+                            for sl, dim in zip(tail, frame_shape))
+                groups.setdefault((b0, b1), []).append((dev, tail, key))
+        except ValueError:
+            return self._plan_monolithic(reason="unsupported_sharding")
+        ranges = sorted(groups)
+        # The streamed path needs the device shards to PARTITION the batch
+        # axis: contiguous non-overlapping row ranges covering [0, B), and
+        # no two devices holding the same (rows, tail) portion. Any
+        # replication means device_put's device-side broadcast beats
+        # repeated host puts — monolithic wins there.
+        if (ranges[0][0] != 0 or ranges[-1][1] != b
+                or any(ranges[i][1] != ranges[i + 1][0]
+                       for i in range(len(ranges) - 1))):
+            return self._plan_monolithic(reason="replicated_layout")
+        for members in groups.values():
+            keys = [k for _, _, k in members]
+            if len(keys) != len(set(keys)):
+                return self._plan_monolithic(reason="replicated_layout")
+        self._device_order = list(idx_map)
+        for b0, b1 in ranges:
+            rows = b1 - b0
+            n_sub = min(self.depth, rows)
+            bounds = [b0 + (rows * i) // n_sub for i in range(n_sub)] + [b1]
+            for s, e in zip(bounds, bounds[1:]):
+                c = _Chunk(s, e)
+                for dev, tail, key in groups[(b0, b1)]:
+                    c.tails[key] = tail
+                    c.targets.append((dev, key))
+                c.frame_like = (
+                    len(c.tails) == 1
+                    and next(iter(c.tails)) == tuple(
+                        (0, d) for d in frame_shape))
+                c.slabs = [
+                    {key: np.empty(
+                        (c.rows,) + tuple(stop - start
+                                          for start, stop in key),
+                        self.dtype)
+                     for key in c.tails}
+                    for _ in range(self.slots)
+                ]
+                self._chunks.append(c)
+        self._chunk_of_row = [0] * b
+        for i, c in enumerate(self._chunks):
+            for r in range(c.start, c.stop):
+                self._chunk_of_row[r] = i
+        return "streamed"
+
+    def _plan_monolithic(self, reason: Optional[str] = None) -> str:
+        self.stats.fallback_reason = reason
+        self._mono_pool = [
+            np.empty(self.batch_shape, self.dtype) for _ in range(self.slots)
+        ]
+        return "monolithic"
+
+    def _scratch_for(self, rows: int) -> np.ndarray:
+        """Whole-frame decode scratch for the general (H-sharded) path —
+        allocated once at the largest chunk size, reused every batch."""
+        if self._scratch is None:
+            biggest = max(c.rows for c in self._chunks)
+            self._scratch = np.empty(
+                (biggest,) + self.batch_shape[1:], self.dtype)
+        return self._scratch[:rows]
+
+    def begin(self, slot: int) -> "BatchBuilder":
+        """Start staging one batch into the given staging-pool slot."""
+        return BatchBuilder(self, slot % self.slots)
+
+
+class BatchBuilder:
+    """Mutable per-batch staging state; produced by ``begin``, consumed by
+    ``finish``. Rows must be written in increasing order (the pipeline,
+    batcher, and decode paths are all naturally monotonic)."""
+
+    def __init__(self, asm: ShardedBatchAssembler, slot: int):
+        self.asm = asm
+        self.slot = slot
+        self._streamed = asm.effective_mode == "streamed"
+        self._filled = [0] * len(asm._chunks) if self._streamed else [0]
+        self._parts: Dict[Any, List[Any]] = {d: [] for d in asm._device_order}
+        self._inflight: List[List[Any]] = []
+        self._stage_s = 0.0
+        self._put_s = 0.0
+        self._wait_s = 0.0
+        self._first_put_t: Optional[float] = None
+        self._t_begin = time.perf_counter()
+
+    # -- row staging -----------------------------------------------------
+
+    def write_row(self, row: int, frame: np.ndarray) -> None:
+        """Copy one frame into its shard slab(s); launches a shard's H2D
+        the moment its last row lands."""
+        t0 = time.perf_counter()
+        if not self._streamed:
+            np.copyto(self._mono_buf()[row], frame)
+            self._stage_s += time.perf_counter() - t0
+            return
+        ci = self.asm._chunk_of_row[row]
+        c = self.asm._chunks[ci]
+        local = row - c.start
+        slabs = c.slabs[self.slot]
+        for key, tail in c.tails.items():
+            np.copyto(slabs[key][local], frame[tail])
+        self._filled[ci] += 1
+        self._stage_s += time.perf_counter() - t0
+        if self._filled[ci] == c.rows:
+            self._launch(ci)
+
+    def windows(self, k: int) -> List[Tuple[int, int]]:
+        """Contiguous row windows covering [0, k) for bulk decode — each
+        window is one shard chunk (clipped at k), so committing a window
+        launches its transfer while the next window decodes."""
+        if not self._streamed:
+            return [(0, k)] if k else []
+        out = []
+        for c in self.asm._chunks:
+            if c.start >= k:
+                break
+            out.append((c.start, min(c.stop, k)))
+        return out
+
+    def window_view(self, start: int, stop: int) -> np.ndarray:
+        """A (rows, H, W, C) buffer for rows [start, stop): the shard slab
+        itself when it holds whole frames (zero-copy decode target), else
+        a reused scratch that ``commit_window`` scatters into slabs."""
+        if not self._streamed:
+            return self._mono_buf()[start:stop]
+        c = self.asm._chunks[self.asm._chunk_of_row[start]]
+        if c.frame_like:
+            key = next(iter(c.tails))
+            return c.slabs[self.slot][key][start - c.start:stop - c.start]
+        return self.asm._scratch_for(stop - start)
+
+    def commit_window(self, start: int, stop: int) -> None:
+        """Mark rows [start, stop) staged (scattering the scratch buffer
+        into shard slabs if the fast path was unavailable); launches the
+        chunk's transfers when it fills."""
+        t0 = time.perf_counter()
+        if not self._streamed:
+            self._filled[0] = stop
+            self._stage_s += time.perf_counter() - t0
+            return
+        ci = self.asm._chunk_of_row[start]
+        c = self.asm._chunks[ci]
+        if not c.frame_like:
+            scratch = self.asm._scratch_for(stop - start)
+            slabs = c.slabs[self.slot]
+            for key, tail in c.tails.items():
+                for i in range(stop - start):
+                    np.copyto(slabs[key][start - c.start + i],
+                              scratch[i][tail])
+        self._filled[ci] += stop - start
+        self._stage_s += time.perf_counter() - t0
+        if self._filled[ci] == c.rows:
+            self._launch(ci)
+
+    # -- transfers -------------------------------------------------------
+
+    def _launch(self, ci: int) -> None:
+        import jax
+
+        c = self.asm._chunks[ci]
+        slabs = c.slabs[self.slot]
+        t0 = time.perf_counter()
+        if self._first_put_t is None:
+            self._first_put_t = t0
+        arrs = []
+        for dev, key in c.targets:
+            arr = jax.device_put(slabs[key], dev)
+            self._parts[dev].append(arr)
+            arrs.append(arr)
+        t1 = time.perf_counter()
+        self._put_s += t1 - t0
+        tracer = self.asm.tracer
+        if tracer is not None and tracer.enabled:
+            nbytes = sum(slabs[key].nbytes for _, key in c.targets)
+            off = time.time() - time.perf_counter()  # monotonic → wall
+            tracer.complete(INGEST_H2D, t0 + off, t1 + off, self.asm.track,
+                            rows=f"{c.start}:{c.stop}", bytes=nbytes)
+        self._inflight.append(arrs)
+        if len(self._inflight) > self.asm.depth:
+            oldest = self._inflight.pop(0)
+            tw = time.perf_counter()
+            for a in oldest:
+                a.block_until_ready()
+            self._wait_s += time.perf_counter() - tw
+
+    def _mono_buf(self) -> np.ndarray:
+        return self.asm._mono_pool[self.slot]
+
+    # -- completion ------------------------------------------------------
+
+    def finish(self, valid: int):
+        """Pad rows [valid, B) by repeating the last valid row, flush the
+        remaining shard transfers, and assemble the batch.
+
+        Returns ``(batch, resident)``: a mesh-sharded ``jax.Array`` with
+        ``resident=True`` on the streamed path (feed
+        ``Engine.submit_resident``), or the host staging array with
+        ``resident=False`` on the monolithic path (feed ``Engine.submit``,
+        which owns the transfer exactly as before).
+        """
+        b = self.asm.batch_shape[0]
+        if not (0 < valid <= b):
+            raise ValueError(f"valid={valid} out of range for batch {b}")
+        if not self._streamed:
+            t0 = time.perf_counter()
+            buf = self._mono_buf()
+            for row in range(valid, b):
+                np.copyto(buf[row], buf[valid - 1])
+            self._stage_s += time.perf_counter() - t0
+            self._record(time.perf_counter())
+            return buf, False
+        # Pad from the already-staged slabs: the source row's chunk may
+        # be launched (its slab is only read), the destination rows are
+        # by construction in not-yet-launched chunks.
+        t0 = time.perf_counter()
+        src_c = self.asm._chunks[self.asm._chunk_of_row[valid - 1]]
+        src_local = valid - 1 - src_c.start
+        for row in range(valid, b):
+            ci = self.asm._chunk_of_row[row]
+            c = self.asm._chunks[ci]
+            slabs = c.slabs[self.slot]
+            for key in c.tails:
+                np.copyto(slabs[key][row - c.start],
+                          src_c.slabs[self.slot][key][src_local])
+            self._filled[ci] += 1
+            if self._filled[ci] == c.rows:
+                self._stage_s += time.perf_counter() - t0
+                self._launch(ci)
+                t0 = time.perf_counter()
+        self._stage_s += time.perf_counter() - t0
+        import jax
+        import jax.numpy as jnp
+
+        arrs = []
+        for dev in self.asm._device_order:
+            parts = self._parts[dev]
+            arrs.append(parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts, axis=0))
+        batch = jax.make_array_from_single_device_arrays(
+            self.asm.batch_shape, self.asm.sharding, arrs)
+        t_end = time.perf_counter()
+        tracer = self.asm.tracer
+        if tracer is not None and tracer.enabled and self._first_put_t:
+            off = time.time() - time.perf_counter()  # monotonic → wall
+            tracer.complete(INGEST_OVERLAP, self._first_put_t + off,
+                            t_end + off, self.asm.track, valid=valid)
+            tracer.complete(INGEST_STAGE, self._t_begin + off, t_end + off,
+                            self.asm.track,
+                            stage_ms=round(self._stage_s * 1e3, 3))
+        self._record(t_end)
+        return batch, True
+
+    def _record(self, t_end: float) -> None:
+        self.asm.stats.record_batch(
+            stage_ms=self._stage_s * 1e3,
+            put_ms=self._put_s * 1e3,
+            wait_ms=self._wait_s * 1e3,
+            span_ms=(t_end - self._t_begin) * 1e3,
+        )

@@ -35,15 +35,17 @@ from typing import Any, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import LatencyStats, RateLogger
+from dvf_tpu.obs.metrics import IngestStats, LatencyStats, RateLogger
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.sched.queues import DropOldestQueue
 from dvf_tpu.sched.reorder import ReorderBuffer
 
 # Trace track ids (the reference maps worker pids to tracks,
 # distributor.py:129; our executors are stages, not processes).
-TRACK_INGEST, TRACK_DEVICE, TRACK_SINK = 0, 1, 2
+# TRACK_H2D is the streamed-ingest transfer lane (per-shard h2d spans).
+TRACK_INGEST, TRACK_DEVICE, TRACK_SINK, TRACK_H2D = 0, 1, 2, 3
 
 
 @dataclasses.dataclass
@@ -67,6 +69,15 @@ class PipelineConfig:
     #   total, less GIL contention (XLA still overlaps compute with host
     #   staging via async dispatch). Ordering is identical: batches retire
     #   oldest-first either way.
+    ingest: str = "streamed"      # batch staging → device transfer path:
+    #   "streamed" (default) decodes frames into per-device-shard slabs
+    #   and device_puts each shard the moment its rows fill, overlapping
+    #   H2D with decode and with the previous batch's compute
+    #   (runtime/ingest.py); "monolithic" is the escape hatch — the
+    #   pre-streaming decode-all → stage-all → one blocking put path.
+    ingest_depth: int = 4         # dispatch-depth knob: how many shard
+    #   transfers may be in flight before the assembler blocks on the
+    #   oldest (also the sub-chunking granularity of a device's shard)
     device_trace_dir: Optional[str] = None  # capture a jax.profiler device
     #   trace for the whole run into this dir — Perfetto-compatible, views
     #   alongside the host-side frame-lifecycle trace (obs.trace) in one
@@ -99,6 +110,10 @@ class Pipeline:
             raise ValueError(
                 f"collect_mode must be 'thread' or 'inline', got "
                 f"{self.config.collect_mode!r}")
+        if self.config.ingest not in INGEST_MODES:
+            raise ValueError(
+                f"ingest must be one of {INGEST_MODES}, got "
+                f"{self.config.ingest!r}")
         self.engine = engine or Engine(filt)
         self.tracer = Tracer(enabled=self.config.trace)
         # Injectable ingest queue: default is the Python drop-oldest queue;
@@ -118,7 +133,8 @@ class Pipeline:
         _ti = self.config.telemetry_interval_s
         self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
-        self._staging: Optional[list] = None
+        self._assembler: Optional[ShardedBatchAssembler] = None
+        self._ingest_stats: Optional[IngestStats] = None
         self._on_idle = None  # inline collect: drain-ready hook (_assemble)
         self._inflight: "DropOldestQueue" = DropOldestQueue(maxsize=1_000_000)
         self._inflight_sem = threading.Semaphore(self.config.max_inflight)
@@ -239,23 +255,36 @@ class Pipeline:
             return None
         return items
 
-    def _staging_for(self, frame_shape, dtype, slot: int) -> np.ndarray:
-        """Preallocated batch staging buffers, one per in-flight slot.
+    def _builder_for(self, frame_shape, dtype, slot: int):
+        """One staged batch via the shared assembler (runtime/ingest.py).
 
-        `np.stack` per batch allocates + zero-fills a fresh multi-MB array
-        on the hot path; reusing a pool removes the allocator from the
-        loop. Pool size is max_inflight + 1: the semaphore guarantees at
-        most max_inflight batches outstanding, so the buffer being rewritten
-        belongs to a batch that has already been collected (its device_put
-        finished long ago).
+        The assembler owns the preallocated staging pool — per-shard
+        slabs (streamed) or whole-batch buffers (monolithic), one set per
+        in-flight slot. Pool size is max_inflight + 1: the semaphore
+        guarantees at most max_inflight batches outstanding, so the
+        buffers being rewritten belong to a batch that has already been
+        collected (the device consumed them long ago). Rebuilt only when
+        the frame signature changes, exactly like the engine's compile.
         """
         shape = (self.config.batch_size, *frame_shape)
-        if self._staging is None or self._staging[0].shape != shape or self._staging[0].dtype != dtype:
-            self._staging = [
-                np.empty(shape, dtype=dtype)
-                for _ in range(self.config.max_inflight + 1)
-            ]
-        return self._staging[slot % len(self._staging)]
+        dtype = np.dtype(dtype)
+        asm = self._assembler
+        if asm is None or asm.batch_shape != shape or asm.dtype != dtype:
+            # The engine's compiled input sharding defines the shard
+            # layout (and its warmup put calibrates the un-overlapped
+            # H2D cost the overlap_efficiency metric is judged against).
+            self.engine.ensure_compiled(shape, dtype)
+            self._ingest_stats = IngestStats(
+                requested_mode=self.config.ingest,
+                depth=self.config.ingest_depth,
+                h2d_block_ms=self.engine.h2d_block_ms)
+            self._assembler = asm = ShardedBatchAssembler(
+                shape, dtype, self.engine.input_sharding,
+                mode=self.config.ingest, depth=self.config.ingest_depth,
+                slots=self.config.max_inflight + 1,
+                tracer=self.tracer, track=TRACK_H2D,
+                stats=self._ingest_stats)
+        return asm.begin(slot)
 
     def _drain_ready(self, pending: "deque") -> bool:
         """Inline collect: retire the oldest batch when the window is full,
@@ -294,7 +323,6 @@ class Pipeline:
                     break
                 if not items:
                     continue
-                b = self.config.batch_size
                 valid = len(items)
                 if inline:
                     # Single-consumer mode: collect in-flight batches HERE
@@ -319,23 +347,30 @@ class Pipeline:
                     if decode is not None:
                         # Ring transport: items carry serialized payloads;
                         # the queue decodes them (JPEG via the threaded
-                        # codec) straight into the staging rows.
-                        batch = self._staging_for(
-                            self.queue.frame_shape, self.queue.frame_dtype, seq)
-                        decode(items, batch)
+                        # codec) straight into the shard staging slabs,
+                        # one window per shard chunk so the transfer of a
+                        # decoded chunk overlaps the decode of the next.
+                        builder = self._builder_for(
+                            self.queue.frame_shape, self.queue.frame_dtype,
+                            seq)
+                        for start, stop in builder.windows(valid):
+                            decode(items[start:stop],
+                                   builder.window_view(start, stop))
+                            builder.commit_window(start, stop)
                     else:
                         f0 = items[0][1]
-                        batch = self._staging_for(f0.shape, f0.dtype, seq)
+                        builder = self._builder_for(f0.shape, f0.dtype, seq)
                         for row, (_, frame, _) in enumerate(items):
-                            np.copyto(batch[row], frame)
-                    # Pad short batches by repeating the last frame — static
-                    # shapes mean one compilation; padded outputs are dropped
-                    # (and repeat-last keeps temporal state correct, see
-                    # Filter.pad_safe).
-                    for row in range(valid, b):
-                        np.copyto(batch[row], batch[valid - 1])
+                            builder.write_row(row, frame)
+                    # finish() pads short batches by repeating the last
+                    # frame — static shapes mean one compilation; padded
+                    # outputs are dropped (and repeat-last keeps temporal
+                    # state correct, see Filter.pad_safe) — and flushes
+                    # the remaining shard transfers.
+                    batch, resident = builder.finish(valid)
                     t0 = time.time()
-                    result = self.engine.submit(batch)
+                    result = (self.engine.submit_resident(batch) if resident
+                              else self.engine.submit(batch))
                     # Start the D2H transfer now, overlapped with the next
                     # batch's staging + device compute; the collect thread's
                     # np.asarray then only waits for completion instead of
@@ -501,7 +536,7 @@ class Pipeline:
 
     def stats(self) -> dict:
         """Superset of the reference's get_frame_stats (distributor.py:346-354)."""
-        return {
+        out = {
             **self.reorder.stats(),
             "total_frames_produced": self.frame_counter,
             "dropped_at_ingest": self.queue.dropped,
@@ -511,3 +546,6 @@ class Pipeline:
             "engine_batches": self.engine.stats.batches,
             **self.latency.summary(),
         }
+        if self._ingest_stats is not None:
+            out["ingest"] = self._ingest_stats.summary()
+        return out

@@ -39,10 +39,13 @@ from dvf_tpu.serve.session import Slot, StreamSession
 
 @dataclasses.dataclass
 class BatchPlan:
-    """One tick's device batch: the staged array, how many rows are real,
-    and the (session, frame_index) tag per valid row."""
+    """One tick's device batch: how many rows are real, the
+    (session, frame_index) tag per valid row, and — on the monolithic
+    staging path only — the staged host array (the streamed ingest path
+    stages straight into per-shard slabs, so ``batch`` is None there and
+    the router never needs it)."""
 
-    batch: np.ndarray
+    batch: Optional[np.ndarray]
     valid: int
     slots: List[Slot]
 
@@ -50,25 +53,29 @@ class BatchPlan:
 class ContinuousBatcher:
     """Drains ready frames across sessions into fixed-signature batches."""
 
-    def __init__(self, batch_size: int):
+    def __init__(self, batch_size: int, staging_pool: int = 2):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         self.batch_size = batch_size
+        # Bounded internal staging ring for plan() callers that pass no
+        # buffer: a fresh multi-MB np.empty per tick put the allocator on
+        # the serving hot path. Cycled like the pipeline's per-slot pool;
+        # callers that hold a plan across more than ``staging_pool``
+        # ticks must pass their own staging (the frontend does).
+        self._staging_pool = max(1, staging_pool)
+        self._staging: Optional[List[np.ndarray]] = None
+        self._staging_seq = 0
 
-    def plan(
-        self,
-        sessions: Sequence[StreamSession],
-        now: float,
-        staging: Optional[np.ndarray] = None,
-    ) -> Optional[BatchPlan]:
-        """Assemble one batch from everything ready; None = nothing to do.
+    def select(self, sessions: Sequence[StreamSession],
+               now: float) -> Optional[List[Slot]]:
+        """EDF slot selection for one batch; None = nothing to do.
 
-        ``staging``: preallocated (batch_size, H, W, C) buffer to fill
-        (the frontend's per-inflight-slot pool); a fresh array is
-        allocated when omitted (tests).
-
-        Dispatch-thread only: touches the sessions' scheduler-owned
-        ``pending`` staging.
+        Drains every session's ingress, sheds blown deadlines, picks the
+        ``batch_size`` earliest-deadline slots, and claims them in-flight
+        — everything plan() does except touching frame bytes, so the
+        streamed assembler can stage the chosen frames straight into its
+        per-shard slabs. Dispatch-thread only: touches the sessions'
+        scheduler-owned ``pending`` staging.
         """
         candidates: List[Slot] = []
         for s in sessions:
@@ -92,11 +99,36 @@ class ContinuousBatcher:
             for _ in range(n):
                 s.pending.popleft()
             s.claim_inflight(n)
+        return chosen
 
+    def _pool_staging(self, frame: np.ndarray) -> np.ndarray:
+        shape = (self.batch_size, *frame.shape)
+        if self._staging is None or self._staging[0].shape != shape \
+                or self._staging[0].dtype != frame.dtype:
+            self._staging = [np.empty(shape, dtype=frame.dtype)
+                             for _ in range(self._staging_pool)]
+        self._staging_seq += 1
+        return self._staging[self._staging_seq % len(self._staging)]
+
+    def plan(
+        self,
+        sessions: Sequence[StreamSession],
+        now: float,
+        staging: Optional[np.ndarray] = None,
+    ) -> Optional[BatchPlan]:
+        """Assemble one monolithic batch from everything ready; None =
+        nothing to do.
+
+        ``staging``: preallocated (batch_size, H, W, C) buffer to fill
+        (the frontend's per-inflight-slot pool); the batcher's own
+        bounded ring is used when omitted (tests, ad-hoc callers).
+        """
+        chosen = self.select(sessions, now)
+        if chosen is None:
+            return None
         valid = len(chosen)
         if staging is None:
-            f0 = chosen[0].frame
-            staging = np.empty((self.batch_size, *f0.shape), dtype=f0.dtype)
+            staging = self._pool_staging(chosen[0].frame)
         for row, slot in enumerate(chosen):
             np.copyto(staging[row], slot.frame)
             slot.frame = None  # drop the client's buffer reference

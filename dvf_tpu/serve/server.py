@@ -44,9 +44,10 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import LatencyStats
+from dvf_tpu.obs.metrics import IngestStats, LatencyStats
 from dvf_tpu.runtime.engine import Engine
-from dvf_tpu.serve.batcher import ContinuousBatcher
+from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
+from dvf_tpu.serve.batcher import BatchPlan, ContinuousBatcher
 from dvf_tpu.serve.router import ResultRouter
 from dvf_tpu.serve.session import (
     CLOSED,
@@ -74,6 +75,12 @@ class ServeConfig:
     tick_s: float = 0.002         # dispatch idle poll
     resilient: bool = True        # one bad batch is dropped + counted;
     #   serving keeps going (live-mode semantics, like Pipeline.resilient)
+    ingest: str = "streamed"      # "streamed": stage chosen frames into
+    #   per-device-shard slabs, device_put each shard as it fills, submit
+    #   the already-resident batch (runtime/ingest.py — the same streamed
+    #   assembler the single-stream pipeline uses); "monolithic": the
+    #   classic stage-all → engine.submit path
+    ingest_depth: int = 4         # in-flight shard-transfer window
 
 
 class ServeFrontend:
@@ -93,6 +100,10 @@ class ServeFrontend:
                 f"only multiplexes stateless filters")
         self.filter = filt
         self.config = config or ServeConfig()
+        if self.config.ingest not in INGEST_MODES:
+            raise ValueError(
+                f"ingest must be one of {INGEST_MODES}, got "
+                f"{self.config.ingest!r}")
         self.engine = engine or Engine(filt)
         self.batcher = ContinuousBatcher(self.config.batch_size)
         self.router = ResultRouter()
@@ -104,7 +115,8 @@ class ServeFrontend:
         self.errors = 0
         self._frame_shape: Optional[tuple] = None  # pinned at first submit
         self._frame_dtype = None
-        self._staging: Optional[List[np.ndarray]] = None
+        self._assembler: Optional[ShardedBatchAssembler] = None
+        self._ingest_stats: Optional[IngestStats] = None
         # Plain unbounded FIFO: depth is already bounded by the semaphore,
         # and drop-oldest semantics here would silently leak a permit and
         # the dropped batch's inflight claims.
@@ -242,17 +254,26 @@ class ServeFrontend:
 
     # -- service threads -------------------------------------------------
 
-    def _staging_for(self, seq: int) -> np.ndarray:
-        """Per-inflight-slot staging pool, exactly like the single-stream
-        pipeline's: max_inflight + 1 buffers means the one being rewritten
-        always belongs to an already-collected batch."""
+    def _builder_for(self, seq: int):
+        """One staged batch via the shared assembler (runtime/ingest.py)
+        — both ingest modes; the assembler owns the per-inflight-slot
+        staging pool (max_inflight + 1 buffers: the one being rewritten
+        always belongs to an already-collected batch, exactly like the
+        single-stream pipeline's)."""
         shape = (self.config.batch_size, *self._frame_shape)
-        if self._staging is None or self._staging[0].shape != shape:
-            self._staging = [
-                np.empty(shape, dtype=self._frame_dtype)
-                for _ in range(self.config.max_inflight + 1)
-            ]
-        return self._staging[seq % len(self._staging)]
+        dtype = np.dtype(self._frame_dtype)
+        if self._assembler is None or self._assembler.batch_shape != shape:
+            self.engine.ensure_compiled(shape, dtype)
+            self._ingest_stats = IngestStats(
+                requested_mode=self.config.ingest,
+                depth=self.config.ingest_depth,
+                h2d_block_ms=self.engine.h2d_block_ms)
+            self._assembler = ShardedBatchAssembler(
+                shape, dtype, self.engine.input_sharding,
+                mode=self.config.ingest, depth=self.config.ingest_depth,
+                slots=self.config.max_inflight + 1,
+                stats=self._ingest_stats)
+        return self._assembler.begin(seq)
 
     def _fail(self, e: BaseException) -> None:
         if self._error is None:
@@ -289,22 +310,36 @@ class ServeFrontend:
                                 if s.state != CLOSED]
                 plan = None
                 if sessions and self._frame_shape is not None:
-                    plan = self.batcher.plan(
-                        sessions, time.time(), staging=self._staging_for(seq))
+                    # Pick the slots only; the frames are staged through
+                    # the shared assembler below, after the in-flight
+                    # permit is acquired (the permit is what makes
+                    # staging-slab reuse safe) — one staging
+                    # implementation for both ingest modes.
+                    chosen = self.batcher.select(sessions, time.time())
+                    if chosen:
+                        plan = BatchPlan(batch=None, valid=len(chosen),
+                                         slots=chosen)
                 self._finalize_drained()
                 if plan is None:
                     time.sleep(self.config.tick_s)
                     continue
                 # Bounded in-flight depth; poll so shutdown can't wedge on
-                # a dead collect thread. Acquired before engine.submit —
-                # the permit is what makes staging-buffer reuse safe.
+                # a dead collect thread. Acquired before any staging
+                # buffer is touched — the permit is what makes
+                # staging/slab reuse safe.
                 while not self._inflight_sem.acquire(timeout=0.1):
                     if self._stop.is_set():
                         self.router.discard(plan)
                         return
                 t0 = time.time()
                 try:
-                    result = self.engine.submit(plan.batch)
+                    builder = self._builder_for(seq)
+                    for row, slot in enumerate(plan.slots):
+                        builder.write_row(row, slot.frame)
+                        slot.frame = None  # drop the client's buffer
+                    batch, resident = builder.finish(plan.valid)
+                    result = (self.engine.submit_resident(batch)
+                              if resident else self.engine.submit(batch))
                     try:
                         result.copy_to_host_async()
                     except AttributeError:
@@ -369,6 +404,8 @@ class ServeFrontend:
             **self.router.stats(),
             "aggregate": LatencyStats.merged(
                 [s.latency for s in every.values()]),
+            **({"ingest": self._ingest_stats.summary()}
+               if self._ingest_stats is not None else {}),
         }
 
 

@@ -30,7 +30,9 @@ from typing import Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.metrics import IngestStats
 from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.transport.codec import JpegGeometryError, make_codec
 
 # ---------------------------------------------------------------------------
@@ -85,8 +87,14 @@ class TpuZmqWorker:
         poll_ms: int = 10,
         delay_s: float = 0.0,
         transport: str = "list",
+        ingest: str = "streamed",
+        ingest_depth: int = 4,
     ):
         import zmq
+
+        if ingest not in INGEST_MODES:
+            raise ValueError(f"ingest must be one of {INGEST_MODES}, "
+                             f"got {ingest!r}")
 
         if filt.stateful and not filt.pad_safe:
             # Short batches are padded by repeating the last frame; a
@@ -109,7 +117,12 @@ class TpuZmqWorker:
         self.filt = filt
         self.engine = engine or Engine(filt)
         self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
-        self._staging = None  # reusable decode batch buffer (_process_batch)
+        self.ingest = ingest
+        self.ingest_depth = ingest_depth
+        self._asm: Optional[ShardedBatchAssembler] = None  # per-geometry
+        #   staged-batch assembler (_process_batch); replaces the old raw
+        #   staging buffer — slabs are reused across batches identically
+        self._ingest_stats: Optional[IngestStats] = None
         self.batch_size = batch_size
         self.assemble_timeout_s = assemble_timeout_s
         self.use_jpeg = use_jpeg
@@ -150,6 +163,42 @@ class TpuZmqWorker:
             return self.codec.encode_batch(list(batch_u8))
         return [row.tobytes() for row in batch_u8]
 
+    def _builder(self, h: int, w: int):
+        """Per-geometry streamed assembler (runtime/ingest.py) — the same
+        ingest implementation the pipeline and serving frontend use.
+        _process_batch is fully synchronous (np.asarray fetches the
+        result before the next batch is assembled), so a single staging
+        slot is enough: the slabs handed to the engine are never still in
+        flight when rewritten. JPEG mode decodes each frame in place via
+        the C shim — zero per-batch allocations, exactly like the old
+        single staging buffer."""
+        shape = (self.batch_size, h, w, 3)
+        if self._asm is None or self._asm.batch_shape != shape:
+            self.engine.ensure_compiled(shape, np.uint8)
+            self._ingest_stats = IngestStats(
+                requested_mode=self.ingest, depth=self.ingest_depth,
+                h2d_block_ms=self.engine.h2d_block_ms)
+            self._asm = ShardedBatchAssembler(
+                shape, np.uint8, self.engine.input_sharding,
+                mode=self.ingest, depth=self.ingest_depth, slots=1,
+                stats=self._ingest_stats)
+        return self._asm.begin(0)
+
+    def _decode_jpeg(self, blobs, valid):
+        """Decode a JPEG batch chunk-by-chunk into the assembler's shard
+        slabs, so each decoded chunk's H2D streams out under the decode
+        of the next; returns the finished (batch, resident) pair."""
+        if self._asm is None:
+            h, w = self.codec.probe(blobs[0])
+        else:
+            h, w = self._asm.batch_shape[1:3]
+        builder = self._builder(h, w)
+        for start, stop in builder.windows(valid):
+            self.codec.decode_batch(blobs[start:stop],
+                                    out=builder.window_view(start, stop))
+            builder.commit_window(start, stop)
+        return builder.finish(valid)
+
     def _process_batch(self, pending, pid) -> None:
         """Decode → engine → encode → push for one assembled batch.
 
@@ -160,51 +209,42 @@ class TpuZmqWorker:
         indices = [i for i, _ in pending]
         valid = len(pending)
         blobs = [b for _, b in pending]
-        # One reusable full-batch staging buffer: _process_batch is fully
-        # synchronous (the np.asarray below fetches the result before the
-        # next batch is assembled), so the buffer handed to engine.submit
-        # is never still in flight when rewritten. JPEG mode decodes each
-        # frame in place via the C shim — zero per-batch allocations.
         # Geometry follows the STREAM (the app's target_size), not our
         # --target-size flag, which only governs the raw path's reshape
         # (reference inverter.py:34 hardcodes raw geometry the same way).
-        # Probe only when the cached staging is absent or proves stale
+        # Probe only when the cached assembler is absent or proves stale
         # (the cv2 fallback codec's probe() is a full decode — probing
         # every batch would double-decode the first frame on that path).
         if self.use_jpeg:
-            if self._staging is None:
-                h, w = self.codec.probe(blobs[0])
-                self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
             try:
-                self.codec.decode_batch(blobs, out=self._staging[:valid])
+                batch, resident = self._decode_jpeg(blobs, valid)
             except JpegGeometryError:
                 # Stream geometry changed (the app restarted with a new
-                # target_size): re-probe, re-stage, retry once. Corrupt
-                # streams raise plain ValueError and go straight to
-                # run()'s containment — no wasted second decode.
-                h, w = self.codec.probe(blobs[0])
-                self._staging = np.empty((self.batch_size, h, w, 3), np.uint8)
-                self.codec.decode_batch(blobs, out=self._staging[:valid])
+                # target_size): re-probe, rebuild the assembler, retry
+                # once. Corrupt streams raise plain ValueError and go
+                # straight to run()'s containment — no wasted second
+                # decode. The abandoned half-staged builder is dropped
+                # with its assembler.
+                self._asm = None
+                batch, resident = self._decode_jpeg(blobs, valid)
         else:
             h = w = self.raw_size
-            shape = (self.batch_size, h, w, 3)
-            if self._staging is None or self._staging.shape != shape:
-                self._staging = np.empty(shape, np.uint8)
+            builder = self._builder(h, w)
             for row, b in enumerate(blobs):
-                self._staging[row] = np.frombuffer(b, np.uint8).reshape(h, w, 3)
-        frames = self._staging
-        # Pad to the compiled batch signature (static shapes — one
-        # compilation for every batch size). Repeat-last keeps stateful
-        # temporal windows correct — see Filter.pad_safe (enforced in
-        # __init__ for filters where it wouldn't).
-        for row in range(valid, self.batch_size):
-            frames[row] = frames[valid - 1]
+                builder.write_row(
+                    row, np.frombuffer(b, np.uint8).reshape(h, w, 3))
+            batch, resident = builder.finish(valid)
+        # finish() padded to the compiled batch signature (static shapes —
+        # one compilation for every batch size; repeat-last keeps stateful
+        # temporal windows correct, see Filter.pad_safe) and, on the
+        # streamed path, already shipped every shard to its device.
         if self.delay_s > 0:
             # Fault injection: simulate a slow worker to exercise the app's
             # drop/reorder logic, like the reference's --delay
             # (inverter.py:37-38,55-56).
             time.sleep(self.delay_s)
-        out = np.asarray(self.engine.submit(frames))
+        out = np.asarray(self.engine.submit_resident(batch) if resident
+                         else self.engine.submit(batch))
         t1 = time.time()
         payloads = self._encode(out[:valid])
         for idx, payload in zip(indices, payloads):

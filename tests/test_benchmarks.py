@@ -72,13 +72,16 @@ def test_stage_decomposition_fields():
     from dvf_tpu.benchmarks import bench_stage_decomposition
 
     d = bench_stage_decomposition(get_filter("invert"), (1, 2), 16, 16, reps=3)
-    assert set(d) == {"1", "2"}
+    # Self-describing keys (the pre-r06 payload published opaque "1"/"2")
+    # with the measured transfer mode recorded in-band.
+    assert set(d) == {"batch_1", "batch_2"}
     for b, legs in d.items():
         for k in ("staging_ms", "h2d_ms", "compute_ms", "d2h_ms"):
             assert legs[k] >= 0, (b, k, legs)
         assert legs["total_ms"] >= legs["compute_ms"]
+        assert legs["transfer_mode"] == "whole_batch"
         assert legs["per_frame_compute_ms"] == round(
-            legs["compute_ms"] / int(b), 4)
+            legs["compute_ms"] / int(b.removeprefix("batch_")), 4)
 
 
 def test_roofline_fields_models():
