@@ -236,6 +236,12 @@ def build_out(result, mode, fallback, error):
         "n_devices": result.get("n_devices"),
         "batch": result.get("batch"),
         "e2e_batch": result.get("e2e_batch"),
+        # Per-kind contained-fault counters from the e2e leg ({} = clean;
+        # resilience.faults taxonomy). A BENCH round asserts this is empty
+        # before trusting the throughput it sits beside — a number that
+        # silently absorbed dropped batches is not a measurement.
+        "faults": result.get("faults"),
+        "recoveries": result.get("recoveries"),
         "fallback": fallback,
         "error": error,
     }

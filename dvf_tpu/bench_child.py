@@ -284,6 +284,11 @@ def main(argv=None) -> int:
             ingest=r["ingest"],
             ingest_depth=r["ingest_depth"],
             overlap_efficiency=r["overlap_efficiency"],
+            # Per-kind contained-fault counters from the run (empty dict =
+            # clean run) — a BENCH round asserts zero unexpected faults
+            # before trusting the fps beside them.
+            faults=r.get("faults", {}),
+            recoveries=r.get("recoveries", 0),
             roofline_frac=round(r["fps"] / roof, 3) if roof else None,
         )
         _log(f"e2e done: {result['e2e_fps']} fps "

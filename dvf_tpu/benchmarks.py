@@ -348,6 +348,11 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
         "ingest_depth": ingest_depth,
         "overlap_efficiency": ingest_stats.get("overlap_efficiency"),
         "ingest_stats": ingest_stats,
+        # Per-kind fault counters (resilience.faults) — a clean bench run
+        # asserts an empty dict; any entry here means the measured number
+        # absorbed contained faults and is suspect.
+        "faults": stats.get("faults", {}).get("by_kind", {}),
+        "recoveries": stats.get("recoveries", 0),
     }
 
 

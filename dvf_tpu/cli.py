@@ -249,6 +249,18 @@ def _resolve_source(args, allow_shm: bool = True):
     )
 
 
+def _parse_chaos(args):
+    """``--chaos`` spec → resilience.chaos.FaultPlan (None when unset)."""
+    if not getattr(args, "chaos", None):
+        return None
+    from dvf_tpu.resilience import FaultPlan
+
+    try:
+        return FaultPlan.parse(args.chaos, seed=args.chaos_seed)
+    except ValueError as e:
+        raise SystemExit(f"error: bad --chaos spec: {e}")
+
+
 def _cmd_serve_multi(args, filt, engine) -> int:
     """Local multi-stream demo: N synthetic client streams at different
     frame rates multiplexed through ONE shared engine by the serving
@@ -285,6 +297,11 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         resilient=not args.fail_fast,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        fault_budget=args.fault_budget,
+        fault_window_s=args.fault_window,
+        stall_timeout_s=(args.stall_timeout if args.stall_timeout is not None
+                         else 30.0),
+        chaos=_parse_chaos(args),
     )
     frontend = ServeFrontend(filt, config, engine=engine)
 
@@ -343,6 +360,10 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         "admission_rejections": stats["admission_rejections"],
         "engine_batches": stats["engine_batches"],
         "errors": stats["errors"],
+        # Per-kind contained-fault counters + supervised engine rebuilds
+        # ({} / 0 on a clean run — see docs/GUIDE.md "Faults, chaos…").
+        "faults": stats["faults"]["by_kind"],
+        "recoveries": stats["recoveries"],
     }
     print(json.dumps(out, default=float))
     return 0
@@ -404,6 +425,10 @@ def cmd_serve(args) -> int:
         collect_mode=args.collect_mode,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        fault_budget=args.fault_budget,
+        fault_window_s=args.fault_window,
+        stall_timeout_s=args.stall_timeout or 0.0,
+        chaos=_parse_chaos(args),
     )
 
     queue = None
@@ -512,6 +537,14 @@ def cmd_serve(args) -> int:
 
 
 def cmd_worker(args) -> int:
+    if args.stall_timeout is not None:
+        # The worker's processing loop is synchronous (decode → step →
+        # push, no in-flight window), so there is nothing for a stall
+        # watchdog to supervise — reject rather than silently ignore.
+        print("error: --stall-timeout does not apply to the worker "
+              "(its batch loop is synchronous; the watchdog supervises "
+              "the pipeline/serve in-flight windows)", file=sys.stderr)
+        return 2
     _force_platform()
 
     from dvf_tpu.runtime.engine import Engine
@@ -530,6 +563,9 @@ def cmd_worker(args) -> int:
         delay_s=args.delay,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        fault_budget=args.fault_budget,
+        fault_window_s=args.fault_window,
+        chaos=_parse_chaos(args),
     )
     print(
         f"TPU worker serving {filt.name} on "
@@ -638,6 +674,8 @@ def cmd_bench(args) -> int:
             "ingest": r["ingest"],
             "ingest_depth": r["ingest_depth"],
             "overlap_efficiency": r["overlap_efficiency"],
+            # Per-kind contained-fault counters ({} = clean run).
+            "faults": r.get("faults", {}),
         }
         if args.lat_frames != 0 and r["fps"] > 0:
             # p50/p99 from a SEPARATE rate-controlled leg (source at 0.8×
@@ -995,6 +1033,34 @@ def main(argv=None) -> int:
                           "flight before staging blocks on the oldest "
                           "(also the per-device sub-chunk granularity)")
 
+    # Shared by the long-running serving subcommands (serve, worker): the
+    # resilience knobs — deterministic fault injection for reproducing
+    # failures end-to-end, and the error-budget/watchdog bounds
+    # (dvf_tpu.resilience).
+    res = argparse.ArgumentParser(add_help=False)
+    res.add_argument("--chaos", default=None, metavar="SPEC",
+                     help="arm deterministic fault injection: comma-"
+                          "separated rules 'site[:key=value]*' over sites "
+                          "decode|transport|h2d|compute|oom|freeze with "
+                          "keys every=N, at=I/J/K (0-based event indices), "
+                          "p=0.05, count=N, delay=SECONDS, kind=NAME — "
+                          "e.g. 'compute:at=3,h2d:every=5:count=2'; "
+                          "exactly reproducible with the same --chaos-seed")
+    res.add_argument("--chaos-seed", type=int, default=0,
+                     help="seed for probabilistic (p=) chaos rules")
+    res.add_argument("--fault-budget", type=int, default=16,
+                     help="contained faults per kind inside --fault-window "
+                          "before escalation (drop → degrade → fail)")
+    res.add_argument("--fault-window", type=float, default=30.0,
+                     help="sliding window (seconds) for --fault-budget")
+    res.add_argument("--stall-timeout", type=float, default=None,
+                     help="stall watchdog: an in-flight batch older than "
+                          "this (seconds) triggers supervised recovery "
+                          "(shed window, rebuild engine). Default: 30 for "
+                          "the multi-stream frontend, off for the single-"
+                          "stream pipeline; rejected by the worker (its "
+                          "batch loop is synchronous — nothing to watch)")
+
     fp = sub.add_parser("filters", help="list registered filters")
     fp.add_argument("-v", "--verbose", action="store_true",
                     help="include each filter's one-line description")
@@ -1004,7 +1070,8 @@ def main(argv=None) -> int:
     dp_.add_argument("--probe-timeout", type=float, default=60.0,
                      help="seconds before declaring the backend unreachable")
 
-    sp = sub.add_parser("serve", parents=[plat, ing], help="run the pipeline")
+    sp = sub.add_parser("serve", parents=[plat, ing, res],
+                        help="run the pipeline")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
     sp.add_argument("--source", default="synthetic",
@@ -1092,7 +1159,7 @@ def main(argv=None) -> int:
                          "consumer to attach and drain before unlinking "
                          "the shm ring (serve cold-start can take ~10 s)")
 
-    wp = sub.add_parser("worker", parents=[plat, ing],
+    wp = sub.add_parser("worker", parents=[plat, ing, res],
                         help="ZMQ worker for the reference app")
     wp.add_argument("--filter", default="invert")
     wp.add_argument("--filter-config", default=None)

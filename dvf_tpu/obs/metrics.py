@@ -12,6 +12,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from dvf_tpu.resilience.faults import FaultStats  # noqa: F401 — re-export:
+#   the per-kind fault counters are part of the metrics surface (embedded
+#   in pipeline/serve/worker stats and the bench JSON) even though the
+#   taxonomy itself lives with the resilience subsystem.
+
 
 class LatencyStats:
     """Streaming fps + latency percentiles.

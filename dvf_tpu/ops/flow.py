@@ -580,7 +580,15 @@ def ema_smooth(alpha: float = 0.35) -> Filter:
             same0,
             jnp.all(batch[1:] == batch[:-1], axis=(1, 2, 3)),
         ])[:, None, None, None]
-        A = jnp.where(same, 1.0, 1.0 - a).astype(batch.dtype)
+        # A is broadcast to the FULL batch shape before the scan: jax
+        # 0.4.x GSPMD miscompiles associative_scan over operands of mixed
+        # shape when the batch axis is sharded (a (B,1,1,1) A beside a
+        # (B,H,W,C) B returns wrong Ac on a data/space mesh — isolated on
+        # jax 0.4.37, CPU, data=2·space=4; exact with either operand
+        # layout on a single device). Shape-matched operands partition
+        # correctly on every toolchain, at the cost of materializing A.
+        A = jnp.broadcast_to(
+            jnp.where(same, 1.0, 1.0 - a).astype(batch.dtype), batch.shape)
         B = jnp.where(same, 0.0, a * batch).astype(batch.dtype)
 
         def combine(left, right):

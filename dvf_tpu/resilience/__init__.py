@@ -1,0 +1,33 @@
+"""Fault taxonomy, deterministic fault injection, error budgets, and the
+stall watchdog / supervised recovery — the shared fault model every
+containment site in the stack (pipeline, serving frontend, ZMQ worker)
+classifies into and escalates through. See the module docstrings for the
+design: faults (taxonomy), chaos (injection plane), budget (drop →
+degrade → fail), supervisor (watchdog + recovery).
+"""
+
+from dvf_tpu.resilience.budget import ErrorBudget, escalate
+from dvf_tpu.resilience.chaos import ChaosFault, ChaosRule, FaultPlan
+from dvf_tpu.resilience.faults import (
+    ALL_KINDS,
+    FaultError,
+    FaultKind,
+    FaultStats,
+    classify,
+)
+from dvf_tpu.resilience.supervisor import InflightWindow, Supervisor
+
+__all__ = [
+    "ALL_KINDS",
+    "ChaosFault",
+    "ChaosRule",
+    "ErrorBudget",
+    "FaultError",
+    "FaultKind",
+    "FaultPlan",
+    "FaultStats",
+    "InflightWindow",
+    "Supervisor",
+    "classify",
+    "escalate",
+]

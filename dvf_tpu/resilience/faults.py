@@ -1,0 +1,143 @@
+"""Structured fault taxonomy for every containment site in the stack.
+
+The reference pipeline's whole fault story is worker.py:71-76 — "drop one
+bad frame and keep going" — and until this module our port mirrored it:
+each containment site (`Pipeline._contain`, `ServeFrontend._contain`,
+`TpuZmqWorker.run`) swallowed a bare ``Exception`` and bumped one opaque
+``errors`` counter. That loses exactly the information an operator (or a
+BENCH round asserting "zero unexpected faults") needs: *what class of
+thing* failed, how often, and what the last instance looked like.
+
+``FaultKind`` is the shared vocabulary. Every contained error is
+classified into one kind, counted per kind in a :class:`FaultStats`
+(exported through pipeline/serve/worker ``stats()`` and the bench JSON),
+and fed to the per-kind :class:`~dvf_tpu.resilience.budget.ErrorBudget`
+that decides drop → degrade → fail escalation.
+
+Classification is two-layered: code that *knows* what failed raises (or
+wraps into) a :class:`FaultError` carrying its kind — the streamed-ingest
+``device_put`` wraps as ``h2d``, the ZMQ worker's decode wraps as
+``decode``, chaos injections carry their configured kind — and everything
+else is classified by :func:`classify` from the exception type/message
+plus the containment site it surfaced at.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class FaultKind:
+    """The error taxonomy (string constants, not an Enum — the values ride
+    through JSON stats payloads and log lines as-is)."""
+
+    DECODE = "decode"        # frame/JPEG decode, source read
+    GEOMETRY = "geometry"    # stream geometry changed mid-flight (re-probe)
+    TRANSPORT = "transport"  # malformed/truncated wire messages, socket errors
+    H2D = "h2d"              # host→device transfer (device_put) failures
+    COMPUTE = "compute"      # the jitted step / result materialization
+    OOM = "oom"              # device memory exhaustion
+    STALL = "stall"          # watchdog: in-flight work older than the timeout
+    INTERNAL = "internal"    # everything else (bookkeeping bugs, sinks)
+
+
+ALL_KINDS = (
+    FaultKind.DECODE, FaultKind.GEOMETRY, FaultKind.TRANSPORT,
+    FaultKind.H2D, FaultKind.COMPUTE, FaultKind.OOM,
+    FaultKind.STALL, FaultKind.INTERNAL,
+)
+
+# Default classification for exceptions that carry no kind of their own,
+# keyed by the containment site that caught them (the site string each
+# `_contain(e, where)` call already passes).
+_SITE_DEFAULT = {
+    # single-stream pipeline sites
+    "ingest": FaultKind.DECODE,      # source read/decode domain
+    "dispatch": FaultKind.COMPUTE,   # staging + engine submit
+    "collect": FaultKind.COMPUTE,    # result materialization
+    "sink": FaultKind.INTERNAL,
+    # zmq worker / serving sites
+    "decode": FaultKind.DECODE,
+    "transport": FaultKind.TRANSPORT,
+    "h2d": FaultKind.H2D,
+    "compute": FaultKind.COMPUTE,
+    "worker": FaultKind.COMPUTE,     # worker loop: engine is the main residue
+}
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM", "Resource exhausted")
+
+
+class FaultError(RuntimeError):
+    """An error with a known :class:`FaultKind` attached.
+
+    Raised directly by chaos injections and by containment sites that
+    escalate ("error budget exhausted"), and used to wrap exceptions at
+    the few points that know exactly which fault domain failed (the
+    streamed-ingest ``device_put``, the worker's decode path).
+    """
+
+    def __init__(self, kind: str, message: str, fatal: bool = False):
+        super().__init__(message)
+        self.kind = kind
+        self.fatal = fatal  # budget-exhaustion errors set this so generic
+        #   per-iteration containment re-raises instead of re-containing
+
+
+def classify(exc: BaseException, site: Optional[str] = None) -> str:
+    """Map one contained exception to its :class:`FaultKind`."""
+    if isinstance(exc, FaultError):
+        return exc.kind
+    try:  # lazy: transport.codec is optional-dependency-adjacent
+        from dvf_tpu.transport.codec import JpegGeometryError
+
+        if isinstance(exc, JpegGeometryError):
+            return FaultKind.GEOMETRY
+    except Exception:  # noqa: BLE001 — classification must never raise
+        pass
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(m in msg for m in _OOM_MARKERS):
+        return FaultKind.OOM
+    if isinstance(exc, (TimeoutError,)):
+        return FaultKind.STALL
+    return _SITE_DEFAULT.get(site or "", FaultKind.INTERNAL)
+
+
+class FaultStats:
+    """Per-kind fault counters + last-error records (thread-safe).
+
+    One instance per pipeline/frontend/worker; ``summary()`` is embedded
+    in their ``stats()`` exports and the bench JSON so a BENCH round can
+    assert exact per-kind counts (zero, for a clean run).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+        self.last: Dict[str, dict] = {}
+
+    def record(self, kind: str, exc: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.last[kind] = {
+                "error": repr(exc) if exc is not None else None,
+                "ts": time.time(),
+            }
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return self.counts.get(kind, 0)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "total": sum(self.counts.values()),
+                "by_kind": dict(self.counts),
+                "last": {k: dict(v) for k, v in self.last.items()},
+            }

@@ -55,10 +55,14 @@ class Engine:
         filt: Filter,
         mesh: Optional[Mesh] = None,
         out_uint8: bool = True,
+        chaos=None,
     ):
         self.filter = filt
         self.mesh = mesh if mesh is not None else make_mesh()
         self.out_uint8 = out_uint8
+        self.chaos = chaos  # resilience.chaos.FaultPlan; armed test/replay
+        #   runs only — submit paths fire the "oom"/"compute" injection
+        #   sites through it (zero overhead when None)
         self.stats = EngineStats()
         self._exec_filter = filt   # possibly halo-wrapped in compile()
         self._step = None
@@ -240,6 +244,9 @@ class Engine:
         """
         if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
             self.compile(batch.shape, batch.dtype)
+        if self.chaos is not None:
+            self.chaos.fire("oom")
+            self.chaos.fire("compute")
         x = jax.device_put(batch, self._sharding)
         y, self._state = self._step(x, self._state)
         self.stats.batches += 1
@@ -257,6 +264,9 @@ class Engine:
         """
         if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
             self.compile(batch.shape, np.dtype(batch.dtype))
+        if self.chaos is not None:
+            self.chaos.fire("oom")
+            self.chaos.fire("compute")
         y, self._state = self._step(batch, self._state)
         self.stats.batches += 1
         self.stats.frames += batch.shape[0]
@@ -296,6 +306,21 @@ class Engine:
         if not (flops or byts):
             return None
         return {"flops_per_batch": flops, "bytes_accessed_per_batch": byts}
+
+    def rebuild(self) -> "Engine":
+        """Fresh engine for supervised recovery (resilience.supervisor):
+        same filter/mesh/options, recompiled at the old signature — the
+        full compile() path, so the replacement is re-warmed and its
+        ``h2d_block_ms`` re-calibrated before it takes traffic. A
+        stateful filter's temporal state restarts fresh (the wedged
+        engine's device-resident state is unrecoverable by definition).
+        """
+        fresh = Engine(self.filter, mesh=self.mesh, out_uint8=self.out_uint8,
+                       chaos=self.chaos)
+        if self._signature is not None:
+            shape, dtype = self._signature
+            fresh.compile(shape, dtype)
+        return fresh
 
     def reset_state(self) -> None:
         if self._exec_filter.stateful and self._signature is not None:

@@ -59,6 +59,7 @@ import numpy as np
 
 from dvf_tpu.obs.metrics import IngestStats
 from dvf_tpu.obs.trace import INGEST_H2D, INGEST_OVERLAP, INGEST_STAGE
+from dvf_tpu.resilience.faults import FaultError, FaultKind
 
 INGEST_MODES = ("streamed", "monolithic")
 
@@ -126,6 +127,7 @@ class ShardedBatchAssembler:
         tracer=None,
         track: int = 0,
         stats: Optional[IngestStats] = None,
+        chaos=None,
     ):
         if mode not in INGEST_MODES:
             raise ValueError(f"ingest mode must be one of {INGEST_MODES}, "
@@ -140,6 +142,9 @@ class ShardedBatchAssembler:
         self.slots = max(1, slots)
         self.tracer = tracer
         self.track = track
+        self.chaos = chaos  # resilience.chaos.FaultPlan — the "h2d"
+        #   injection site fires per shard put when armed (None = zero
+        #   overhead)
         self.stats = stats if stats is not None else IngestStats(
             requested_mode=mode, depth=depth)
         self._chunks: List[_Chunk] = []
@@ -240,6 +245,26 @@ class ShardedBatchAssembler:
         """Start staging one batch into the given staging-pool slot."""
         return BatchBuilder(self, slot % self.slots)
 
+    def release(self) -> None:
+        """Drop every staging buffer reference eagerly.
+
+        For an assembler abandoned mid-batch (the ZMQ worker's geometry
+        re-probe), the raising frame's traceback keeps the half-staged
+        builder — and through it this assembler and all its slabs —
+        alive for the whole retry, doubling peak staging memory until GC.
+        Releasing explicitly caps the overlap at zero; in-flight
+        ``device_put`` s keep their own references to the individual
+        slabs they read, so dropping ours is always safe. The assembler
+        is unusable afterwards (callers null their reference).
+        """
+        for c in self._chunks:
+            c.slabs = []
+        self._chunks = []
+        self._chunk_of_row = []
+        self._device_order = []
+        self._mono_pool = None
+        self._scratch = None
+
 
 class BatchBuilder:
     """Mutable per-batch staging state; produced by ``begin``, consumed by
@@ -335,14 +360,27 @@ class BatchBuilder:
 
         c = self.asm._chunks[ci]
         slabs = c.slabs[self.slot]
+        if self.asm.chaos is not None:
+            # Injection site "h2d": a delay rule stalls this put (models a
+            # congested link), a raise rule denies it — either way exactly
+            # where a real transfer fault would surface.
+            self.asm.chaos.fire("h2d")
         t0 = time.perf_counter()
         if self._first_put_t is None:
             self._first_put_t = t0
         arrs = []
-        for dev, key in c.targets:
-            arr = jax.device_put(slabs[key], dev)
-            self._parts[dev].append(arr)
-            arrs.append(arr)
+        try:
+            for dev, key in c.targets:
+                arr = jax.device_put(slabs[key], dev)
+                self._parts[dev].append(arr)
+                arrs.append(arr)
+        except Exception as e:  # noqa: BLE001 — carry the fault kind so
+            # containment classifies this as h2d (and can escalate to the
+            # streamed→monolithic fallback) instead of guessing from site.
+            raise FaultError(
+                FaultKind.H2D,
+                f"shard device_put failed for rows {c.start}:{c.stop}: "
+                f"{e!r}") from e
         t1 = time.perf_counter()
         self._put_s += t1 - t0
         tracer = self.asm.tracer

@@ -37,6 +37,9 @@ import numpy as np
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.obs.metrics import IngestStats, LatencyStats, RateLogger
 from dvf_tpu.obs.trace import Tracer
+from dvf_tpu.resilience.budget import ErrorBudget, escalate
+from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
+from dvf_tpu.resilience.supervisor import Supervisor
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.sched.queues import DropOldestQueue
@@ -78,6 +81,18 @@ class PipelineConfig:
     ingest_depth: int = 4         # dispatch-depth knob: how many shard
     #   transfers may be in flight before the assembler blocks on the
     #   oldest (also the sub-chunking granularity of a device's shard)
+    fault_budget: int = 16        # contained faults per kind inside
+    #   fault_window_s before containment escalates (resilience.budget:
+    #   drop → degrade → fail); resilient mode only
+    fault_window_s: float = 30.0
+    stall_timeout_s: float = 0.0  # >0: arm the stall watchdog
+    #   (resilience.supervisor) — an in-flight batch older than this trips
+    #   recovery (resilient + thread collect: shed the window, rebuild the
+    #   engine; otherwise: abort with a stall FaultError). 0 = off, the
+    #   pre-supervision behavior.
+    chaos: Any = None             # resilience.chaos.FaultPlan — arms the
+    #   deterministic fault-injection sites in the engine, assembler, and
+    #   collect loop (--chaos CLI spec); None = zero overhead
     device_trace_dir: Optional[str] = None  # capture a jax.profiler device
     #   trace for the whole run into this dir — Perfetto-compatible, views
     #   alongside the host-side frame-lifecycle trace (obs.trace) in one
@@ -114,7 +129,9 @@ class Pipeline:
             raise ValueError(
                 f"ingest must be one of {INGEST_MODES}, got "
                 f"{self.config.ingest!r}")
-        self.engine = engine or Engine(filt)
+        self.engine = engine or Engine(filt, chaos=self.config.chaos)
+        if self.config.chaos is not None and self.engine.chaos is None:
+            self.engine.chaos = self.config.chaos  # arm a caller-built engine
         self.tracer = Tracer(enabled=self.config.trace)
         # Injectable ingest queue: default is the Python drop-oldest queue;
         # `--transport ring` passes a transport.ring_queue.RingFrameQueue,
@@ -130,6 +147,24 @@ class Pipeline:
         self.latency = LatencyStats()
         self.frame_counter = 0
         self.errors = 0
+        self.faults = FaultStats()      # per-kind counters + last errors
+        self.recoveries = 0             # supervisor engine rebuilds
+        self._budget = ErrorBudget(limit=self.config.fault_budget,
+                                   window_s=self.config.fault_window_s)
+        # Stall escalation is consecutive, not time-windowed: stalls
+        # arrive at most once per stall_timeout_s, so a sliding window
+        # could never fill. Recoveries with no delivered batch in between
+        # (delivery resets the counter) fail hard — the pipeline cannot
+        # replace a permanently wedged collect thread, so it must not
+        # shed-rebuild at 0 fps forever.
+        self._stalls_since_progress = 0
+        self._stall_fail_after = max(2, self.config.fault_budget // 4)
+        self._ingest_mode = self.config.ingest  # may degrade to monolithic
+        #   after repeated h2d faults (budget escalation)
+        self._degrade_reason: Optional[str] = None
+        self._supervisor: Optional[Supervisor] = None
+        self._recovering = threading.Event()  # dispatch parks while the
+        #   supervisor swaps the engine/assembler (see _on_stall)
         _ti = self.config.telemetry_interval_s
         self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
         self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
@@ -209,17 +244,93 @@ class Pipeline:
 
     def _contain(self, e: BaseException, where: str) -> bool:
         """Resilient mode: drop, count, continue (the reference's
-        per-iteration ``except: continue``, distributor.py:249-251,287-289).
+        per-iteration ``except: continue``, distributor.py:249-251,287-289)
+        — but classified (resilience.faults) and bounded by the per-kind
+        error budget: the first overflow degrades (streamed→monolithic
+        ingest for h2d faults), the second fails hard, so a permanently
+        broken stage surfaces instead of shedding frames forever.
         Fail-fast mode: abort the pipeline. Returns True to continue."""
-        if self.config.resilient and isinstance(e, Exception):
-            self.errors += 1
+        kind = classify(e, site=where)
+        self.faults.record(kind, e)
+        if not (self.config.resilient and isinstance(e, Exception)):
+            self._fail(e)
+            return False
+        self.errors += 1
+        if escalate(self._budget, kind, self._degrade) == ErrorBudget.CONTAIN:
             # stderr: stdout is a data channel (one-JSON-line contract in
             # the bench stack and CLI).
-            print(f"[pipeline:{where}] error (continuing): {e!r}",
+            print(f"[pipeline:{where}] {kind} fault (continuing): {e!r}",
                   file=sys.stderr, flush=True)
             return True
-        self._fail(e)
+        self._fail(FaultError(
+            kind,
+            f"error budget exhausted for {kind!r} faults "
+            f"(> {self.config.fault_budget} in "
+            f"{self.config.fault_window_s:g}s, no degradation left); "
+            f"last: {e!r}"))
         return False
+
+    def _degrade(self, kind: str) -> bool:
+        """Apply this kind's degradation, if one exists. h2d: fall back
+        from streamed to monolithic ingest (the same auto-degrade the
+        assembler does for replicated layouts, here forced by fault
+        pressure — reason recorded in the ingest stats). Returns True if
+        a degradation was applied."""
+        if kind == FaultKind.H2D and self._ingest_mode == "streamed":
+            self._ingest_mode = "monolithic"
+            self._degrade_reason = "h2d_fault_budget"
+            self._assembler = None  # rebuilt monolithic on the next batch
+            print("[pipeline] repeated h2d faults: degrading ingest "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
+        return False
+
+    def _on_stall(self, reason: str) -> None:
+        """Watchdog callback (supervisor thread): a submitted batch aged
+        past stall_timeout_s. Resilient + thread-collect: shed the
+        in-flight window (results written off, permits restored) and
+        rebuild the engine — recompile, re-warm, re-calibrate — so a
+        wedged device program can't freeze the stream forever. Inline
+        collect (the dispatch thread is the one wedged) or fail-fast:
+        abort with a stall fault."""
+        e = FaultError(FaultKind.STALL, f"pipeline stalled: {reason}")
+        self.faults.record(FaultKind.STALL, e)
+        self._stalls_since_progress += 1
+        recoverable = (self.config.resilient
+                       and self.config.collect_mode == "thread"
+                       and self._stalls_since_progress <= self._stall_fail_after)
+        if not recoverable:
+            self._fail(e)
+            return
+        self.errors += 1
+        print(f"[pipeline] {reason}: shedding in-flight window and "
+              f"rebuilding engine", file=sys.stderr, flush=True)
+        # Park dispatch (it checks the flag between assembling and
+        # staging): a batch submitted mid-recovery would route through
+        # the old wedged engine and manufacture a follow-on stall. A
+        # dispatch iteration already inside the staging/submit block
+        # cannot be interrupted — its batch lands in the window and the
+        # watchdog's next trip sheds it.
+        self._recovering.set()
+        try:
+            shed = self._inflight.pop_up_to(len(self._inflight))
+            for item in shed:
+                self._supervisor.window.remove(item[0])
+            # Rebuild BEFORE releasing the shed permits, so a dispatch
+            # blocked on the semaphore wakes to the fresh engine.
+            self.engine = self.engine.rebuild()
+            self._assembler = None
+            for _ in shed:
+                self._inflight_sem.release()
+            # A batch already popped by collect and still materializing
+            # stays tracked only by that thread — its permit comes back
+            # when np.asarray returns/raises there; clear its window
+            # entry so the watchdog doesn't immediately re-trip on the
+            # batch being shed.
+            self._supervisor.window.drain()
+            self.recoveries += 1
+        finally:
+            self._recovering.clear()
 
     def _assemble(self) -> Optional[list]:
         """Collect up to batch_size fresh frames; None = stream finished.
@@ -280,10 +391,14 @@ class Pipeline:
                 h2d_block_ms=self.engine.h2d_block_ms)
             self._assembler = asm = ShardedBatchAssembler(
                 shape, dtype, self.engine.input_sharding,
-                mode=self.config.ingest, depth=self.config.ingest_depth,
+                mode=self._ingest_mode, depth=self.config.ingest_depth,
                 slots=self.config.max_inflight + 1,
                 tracer=self.tracer, track=TRACK_H2D,
-                stats=self._ingest_stats)
+                stats=self._ingest_stats, chaos=self.config.chaos)
+            if self._degrade_reason is not None:
+                # Budget-forced monolithic fallback: record why, like the
+                # assembler's own replicated_layout/cheap_transfer reasons.
+                self._ingest_stats.fallback_reason = self._degrade_reason
         return asm.begin(slot)
 
     def _drain_ready(self, pending: "deque") -> bool:
@@ -295,7 +410,7 @@ class Pipeline:
         while pending:
             if len(pending) < self.config.max_inflight:
                 try:
-                    ready = pending[0][2].is_ready()
+                    ready = pending[0][3].is_ready()
                 except AttributeError:  # non-jax result (tests/fakes)
                     break
                 except Exception:  # noqa: BLE001 — poisoned async result:
@@ -323,6 +438,12 @@ class Pipeline:
                     break
                 if not items:
                     continue
+                while self._recovering.is_set() and not self._abort.is_set():
+                    # Stall recovery is swapping the engine/assembler:
+                    # park with the assembled frames in hand — submitting
+                    # now would route them through the old wedged engine
+                    # mid-rebuild and manufacture a follow-on stall.
+                    time.sleep(0.001)
                 valid = len(items)
                 if inline:
                     # Single-consumer mode: collect in-flight batches HERE
@@ -385,12 +506,17 @@ class Pipeline:
                     if not self._contain(e, "dispatch"):
                         return
                     continue
-                seq += 1
+                if self._supervisor is not None:
+                    # Watchdog window: this batch is now in flight; the
+                    # collect side removes it once materialized (either
+                    # way), so its age is the stall signal.
+                    self._supervisor.window.add(seq)
                 meta = [(idx, ts) for idx, _, ts in items]
                 if inline:
-                    pending.append((meta, valid, result, t0))
+                    pending.append((seq, meta, valid, result, t0))
                 else:
-                    self._inflight.put((meta, valid, result, t0))
+                    self._inflight.put((seq, meta, valid, result, t0))
+                seq += 1
             # Inline mode: drain the window (graceful stop / end of
             # stream). Hard abort drops it, matching the collect thread.
             while pending and not self._abort.is_set():
@@ -401,15 +527,20 @@ class Pipeline:
         finally:
             self._dispatch_done.set()
 
-    def _collect_one(self, meta, valid, result, t0, release=True) -> bool:
+    def _collect_one(self, seq, meta, valid, result, t0, release=True) -> bool:
         """Materialize one batch into the reorder buffer + sink; returns
         False only when an error escaped containment."""
         try:
             out = np.asarray(result)  # blocks until the device is done
         except Exception as e:  # noqa: BLE001 — device error: drop batch
+            if self._supervisor is not None:
+                self._supervisor.window.remove(seq)
             if release:
                 self._inflight_sem.release()
             return self._contain(e, "collect")
+        if self._supervisor is not None:
+            self._supervisor.window.remove(seq)
+            self._stalls_since_progress = 0  # engine made real progress
         if release:
             self._inflight_sem.release()
         t1 = time.time()
@@ -423,8 +554,13 @@ class Pipeline:
         return True
 
     def _collect(self) -> None:
+        chaos = self.config.chaos
         try:
             while not self._abort.is_set():
+                if chaos is not None:
+                    chaos.fire("freeze")  # injection site: a delay rule
+                    #   wedges this consumer so the stall watchdog has a
+                    #   deterministic stall to catch
                 try:
                     item = self._inflight.get(timeout=0.05)
                 except TimeoutError:
@@ -473,6 +609,10 @@ class Pipeline:
         if self.config.collect_mode != "inline":
             threads.append(
                 threading.Thread(target=self._collect, name="dvf-collect", daemon=True))
+        if self.config.stall_timeout_s > 0:
+            self._supervisor = Supervisor(
+                self.config.stall_timeout_s, on_stall=self._on_stall,
+                name="dvf-pipeline-supervisor").start()
         try:
             for t in threads:
                 t.start()
@@ -492,6 +632,8 @@ class Pipeline:
                               file=sys.stderr, flush=True)
                         self.stop()
         finally:
+            if self._supervisor is not None:
+                self._supervisor.stop()
             # Always stop the profiler — the abort path (double Ctrl-C /
             # escaping exception) is exactly the run someone inspects.
             if device_tracing:
@@ -544,8 +686,15 @@ class Pipeline:
             "errors": self.errors,
             "delivered": self.latency.count,
             "engine_batches": self.engine.stats.batches,
+            # Classified fault counters + last-error records and the
+            # number of supervisor engine rebuilds (resilience.faults) —
+            # what a BENCH round asserts zero-unexpected-faults against.
+            "faults": self.faults.summary(),
+            "recoveries": self.recoveries,
             **self.latency.summary(),
         }
         if self._ingest_stats is not None:
             out["ingest"] = self._ingest_stats.summary()
+        if self.config.chaos is not None:
+            out["chaos"] = self.config.chaos.summary()
         return out

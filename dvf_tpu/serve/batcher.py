@@ -48,6 +48,9 @@ class BatchPlan:
     batch: Optional[np.ndarray]
     valid: int
     slots: List[Slot]
+    dead: bool = False  # set by supervisor recovery (or a discard) when
+    #   the plan's claims were already released — a late result/second
+    #   discard for a dead plan must not double-account the sessions
 
 
 class ContinuousBatcher:

@@ -16,6 +16,8 @@ export merges those per-stream samples on demand
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from dvf_tpu.serve.batcher import BatchPlan
@@ -28,6 +30,14 @@ class ResultRouter:
         self.batches = 0
         self.frames = 0
         self.late_after_close = 0  # results for hard-closed sessions
+        self.late_after_recovery = 0  # results for plans the supervisor
+        #   already wrote off (their sessions' claims were released at
+        #   recovery; routing them now would double-account)
+        self._dead_lock = threading.Lock()  # makes the plan.dead
+        #   check-then-set atomic: recovery (supervisor thread) and a
+        #   waking superseded collect thread may discard the same plan
+        #   concurrently, and a double discard_inflight would drive
+        #   session.inflight negative
 
     def route(self, plan: BatchPlan, out: np.ndarray) -> int:
         """Demux one completed batch; returns frames delivered.
@@ -38,6 +48,13 @@ class ResultRouter:
         out_queue_size full batches (batch_size× amplification) instead
         of out_queue_size frames.
         """
+        with self._dead_lock:
+            if plan.dead:
+                self.late_after_recovery += 1
+                return 0
+            plan.dead = True  # consumed — a recovery discard racing this
+            #   route (the plan was still in the supervisor window) must
+            #   become a no-op, not a second release of the same claims
         touched = []
         for row, slot in enumerate(plan.slots[: plan.valid]):
             s = slot.session
@@ -53,18 +70,26 @@ class ResultRouter:
         self.frames += plan.valid
         return delivered
 
-    def discard(self, plan: BatchPlan) -> None:
+    def discard(self, plan: BatchPlan, kind: str = None) -> None:
         """A device batch failed; release its sessions' in-flight claims
-        so a closing session can still finalize."""
+        so a closing session can still finalize. ``kind`` (a FaultKind)
+        attributes the loss in each session's per-kind fault counters;
+        None for non-fault discards (shutdown). Idempotent: a plan
+        already written off (supervisor recovery) is skipped."""
+        with self._dead_lock:
+            if plan.dead:
+                return
+            plan.dead = True
         per_session = {}
         for slot in plan.slots[: plan.valid]:
             per_session[slot.session] = per_session.get(slot.session, 0) + 1
         for s, n in per_session.items():
-            s.discard_inflight(n)
+            s.discard_inflight(n, kind=kind)
 
     def stats(self) -> dict:
         return {
             "batches": self.batches,
             "frames": self.frames,
             "late_after_close": self.late_after_close,
+            "late_after_recovery": self.late_after_recovery,
         }

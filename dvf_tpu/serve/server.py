@@ -45,6 +45,9 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.obs.metrics import IngestStats, LatencyStats
+from dvf_tpu.resilience.budget import ErrorBudget, escalate
+from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
+from dvf_tpu.resilience.supervisor import InflightWindow, Supervisor
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.serve.batcher import BatchPlan, ContinuousBatcher
@@ -75,6 +78,19 @@ class ServeConfig:
     tick_s: float = 0.002         # dispatch idle poll
     resilient: bool = True        # one bad batch is dropped + counted;
     #   serving keeps going (live-mode semantics, like Pipeline.resilient)
+    fault_budget: int = 16        # contained faults per kind inside
+    #   fault_window_s before escalation (resilience.budget): first
+    #   overflow degrades (h2d → monolithic ingest; compute/oom →
+    #   supervised engine rebuild), second surfaces a hard ServeError —
+    #   a permanently broken engine must not become a silent 0-fps server
+    fault_window_s: float = 30.0
+    stall_timeout_s: float = 30.0  # >0: stall watchdog over the in-flight
+    #   window (resilience.supervisor) — a submitted batch older than this
+    #   triggers recovery: shed the window, rebuild the engine (recompile,
+    #   re-warm, re-calibrate), replace a wedged collect thread; open
+    #   sessions survive with their frame index spaces intact. 0 = off.
+    chaos: Any = None             # resilience.chaos.FaultPlan — arms the
+    #   engine/assembler/collect injection sites (--chaos CLI spec)
     ingest: str = "streamed"      # "streamed": stage chosen frames into
     #   per-device-shard slabs, device_put each shard as it fills, submit
     #   the already-resident batch (runtime/ingest.py — the same streamed
@@ -104,7 +120,9 @@ class ServeFrontend:
             raise ValueError(
                 f"ingest must be one of {INGEST_MODES}, got "
                 f"{self.config.ingest!r}")
-        self.engine = engine or Engine(filt)
+        self.engine = engine or Engine(filt, chaos=self.config.chaos)
+        if self.config.chaos is not None and self.engine.chaos is None:
+            self.engine.chaos = self.config.chaos  # arm caller-built engine
         self.batcher = ContinuousBatcher(self.config.batch_size)
         self.router = ResultRouter()
         self._lock = threading.Lock()
@@ -113,6 +131,30 @@ class ServeFrontend:
         self._ids = itertools.count()
         self.admission_rejections = 0
         self.errors = 0
+        self.faults = FaultStats()   # per-kind counters + last errors
+        self.recoveries = 0          # supervised engine rebuilds
+        self._budget = ErrorBudget(limit=self.config.fault_budget,
+                                   window_s=self.config.fault_window_s)
+        # Stall escalation is NOT time-windowed: stalls arrive at most
+        # once per stall_timeout_s, so a sliding window can never fill.
+        # Instead, consecutive recoveries with no successful batch in
+        # between count up; a materialized batch resets the run. Past the
+        # threshold the engine is declared unrecoverable.
+        self._stalls_since_progress = 0
+        self._stall_fail_after = max(2, self.config.fault_budget // 4)
+        # In-flight registry (submit → materialize/discard), maintained
+        # even with the watchdog off: budget-driven recovery must be able
+        # to shed batches a wedged collect thread is holding.
+        self._window = InflightWindow()
+        self._ingest_mode = self.config.ingest  # may degrade to monolithic
+        self._degrade_reason: Optional[str] = None
+        self._supervisor: Optional[Supervisor] = None
+        self._recovering = threading.Event()  # dispatch parks while set
+        self._dispatch_parked = threading.Event()  # ack of that park
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._recover_lock = threading.Lock()
+        self._collect_gen = 0  # bumped by recovery; a stale collect thread
+        #   exits at its next loop check (and a wedged one, when it wakes)
         self._frame_shape: Optional[tuple] = None  # pinned at first submit
         self._frame_dtype = None
         self._assembler: Optional[ShardedBatchAssembler] = None
@@ -136,18 +178,27 @@ class ServeFrontend:
             threading.Thread(target=self._dispatch, name="dvf-serve-dispatch",
                              daemon=True),
             threading.Thread(target=self._collect, name="dvf-serve-collect",
-                             daemon=True),
+                             daemon=True, args=(0,)),
         ]
+        self._dispatch_thread = self._threads[0]
         for t in self._threads:
             t.start()
+        if self.config.stall_timeout_s > 0:
+            self._supervisor = Supervisor(
+                self.config.stall_timeout_s, on_stall=self._on_stall,
+                name="dvf-serve-supervisor", window=self._window)
+            self._supervisor.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
         """Graceful shutdown: stop batching new work, drain what's in
         flight, deliver every session's tail, retire all sessions."""
         self._stop.set()
+        if self._supervisor is not None:
+            self._supervisor.stop()
         for t in self._threads:
-            t.join(timeout=timeout)
+            if t is not threading.current_thread():
+                t.join(timeout=timeout)
         with self._lock:
             sessions = list(self._sessions.items())
             for sid, s in sessions:
@@ -198,6 +249,12 @@ class ServeFrontend:
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
         """Enqueue one frame on a stream; returns its per-stream index."""
+        if self._error is not None:
+            # The service threads died (error budget exhausted / fail-fast
+            # fault): surface it to the submitting client instead of
+            # queueing frames nothing will ever serve.
+            raise ServeError(
+                f"frontend failed: {self._error!r}") from self._error
         if self._frame_shape is None:
             with self._lock:
                 if self._frame_shape is None:
@@ -270,9 +327,11 @@ class ServeFrontend:
                 h2d_block_ms=self.engine.h2d_block_ms)
             self._assembler = ShardedBatchAssembler(
                 shape, dtype, self.engine.input_sharding,
-                mode=self.config.ingest, depth=self.config.ingest_depth,
+                mode=self._ingest_mode, depth=self.config.ingest_depth,
                 slots=self.config.max_inflight + 1,
-                stats=self._ingest_stats)
+                stats=self._ingest_stats, chaos=self.config.chaos)
+            if self._degrade_reason is not None:
+                self._ingest_stats.fallback_reason = self._degrade_reason
         return self._assembler.begin(seq)
 
     def _fail(self, e: BaseException) -> None:
@@ -281,13 +340,157 @@ class ServeFrontend:
         self._stop.set()
 
     def _contain(self, e: BaseException, where: str) -> bool:
-        if self.config.resilient and isinstance(e, Exception):
-            self.errors += 1
-            print(f"[serve:{where}] error (continuing): {e!r}",
+        """Bounded containment (resilience.budget): classify, count,
+        continue while within the per-kind budget; the first overflow
+        degrades (h2d → monolithic ingest, compute/oom → supervised
+        engine rebuild), the second surfaces a hard ServeError — a
+        permanently broken engine must not serve 0 fps silently."""
+        kind = classify(e, site=where)
+        self.faults.record(kind, e)
+        if not (self.config.resilient and isinstance(e, Exception)):
+            self._fail(e)
+            return False
+        self.errors += 1
+        if escalate(self._budget, kind, self._degrade) == ErrorBudget.CONTAIN:
+            print(f"[serve:{where}] {kind} fault (continuing): {e!r}",
                   file=sys.stderr, flush=True)
             return True
-        self._fail(e)
+        self._fail(ServeError(
+            f"error budget exhausted for {kind!r} faults "
+            f"(> {self.config.fault_budget} in "
+            f"{self.config.fault_window_s:g}s, after degradation); "
+            f"last: {e!r}"))
         return False
+
+    def _degrade(self, kind: str) -> bool:
+        """First-overflow degradation per kind. Returns True if applied
+        (the fault is then still contained; a second overflow fails)."""
+        if kind == FaultKind.H2D and self._ingest_mode == "streamed":
+            self._ingest_mode = "monolithic"
+            self._degrade_reason = "h2d_fault_budget"
+            self._assembler = None
+            print("[serve] repeated h2d faults: degrading ingest "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
+        if kind in (FaultKind.COMPUTE, FaultKind.OOM, FaultKind.INTERNAL):
+            # The engine itself may be the broken thing (poisoned compile
+            # cache, leaked device state): rebuild it once. If the fresh
+            # engine still faults through a second budget window, the
+            # filter/input is broken, not the engine — FAIL.
+            self._recover(f"fault budget overflow ({kind})", kind=kind)
+            return True
+        return False
+
+    def _on_stall(self, reason: str) -> None:
+        """Watchdog callback (supervisor thread): a submitted batch aged
+        past stall_timeout_s without materializing."""
+        e = FaultError(FaultKind.STALL, f"serve stalled: {reason}")
+        self.faults.record(FaultKind.STALL, e)
+        if not self.config.resilient:
+            self._fail(e)
+            return
+        self.errors += 1
+        # Stall escalation is consecutive, not time-windowed: stalls
+        # arrive at most once per stall_timeout_s, so a sliding window
+        # could never fill — instead, recoveries that never restore
+        # service (no batch materializes in between, which would reset
+        # the counter in _collect) declare the engine unrecoverable.
+        self._stalls_since_progress += 1
+        if self._stalls_since_progress > self._stall_fail_after:
+            self._fail(ServeError(
+                f"{self._stalls_since_progress} consecutive stall "
+                f"recoveries without a served batch (engine "
+                f"unrecoverable): {reason}"))
+            return
+        self._recover(reason, kind=FaultKind.STALL)
+
+    def _recover(self, reason: str, kind: str = FaultKind.STALL) -> None:
+        """Supervised recovery: shed the in-flight window (each lost
+        frame attributed to ``kind`` in its session's fault counters),
+        replace the collect thread (a wedged one exits when it wakes —
+        generation check), rebuild the Engine (recompile, re-warm,
+        re-calibrate h2d_block_ms), and reset the in-flight semaphore.
+        Open sessions are untouched: their frame index spaces, reorder
+        cursors, and out queues survive, so indices stay monotone across
+        the recovery. Runs in whichever thread detected the fault
+        (supervisor, dispatch, or collect); serialized by _recover_lock.
+        """
+        with self._recover_lock:
+            if self._stop.is_set():
+                return
+            print(f"[serve] recovering engine ({reason}): shedding "
+                  f"in-flight window, rebuilding engine",
+                  file=sys.stderr, flush=True)
+            self._recovering.set()
+            try:
+                # Wait (bounded) for the dispatch thread to park, unless
+                # WE are the dispatch thread (then it's here, not mid-
+                # staging): a straddling iteration could otherwise put a
+                # batch into the old queue after the drain below. If it's
+                # wedged past the deadline, any straggler is caught by
+                # the watchdog window on the next trip.
+                if threading.current_thread() is not self._dispatch_thread:
+                    deadline = time.monotonic() + 2.0
+                    while (not self._dispatch_parked.is_set()
+                           and not self._stop.is_set()
+                           and time.monotonic() < deadline):
+                        time.sleep(0.002)
+                old_q = self._inflight
+                while True:  # shed everything queued for collection
+                    try:
+                        seq, plan, _result, _t0 = old_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    self.router.discard(plan, kind=kind)
+                    self._window.remove(seq)
+                # Batches popped by a wedged collect but never routed:
+                # write them off too (route() skips dead plans if that
+                # thread ever wakes up holding one). The window is owned
+                # by the frontend, so this works with the watchdog off.
+                for _seq, plan in self._window.drain():
+                    if plan is not None:
+                        self.router.discard(plan, kind=kind)
+                # Fresh queue + semaphore BEFORE the replacement collect
+                # thread starts: generation-pinning means the old thread
+                # only ever sees the old (now drained) queue, and permits
+                # held by shed batches die with the old semaphore instead
+                # of leaking into (or over-crediting) the new window.
+                self._inflight = queue.Queue()
+                self._inflight_sem = threading.Semaphore(
+                    self.config.max_inflight)
+                # Replace the collect thread; a live one exits at its next
+                # generation check, a wedged one whenever it wakes. Prune
+                # exited threads first — a long-lived server recovering
+                # through intermittent fault bursts must not accumulate
+                # one dead Thread per recovery forever.
+                self._collect_gen += 1
+                t = threading.Thread(
+                    target=self._collect, name="dvf-serve-collect",
+                    daemon=True, args=(self._collect_gen,))
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+                t.start()
+                self.engine = self.engine.rebuild()
+                self._assembler = None
+                # Second straggler sweep: a dispatch iteration that was
+                # mid-staging when the drain above ran (wedged past the
+                # park deadline) has had the whole engine rebuild to land
+                # its put into the abandoned queue/window — write it off
+                # now so its sessions' claims never leak even with the
+                # watchdog (whose next trip would otherwise catch it) off.
+                while True:
+                    try:
+                        seq, plan, _result, _t0 = old_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    self.router.discard(plan, kind=kind)
+                    self._window.remove(seq)
+                for _seq, plan in self._window.drain():
+                    if plan is not None:
+                        self.router.discard(plan, kind=kind)
+                self.recoveries += 1
+            finally:
+                self._recovering.clear()
 
     def _finalize_drained(self) -> None:
         """Retire closing sessions with nothing left queued or in flight
@@ -305,6 +508,16 @@ class ServeFrontend:
         seq = 0
         try:
             while not self._stop.is_set():
+                if self._recovering.is_set():
+                    # Supervised recovery in progress: park — the engine,
+                    # queue, and semaphore are being replaced under us.
+                    # _recover waits for this flag before touching them.
+                    self._dispatch_parked.set()
+                    time.sleep(self.config.tick_s)
+                    continue
+                self._dispatch_parked.clear()
+                if self._supervisor is not None:
+                    self._supervisor.beat("dispatch")
                 with self._lock:
                     sessions = [s for s in self._sessions.values()
                                 if s.state != CLOSED]
@@ -326,11 +539,32 @@ class ServeFrontend:
                 # Bounded in-flight depth; poll so shutdown can't wedge on
                 # a dead collect thread. Acquired before any staging
                 # buffer is touched — the permit is what makes
-                # staging/slab reuse safe.
-                while not self._inflight_sem.acquire(timeout=0.1):
+                # staging/slab reuse safe. The semaphore AND queue are
+                # captured per iteration: recovery installs fresh ones,
+                # and a batch must live entirely in one generation — a
+                # straddler releasing a permit into the NEW semaphore
+                # would over-credit the window (one extra batch in flight
+                # breaks the staging pool's max_inflight+1 reuse contract).
+                sem = self._inflight_sem
+                acquired = False
+                while True:
+                    if sem.acquire(timeout=0.1):
+                        acquired = True
+                        break
                     if self._stop.is_set():
                         self.router.discard(plan)
                         return
+                    if self._recovering.is_set():
+                        break  # shed below, then park at the loop top
+                    sem = self._inflight_sem
+                if not acquired or sem is not self._inflight_sem:
+                    # Recovery started while we waited (or swapped the
+                    # semaphore right after our acquire): shed this plan
+                    # into the recovery's accounting rather than staging
+                    # into structures being torn down.
+                    self.router.discard(plan, kind=FaultKind.STALL)
+                    continue
+                q = self._inflight
                 t0 = time.time()
                 try:
                     builder = self._builder_for(seq)
@@ -345,37 +579,78 @@ class ServeFrontend:
                     except AttributeError:
                         pass
                 except Exception as e:  # noqa: BLE001 — drop this batch
-                    self._inflight_sem.release()
-                    self.router.discard(plan)
+                    sem.release()
+                    self.router.discard(plan, kind=classify(e, "dispatch"))
                     if not self._contain(e, "dispatch"):
                         return
                     continue
+                # In-flight window: registered from now until the collect
+                # side materializes (or discards) it; carries the plan so
+                # a recovery can shed the sessions' claims even for a
+                # batch a wedged collect thread is holding. The watchdog
+                # (when armed) trips on this window's oldest age.
+                self._window.add(seq, plan)
+                q.put((seq, plan, result, t0))
                 seq += 1
-                self._inflight.put((plan, result, t0))
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
         finally:
             self._dispatch_done.set()
 
-    def _collect(self) -> None:
+    def _collect(self, gen: int = 0) -> None:
+        chaos = self.config.chaos
+        q = self._inflight  # generation-pinned: recovery installs a fresh
+        #   queue before starting the replacement thread, so a superseded
+        #   thread can never pop (and then wrongly discard) a
+        #   post-recovery batch — it only ever sees its own, drained,
+        #   queue and whatever single item it was already holding.
+        sem = self._inflight_sem  # pinned with the queue: a permit must be
+        #   released into the semaphore it was acquired from — releasing
+        #   the live attribute would over-credit a post-recovery window
         try:
-            while True:
+            while self._collect_gen == gen:  # superseded by recovery → exit
+                if chaos is not None:
+                    chaos.fire("freeze")  # injection site: a delay rule
+                    #   wedges this consumer (deterministic stall for the
+                    #   watchdog tests)
+                if self._supervisor is not None:
+                    self._supervisor.beat("collect")
                 try:
-                    plan, result, _t0 = self._inflight.get(timeout=0.05)
+                    seq, plan, result, _t0 = q.get(timeout=0.05)
                 except queue.Empty:
-                    if self._dispatch_done.is_set() and self._inflight.empty():
+                    if self._dispatch_done.is_set() and q.empty():
                         break
                     continue
                 try:
                     out = np.asarray(result)  # waits for the device
                 except Exception as e:  # noqa: BLE001 — poisoned batch
-                    self._inflight_sem.release()
-                    self.router.discard(plan)
+                    if self._collect_gen != gen:
+                        # Superseded mid-wait: make sure the plan's
+                        # session claims are released — discard is
+                        # idempotent, so this is a no-op when recovery
+                        # already shed it.
+                        self.router.discard(plan)
+                        continue
+                    self._window.remove(seq)
+                    sem.release()
+                    self.router.discard(plan, kind=classify(e, "collect"))
                     if not self._contain(e, "collect"):
                         return
                     continue
-                self._inflight_sem.release()
+                if self._collect_gen != gen:
+                    # Recovery wrote this batch off while we materialized
+                    # it: drop the result (semaphore replaced, no release)
+                    # but release the session claims if the recovery could
+                    # not see this plan (it was popped, so only the
+                    # supervisor window — when armed — tracked it).
+                    self.router.discard(plan)
+                    continue
+                self._window.remove(seq)
+                sem.release()
                 self.router.route(plan, out)
+                # A materialized batch is proof of engine progress: the
+                # consecutive-stall escalation counter starts over.
+                self._stalls_since_progress = 0
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
 
@@ -399,6 +674,13 @@ class ServeFrontend:
             # from the retention bound leave the sum.
             "shed_total": sum(s["shed"] for s in session_stats.values()),
             "errors": self.errors,
+            # Classified per-kind fault counters + last errors, budget
+            # escalation levels, and supervised recoveries — the fleet
+            # half of the fault model (per-tenant attribution is in each
+            # session row's "faults").
+            "faults": self.faults.summary(),
+            "fault_budget": self._budget.summary(),
+            "recoveries": self.recoveries,
             "engine_batches": self.engine.stats.batches,
             "engine_frames": self.engine.stats.frames,
             **self.router.stats(),
@@ -406,6 +688,12 @@ class ServeFrontend:
                 [s.latency for s in every.values()]),
             **({"ingest": self._ingest_stats.summary()}
                if self._ingest_stats is not None else {}),
+            **({"supervisor": {
+                    "stalls": self._supervisor.stalls,
+                    "heartbeat_ages_s": self._supervisor.heartbeat_ages(),
+                }} if self._supervisor is not None else {}),
+            **({"chaos": self.config.chaos.summary()}
+               if self.config.chaos is not None else {}),
         }
 
 

@@ -136,6 +136,9 @@ class StreamSession:
         self.shed = 0           # frames dropped for a blown SLO deadline
         self.slo_miss = 0       # delivered, but past the SLO budget
         self.failed = 0         # frames lost to a failed device batch
+        self.faults: dict = {}  # the same losses, classified by FaultKind
+        #   (resilience.faults) — per-tenant fault attribution, poll-able
+        #   through stats() beside the aggregate counters
         self.sink_errors = 0    # contained per-frame sink failures
         self._last_deadline = float("-inf")
 
@@ -238,14 +241,17 @@ class StreamSession:
             if self.state != CLOSED:  # late result after hard close: dropped
                 self.reorder.complete(slot.index, (frame, slot.ts, slot.tag))
 
-    def discard_inflight(self, n: int = 1) -> None:
+    def discard_inflight(self, n: int = 1, kind: str = None) -> None:
         """A device batch failed; its slots never produced results.
-        Counted (``failed``) so the per-session accounting identity
-        submitted == delivered + shed + failed + dropped_at_ingress
-        still reconciles after contained errors."""
+        Counted (``failed``, and per fault ``kind`` when one is given —
+        shutdown discards pass None) so the per-session accounting
+        identity submitted == delivered + shed + failed +
+        dropped_at_ingress still reconciles after contained errors."""
         with self._lock:
             self.inflight -= n
             self.failed += n
+            if kind is not None:
+                self.faults[kind] = self.faults.get(kind, 0) + n
 
     def deliver_ready(self) -> int:
         """Advance the reorder cursor and emit everything ready; returns
@@ -330,6 +336,7 @@ class StreamSession:
                 "shed": self.shed,
                 "slo_miss": self.slo_miss,
                 "failed": self.failed,
+                "faults": dict(self.faults),
                 "sink_errors": self.sink_errors,
                 "dropped_at_ingress": self.ingress.dropped,
                 "dropped_unpolled": self.out.dropped,  # delivered but

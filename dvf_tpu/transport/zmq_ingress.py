@@ -31,6 +31,8 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.obs.metrics import IngestStats
+from dvf_tpu.resilience.budget import ErrorBudget, escalate
+from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.transport.codec import JpegGeometryError, make_codec
@@ -89,6 +91,9 @@ class TpuZmqWorker:
         transport: str = "list",
         ingest: str = "streamed",
         ingest_depth: int = 4,
+        fault_budget: int = 16,
+        fault_window_s: float = 30.0,
+        chaos=None,
     ):
         import zmq
 
@@ -115,10 +120,20 @@ class TpuZmqWorker:
         self.push.connect(f"tcp://{host}:{collect_port}")
         self._zmq = zmq
         self.filt = filt
-        self.engine = engine or Engine(filt)
+        self.chaos = chaos  # resilience.chaos.FaultPlan ("decode" and
+        #   "transport" injection sites live here; "h2d"/"compute"/"oom"
+        #   ride on the engine and assembler)
+        self.engine = engine or Engine(filt, chaos=chaos)
+        if chaos is not None and self.engine.chaos is None:
+            self.engine.chaos = chaos
         self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
         self.ingest = ingest
         self.ingest_depth = ingest_depth
+        self.faults = FaultStats()
+        self.fault_budget = fault_budget
+        self.fault_window_s = fault_window_s
+        self._budget = ErrorBudget(limit=fault_budget, window_s=fault_window_s)
+        self._degrade_reason: Optional[str] = None
         self._asm: Optional[ShardedBatchAssembler] = None  # per-geometry
         #   staged-batch assembler (_process_batch); replaces the old raw
         #   staging buffer — slabs are reused across batches identically
@@ -181,7 +196,9 @@ class TpuZmqWorker:
             self._asm = ShardedBatchAssembler(
                 shape, np.uint8, self.engine.input_sharding,
                 mode=self.ingest, depth=self.ingest_depth, slots=1,
-                stats=self._ingest_stats)
+                stats=self._ingest_stats, chaos=self.chaos)
+            if self._degrade_reason is not None:
+                self._ingest_stats.fallback_reason = self._degrade_reason
         return self._asm.begin(0)
 
     def _decode_jpeg(self, blobs, valid):
@@ -216,23 +233,56 @@ class TpuZmqWorker:
         # (the cv2 fallback codec's probe() is a full decode — probing
         # every batch would double-decode the first frame on that path).
         if self.use_jpeg:
+            if self.chaos is not None:
+                # Injection site "decode": one event per blob; a firing
+                # rule mangles that blob so the codec rejects it.
+                blobs = [self.chaos.corrupt("decode", b) for b in blobs]
             try:
                 batch, resident = self._decode_jpeg(blobs, valid)
-            except JpegGeometryError:
+            except JpegGeometryError as ge:
                 # Stream geometry changed (the app restarted with a new
                 # target_size): re-probe, rebuild the assembler, retry
                 # once. Corrupt streams raise plain ValueError and go
                 # straight to run()'s containment — no wasted second
-                # decode. The abandoned half-staged builder is dropped
-                # with its assembler.
-                self._asm = None
+                # decode. Counted under the geometry fault kind (a
+                # geometry *storm* — a flapping producer — exhausts its
+                # budget and fails instead of re-probing forever).
+                self.faults.record(FaultKind.GEOMETRY, ge)
+                # The re-probe IS the containment, so the degrade tier
+                # keeps re-probing; only the second overflow fails.
+                if (escalate(self._budget, FaultKind.GEOMETRY,
+                             lambda _k: True) == ErrorBudget.FAIL):
+                    raise FaultError(
+                        FaultKind.GEOMETRY,
+                        f"geometry fault budget exhausted "
+                        f"(> {self.fault_budget} re-probes in "
+                        f"{self.fault_window_s:g}s): {ge!r}",
+                        fatal=True) from ge
+                # Release the abandoned half-staged assembler's slabs
+                # explicitly: the raising frame's traceback pins the
+                # builder (and through it every slab) for the whole
+                # retry, doubling peak staging memory until GC otherwise.
+                old, self._asm = self._asm, None
+                if old is not None:
+                    old.release()
                 batch, resident = self._decode_jpeg(blobs, valid)
+            except FaultError:
+                raise  # already classified (h2d from the assembler, chaos)
+            except Exception as e:  # noqa: BLE001 — corrupt JPEG stream:
+                # carry the decode kind into run()'s containment so the
+                # fault counters attribute it correctly.
+                raise FaultError(FaultKind.DECODE,
+                                 f"jpeg decode failed: {e!r}") from e
         else:
             h = w = self.raw_size
             builder = self._builder(h, w)
             for row, b in enumerate(blobs):
-                builder.write_row(
-                    row, np.frombuffer(b, np.uint8).reshape(h, w, 3))
+                try:
+                    frame = np.frombuffer(b, np.uint8).reshape(h, w, 3)
+                except ValueError as e:  # poison payload: wrong byte count
+                    raise FaultError(FaultKind.DECODE,
+                                     f"raw frame reshape failed: {e!r}") from e
+                builder.write_row(row, frame)
             batch, resident = builder.finish(valid)
         # finish() padded to the compiled batch signature (static shapes —
         # one compilation for every batch size; repeat-last keeps stateful
@@ -288,6 +338,10 @@ class TpuZmqWorker:
 
                 if self.dealer.poll(self.poll_ms):
                     parts = self.dealer.recv_multipart()
+                    if self.chaos is not None:
+                        # Injection site "transport": a firing rule
+                        # truncates the multipart → malformed reply below.
+                        parts = self.chaos.truncate("transport", parts)
                     # Any reply consumes a credit — even a malformed or
                     # control message. Decrementing only on well-formed
                     # frames would leak that credit forever and starve the
@@ -296,6 +350,18 @@ class TpuZmqWorker:
                     parsed = parse_frame_reply(parts)
                     if parsed is None:
                         self.errors += 1
+                        self.faults.record(
+                            FaultKind.TRANSPORT,
+                            ValueError(f"malformed frame reply "
+                                       f"({len(parts)} parts)"))
+                        if (escalate(self._budget, FaultKind.TRANSPORT,
+                                     lambda _k: True) == ErrorBudget.FAIL):
+                            raise FaultError(
+                                FaultKind.TRANSPORT,
+                                f"transport fault budget exhausted "
+                                f"(> {self.fault_budget} malformed "
+                                f"messages in {self.fault_window_s:g}s)",
+                                fatal=True)
                     else:
                         idx, payload = parsed
                         if self._ring is not None:
@@ -347,12 +413,55 @@ class TpuZmqWorker:
                 if max_frames is not None and self.frames_processed >= max_frames:
                     break
             except Exception as e:  # noqa: BLE001 — per-iteration containment
+                if isinstance(e, FaultError) and e.fatal:
+                    raise  # a budget-exhaustion error escaping containment
                 self.errors += 1
-                print(f"[TpuZmqWorker] error (continuing): {e!r}", file=sys.stderr)
+                kind = classify(e, site="worker")
+                self.faults.record(kind, e)
+                if escalate(self._budget, kind,
+                            self._degrade) != ErrorBudget.CONTAIN:
+                    raise FaultError(
+                        kind,
+                        f"error budget exhausted for {kind!r} faults "
+                        f"(> {self.fault_budget} in {self.fault_window_s:g}s"
+                        f", after degradation); last: {e!r}",
+                        fatal=True) from e
+                print(f"[TpuZmqWorker] {kind} fault (continuing): {e!r}",
+                      file=sys.stderr)
                 # Drop any half-assembled batch; poison inputs must not wedge
                 # the loop by re-raising forever.
                 pending = []
                 first_recv_t = None
+
+    def _degrade(self, kind: str) -> bool:
+        """First-overflow degradation: repeated h2d faults fall back from
+        streamed to monolithic ingest (reason recorded in the ingest
+        stats), mirroring the pipeline/serve ladder. Other kinds have no
+        degraded mode here — the budget fails them."""
+        if kind == FaultKind.H2D and self.ingest == "streamed":
+            self.ingest = "monolithic"
+            self._degrade_reason = "h2d_fault_budget"
+            old, self._asm = self._asm, None
+            if old is not None:
+                old.release()
+            print("[TpuZmqWorker] repeated h2d faults: degrading ingest "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
+        return False
+
+    def stats(self) -> dict:
+        """Counters for tests/operators (the worker's run loop prints
+        nothing on the happy path)."""
+        return {
+            "frames_processed": self.frames_processed,
+            "batches": self.batches,
+            "errors": self.errors,
+            "faults": self.faults.summary(),
+            **({"ingest": self._ingest_stats.summary()}
+               if self._ingest_stats is not None else {}),
+            **({"chaos": self.chaos.summary()}
+               if self.chaos is not None else {}),
+        }
 
     def close(self) -> None:
         self._stop.set()

@@ -34,6 +34,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 "
+                   "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests (seeded "
+                   "FaultPlans, CPU backend, bounded wall time — run in "
+                   "tier-1; select with -m chaos)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,539 @@
+"""Chaos matrix: deterministic fault injection through the resilience
+subsystem (dvf_tpu/resilience/).
+
+Acceptance surface of ISSUE 4: under a seeded FaultPlan injecting each
+FaultKind into the serve path (CPU backend), the frontend never
+deadlocks, sheds/recovers within the error budget, keeps non-faulted
+sessions bit-identical to a fault-free run, and reports exact per-kind
+fault counts; a forced engine-death run shows supervised recovery with
+open sessions surviving and frame indices staying monotone.
+
+Everything here is seeded and event-indexed (``at=``/``every=`` chaos
+triggers) — no timing-dependent fault placement — and runs on the CPU
+backend with small frames, so the matrix is tier-1 material (marker:
+``chaos``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dvf_tpu.ops import get_filter
+from dvf_tpu.resilience import (
+    ChaosFault,
+    ErrorBudget,
+    FaultKind,
+    FaultPlan,
+    FaultStats,
+    classify,
+)
+from dvf_tpu.serve import ServeConfig, ServeError, ServeFrontend
+
+H, W = 16, 24
+
+pytestmark = pytest.mark.chaos
+
+
+def tagged_frame(session_no: int, frame_no: int) -> np.ndarray:
+    f = np.full((H, W, 3), 11, np.uint8)
+    f[0] = session_no
+    f[1] = frame_no % 251
+    return f
+
+
+# ------------------------------------------------------------- unit layer
+
+
+class TestFaultPlan:
+    def test_parse_and_deterministic_firing(self):
+        plan = FaultPlan.parse("compute:at=1/3,h2d:every=4:count=2", seed=9)
+        fired = []
+        for i in range(8):
+            try:
+                plan.fire("compute")
+            except ChaosFault as e:
+                fired.append((i, e.kind))
+        assert fired == [(1, "compute"), (3, "compute")]
+        h2d = []
+        for i in range(16):
+            try:
+                plan.fire("h2d")
+            except ChaosFault:
+                h2d.append(i)
+        assert h2d == [3, 7]  # every 4th event, capped at count=2
+
+    def test_parse_rejects_unknown_site_and_key(self):
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            FaultPlan.parse("warp:every=1")
+        with pytest.raises(ValueError, match="unknown chaos rule key"):
+            FaultPlan.parse("compute:when=now")
+
+    def test_corrupt_and_truncate_are_event_indexed(self):
+        plan = FaultPlan().add("decode", at=(1,)).add("transport", at=(0,))
+        blob = bytes(range(256))
+        assert plan.corrupt("decode", blob) == blob          # event 0
+        mangled = plan.corrupt("decode", blob)               # event 1
+        assert mangled != blob and len(mangled) < len(blob) + 16
+        parts = [b"0", b"payload"]
+        assert plan.truncate("transport", parts) == [b"0"]   # event 0
+        assert plan.truncate("transport", parts) == parts    # event 1
+
+    def test_delay_rule_sleeps_instead_of_raising(self):
+        plan = FaultPlan().add("freeze", at=(0,), delay_s=0.05)
+        t0 = time.perf_counter()
+        plan.fire("freeze")  # must not raise
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_summary_reports_fired_counts(self):
+        plan = FaultPlan().add("compute", at=(0,))
+        with pytest.raises(ChaosFault):
+            plan.fire("compute")
+        s = plan.summary()
+        assert s["fired"] == {"compute:compute": 1}
+        assert s["events"] == {"compute": 1}
+
+
+class TestErrorBudget:
+    def test_drop_degrade_fail_ladder(self):
+        b = ErrorBudget(limit=2, window_s=60.0)
+        assert [b.record("compute") for _ in range(3)] == [
+            "contain", "contain", "degrade"]
+        # Fresh window after the degrade; the degraded config overflowing
+        # again is a hard fail.
+        assert [b.record("compute") for _ in range(3)] == [
+            "contain", "contain", "fail"]
+        assert b.level("compute") == 2
+
+    def test_window_expiry_forgives_old_faults(self):
+        b = ErrorBudget(limit=2, window_s=0.5)
+        now = 100.0
+        assert b.record("h2d", now=now) == "contain"
+        assert b.record("h2d", now=now) == "contain"
+        # Past the window: the old events age out, no escalation.
+        assert b.record("h2d", now=now + 1.0) == "contain"
+        assert b.level("h2d") == 0
+
+    def test_per_kind_limits(self):
+        b = ErrorBudget(limit=10, window_s=60.0, limits={"stall": 1})
+        assert b.record("stall") == "contain"
+        assert b.record("stall") == "degrade"
+
+
+class TestClassify:
+    def test_fault_error_kind_wins(self):
+        from dvf_tpu.resilience import FaultError
+
+        assert classify(FaultError(FaultKind.H2D, "x"), "sink") == "h2d"
+
+    def test_oom_markers(self):
+        assert classify(RuntimeError("RESOURCE_EXHAUSTED: oom"), "dispatch") \
+            == FaultKind.OOM
+
+    def test_site_defaults(self):
+        assert classify(ValueError("x"), "ingest") == FaultKind.DECODE
+        assert classify(ValueError("x"), "collect") == FaultKind.COMPUTE
+        assert classify(ValueError("x"), None) == FaultKind.INTERNAL
+
+    def test_stats_exact_counts(self):
+        fs = FaultStats()
+        fs.record(FaultKind.DECODE, ValueError("a"))
+        fs.record(FaultKind.DECODE, ValueError("b"))
+        s = fs.summary()
+        assert s["by_kind"] == {"decode": 2}
+        assert s["total"] == 2
+        assert "ValueError" in s["last"]["decode"]["error"]
+
+
+# ------------------------------------------------------ pipeline under chaos
+
+
+class TestPipelineChaos:
+    def test_compute_fault_exact_counts(self):
+        from dvf_tpu.io.sinks import NullSink
+        from dvf_tpu.io.sources import SyntheticSource
+        from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+        chaos = FaultPlan().add("compute", at=(1,))
+        pipe = Pipeline(
+            SyntheticSource(height=16, width=16, n_frames=32),
+            get_filter("invert"), NullSink(),
+            PipelineConfig(batch_size=4, frame_delay=0, queue_size=64,
+                           resilient=True, chaos=chaos))
+        stats = pipe.run()
+        assert stats["faults"]["by_kind"] == {"compute": 1}
+        assert stats["errors"] == 1
+        # Exactly one batch (≤ 4 frames) lost, everything else delivered.
+        assert 32 - 4 <= stats["delivered"] < 32
+        assert stats["chaos"]["fired"] == {"compute:compute": 1}
+
+    def test_fail_fast_chaos_fault_aborts(self):
+        from dvf_tpu.io.sinks import NullSink
+        from dvf_tpu.io.sources import SyntheticSource
+        from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+        chaos = FaultPlan().add("oom", at=(0,))
+        pipe = Pipeline(
+            SyntheticSource(height=16, width=16, n_frames=8),
+            get_filter("invert"), NullSink(),
+            PipelineConfig(batch_size=4, frame_delay=0, queue_size=64,
+                           resilient=False, chaos=chaos))
+        with pytest.raises(ChaosFault):
+            pipe.run()
+        assert pipe.faults.summary()["by_kind"] == {"oom": 1}
+
+    def test_stall_watchdog_recovers_pipeline(self):
+        """A frozen collect thread stalls the in-flight window; the
+        pipeline watchdog sheds the window and rebuilds the engine, and
+        the stream keeps delivering after the consumer wakes."""
+        from dvf_tpu.io.sinks import NullSink
+        from dvf_tpu.io.sources import SyntheticSource
+        from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+        chaos = FaultPlan().add("freeze", at=(2,), delay_s=1.2)
+        pipe = Pipeline(
+            SyntheticSource(height=16, width=16, n_frames=200, rate=100.0),
+            get_filter("invert"), NullSink(),
+            PipelineConfig(batch_size=4, frame_delay=0, queue_size=1000,
+                           resilient=True, chaos=chaos,
+                           stall_timeout_s=0.3, collect_mode="thread"))
+        stats = pipe.run()
+        assert stats["recoveries"] >= 1
+        assert stats["faults"]["by_kind"].get("stall", 0) >= 1
+        assert stats["delivered"] > 0
+
+    def test_h2d_budget_degrades_streamed_to_monolithic(self, monkeypatch):
+        import dvf_tpu.runtime.ingest as ingest_mod
+        from dvf_tpu.io.sinks import NullSink
+        from dvf_tpu.io.sources import SyntheticSource
+        from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+
+        # Force the streamed path on CPU (the cheap-transfer gate would
+        # auto-degrade before chaos could reach the h2d site).
+        monkeypatch.setattr(ingest_mod, "MIN_STREAM_H2D_MS", 0.0)
+        chaos = FaultPlan().add("h2d", every=1, count=64)
+        pipe = Pipeline(
+            SyntheticSource(height=16, width=16, n_frames=48),
+            get_filter("invert"), NullSink(),
+            PipelineConfig(batch_size=8, frame_delay=0, queue_size=64,
+                           resilient=True, chaos=chaos, fault_budget=2))
+        stats = pipe.run()
+        # Budget (2) overflowed at the 3rd h2d fault → streamed degraded
+        # to monolithic (reason recorded), stream finished healthy.
+        assert stats["faults"]["by_kind"] == {"h2d": 3}
+        assert stats["ingest"]["mode"] == "monolithic"
+        assert stats["ingest"]["fallback_reason"] == "h2d_fault_budget"
+        assert stats["delivered"] > 0
+
+
+# ------------------------------------------------------- serve chaos matrix
+
+
+def _drive_sync(fe, sid, frame, deadline_s=30.0):
+    """Submit one frame and wait for it to resolve (delivered or failed)
+    — each device batch carries exactly one frame, so chaos event indices
+    map 1:1 onto submitted frames."""
+    s = fe._session(sid)
+    before = s.delivered + s.failed
+    fe.submit(sid, frame)
+    deadline = time.time() + deadline_s
+    while s.delivered + s.failed < before + 1:
+        assert time.time() < deadline, "serve path deadlocked"
+        time.sleep(0.002)
+
+
+def _run_two_session_matrix(chaos, n_each=6, monkeypatched_ingest=False):
+    """Alternate frames A,B,A,B… with one frame per device batch; poll
+    everything; return (deliveries_by_sid, stats, sids)."""
+    fe = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=8, queue_size=1000, slo_ms=60_000.0,
+                    stall_timeout_s=0.0, chaos=chaos))
+    deliveries = {}
+    with fe:
+        a, b = fe.open_stream(), fe.open_stream()
+        for j in range(n_each):
+            _drive_sync(fe, a, tagged_frame(0, j))
+            _drive_sync(fe, b, tagged_frame(1, j))
+        for sid in (a, b):
+            deliveries[sid] = fe.poll(sid)
+        stats = fe.stats()
+    return deliveries, stats, (a, b)
+
+
+class TestServeChaosMatrix:
+    """Each engine-path FaultKind injected into the serve path: exact
+    counts, no deadlock, non-faulted session bit-identical to fault-free.
+
+    Event math: one frame per batch (sync driving), streams alternate
+    A,B,A,B…, so batch index 2j is A's frame j and 2j+1 is B's frame j.
+    The rules below fault B's frames 0, 1, and 2 and never touch A.
+    """
+
+    def _check(self, kind, chaos_builder, monkeypatch=None):
+        # Fault-free reference: session A's exact deliveries.
+        ref, ref_stats, (ra, _rb) = _run_two_session_matrix(None)
+        assert ref_stats["faults"]["by_kind"] == {}
+        got, stats, (a, b) = _run_two_session_matrix(chaos_builder())
+
+        # Exact per-kind counts, frontend- and session-level.
+        assert stats["faults"]["by_kind"] == {kind: 3}
+        assert stats["errors"] == 3
+        sess = stats["sessions"]
+        assert sess[b]["faults"] == {kind: 3}
+        assert sess[b]["failed"] == 3
+        assert sess[b]["delivered"] == 3
+        assert sess[a]["faults"] == {}
+        assert sess[a]["delivered"] == 6
+
+        # The non-faulted session is bit-identical to the fault-free run.
+        assert [d.index for d in got[a]] == [d.index for d in ref[ra]]
+        for d_got, d_ref in zip(got[a], ref[ra]):
+            np.testing.assert_array_equal(d_got.frame, d_ref.frame)
+        # Indices stay strictly monotone on both streams.
+        for sid in (a, b):
+            idx = [d.index for d in got[sid]]
+            assert idx == sorted(idx) and len(set(idx)) == len(idx)
+
+    def test_compute_faults(self):
+        self._check(
+            FaultKind.COMPUTE,
+            lambda: FaultPlan().add("compute", at=(1, 3, 5)))
+
+    def test_oom_faults(self):
+        self._check(
+            FaultKind.OOM,
+            lambda: FaultPlan().add("oom", at=(1, 3, 5)))
+
+    def test_h2d_faults(self, monkeypatch):
+        import dvf_tpu.runtime.ingest as ingest_mod
+
+        monkeypatch.setattr(ingest_mod, "MIN_STREAM_H2D_MS", 0.0)
+        # Streamed path on an 8-way data mesh with batch_size=8: one
+        # 1-row chunk per device → 8 h2d events per batch. Batches 1, 3,
+        # and 5 are B's frames 0–2.
+        self._check(
+            FaultKind.H2D,
+            lambda: FaultPlan().add("h2d", at=(8 * 1, 8 * 3, 8 * 5)))
+
+
+class TestServeSupervision:
+    def test_stall_watchdog_recovers_frozen_collect(self):
+        """A frozen collect thread (freeze injection) wedges the in-flight
+        window; the watchdog trips, sheds the window, rebuilds the engine,
+        and replaces the consumer — the session survives and later frames
+        flow, indices monotone across the recovery."""
+        chaos = FaultPlan().add("freeze", at=(3,), delay_s=1.5)
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=4, queue_size=1000, slo_ms=60_000.0,
+                        stall_timeout_s=0.35, chaos=chaos))
+        deliveries = []
+        with fe:
+            sid = fe.open_stream()
+            s = fe._session(sid)
+            i = 0
+            # Drive through the freeze until the watchdog has recovered.
+            deadline = time.time() + 20.0
+            while fe.recoveries < 1:
+                assert time.time() < deadline, "watchdog never tripped"
+                fe.submit(sid, tagged_frame(0, i))
+                i += 1
+                deliveries.extend(fe.poll(sid))
+                time.sleep(0.01)
+            # Post-recovery: the rebuilt engine must serve new frames.
+            delivered_before = s.delivered
+            deadline = time.time() + 20.0
+            while s.delivered <= delivered_before:
+                assert time.time() < deadline, "no delivery after recovery"
+                fe.submit(sid, tagged_frame(0, i))
+                i += 1
+                deliveries.extend(fe.poll(sid))
+                time.sleep(0.01)
+            deliveries.extend(fe.poll(sid))
+            stats = fe.stats()
+
+        assert stats["recoveries"] >= 1
+        assert stats["faults"]["by_kind"].get("stall", 0) >= 1
+        # Snapshot taken pre-stop: the session was still OPEN — it
+        # survived the recovery rather than being torn down by it.
+        assert stats["sessions"][sid]["state"] == "open"
+        idx = [d.index for d in deliveries]
+        assert idx == sorted(idx) and len(set(idx)) == len(idx), (
+            "frame indices regressed across supervisor recovery")
+        # Frames shed by the recovery are attributed, not silently lost.
+        assert stats["sessions"][sid]["failed"] >= 1
+        assert fe._error is None
+
+    def test_engine_death_recovery_sessions_survive(self):
+        """Forced engine death: repeated compute faults overflow the
+        budget once → supervised rebuild replaces the broken engine;
+        the open session survives with monotone indices."""
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=4, queue_size=1000, slo_ms=60_000.0,
+                        stall_timeout_s=0.0, fault_budget=2))
+        deliveries = []
+        with fe:
+            sid = fe.open_stream()
+            s = fe._session(sid)
+            for j in range(2):  # healthy warm-up
+                _drive_sync(fe, sid, tagged_frame(0, j))
+
+            def dead_step(*a, **k):
+                raise RuntimeError("engine died (forced)")
+
+            fe.engine._step = dead_step
+            # Faults 1 and 2 are contained; the 3rd overflows the budget
+            # and triggers the rebuild — a FRESH engine whose _step works.
+            for j in range(2, 5):
+                _drive_sync(fe, sid, tagged_frame(0, j))
+            # The faulted frame is accounted (failed++) BEFORE the
+            # dispatch thread runs the rebuild, so wait for it to land.
+            deadline = time.time() + 10.0
+            while fe.recoveries < 1:
+                assert time.time() < deadline, "rebuild never happened"
+                time.sleep(0.002)
+            assert fe.recoveries == 1
+            _drive_sync(fe, sid, tagged_frame(0, 5))  # rebuilt engine serves
+            deliveries.extend(fe.poll(sid))
+            stats = fe.stats()
+
+        sess = stats["sessions"][sid]
+        assert stats["faults"]["by_kind"] == {"compute": 3}
+        assert sess["faults"] == {"compute": 3}
+        assert sess["delivered"] == 3  # frames 0, 1, and 5
+        idx = [d.index for d in deliveries]
+        assert idx == sorted(idx) and len(set(idx)) == len(idx)
+        assert fe._error is None
+
+    def test_permanently_broken_engine_surfaces_serve_error(self):
+        """Satellite: unbounded `_contain` swallowing is gone — an engine
+        that still faults after its rebuild exhausts the budget ladder
+        and surfaces ServeError instead of serving 0 fps silently."""
+        chaos = FaultPlan().add("compute", every=1)  # unbounded faults
+        fe = ServeFrontend(
+            get_filter("invert"),
+            ServeConfig(batch_size=4, queue_size=1000, slo_ms=60_000.0,
+                        stall_timeout_s=0.0, fault_budget=2, chaos=chaos))
+        fe.start()
+        try:
+            sid = fe.open_stream()
+            s = fe._session(sid)
+            deadline = time.time() + 30.0
+            with pytest.raises(ServeError, match="budget exhausted"):
+                while True:
+                    assert time.time() < deadline, "never escalated"
+                    before = s.delivered + s.failed
+                    fe.submit(sid, tagged_frame(0, 0))  # raises once failed
+                    while (s.delivered + s.failed < before + 1
+                           and fe._error is None):
+                        assert time.time() < deadline
+                        time.sleep(0.002)
+            assert fe.recoveries == 1  # one rebuild was tried first
+            assert isinstance(fe._error, ServeError)
+        finally:
+            with pytest.raises(ServeError):
+                fe.stop()
+
+
+class TestWorkerChaos:
+    """decode/transport FaultKinds on their natural path: the ZMQ worker."""
+
+    @pytest.fixture
+    def app(self):
+        pytest.importorskip("zmq")
+        import zmq
+
+        class _App:
+            def __init__(self):
+                self.ctx = zmq.Context()
+                self.router = self.ctx.socket(zmq.ROUTER)
+                self.dist_port = self.router.bind_to_random_port(
+                    "tcp://127.0.0.1")
+                self.pull = self.ctx.socket(zmq.PULL)
+                self.coll_port = self.pull.bind_to_random_port(
+                    "tcp://127.0.0.1")
+
+            def close(self):
+                self.router.close(0)
+                self.pull.close(0)
+                self.ctx.term()
+
+        a = _App()
+        yield a
+        a.close()
+
+    def _serve_frames(self, app, worker, payloads, done, wall_s=30.0):
+        """Pump payloads through the worker until ``done(results)`` (a
+        predicate — batch boundaries under load are not deterministic, so
+        callers assert on membership, not exact counts)."""
+        import threading
+
+        t = threading.Thread(target=worker.run, daemon=True)
+        t.start()
+        sent, results = 0, {}
+        deadline = time.time() + wall_s
+        while not done(results) and time.time() < deadline:
+            if sent < len(payloads) and app.router.poll(5):
+                client = app.router.recv_multipart()[0]
+                app.router.send_multipart(
+                    [client, str(sent).encode(), payloads[sent]])
+                sent += 1
+            if app.pull.poll(5):
+                parts = app.pull.recv_multipart()
+                results[int(parts[0])] = parts[4]
+        worker.stop()
+        t.join(timeout=10)
+        assert done(results), "timed out before the expected frames landed"
+        return results
+
+    def test_decode_corruption_counted_and_contained(self, app, rng):
+        from dvf_tpu.transport.codec import make_codec
+        from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+        codec = make_codec()
+        frames = [rng.integers(0, 255, (16, 16, 3), np.uint8)
+                  for _ in range(6)]
+        payloads = codec.encode_batch(frames)
+        codec.close()
+        # Decode events count per blob in arrival order regardless of how
+        # batches split, so event 3 is always frame 3's decode.
+        chaos = FaultPlan().add("decode", at=(3,))
+        worker = TpuZmqWorker(
+            get_filter("invert"), host="127.0.0.1",
+            distribute_port=app.dist_port, collect_port=app.coll_port,
+            batch_size=2, use_jpeg=True, chaos=chaos)
+        results = self._serve_frames(
+            app, worker, payloads,
+            done=lambda r: {0, 1, 4, 5} <= set(r)
+            and worker.faults.count("decode") == 1)
+        worker.close()
+        # Frame 3 (the corrupted blob) is always lost; frame 2 is lost
+        # only when it shared frame 3's batch. Everything else serves.
+        assert 3 not in results
+        assert worker.faults.summary()["by_kind"] == {"decode": 1}
+        assert worker.errors == 1
+
+    def test_transport_truncation_counted_and_contained(self, app, rng):
+        from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+        frames = [rng.integers(0, 255, (16, 16, 3), np.uint8)
+                  for _ in range(4)]
+        payloads = [f.tobytes() for f in frames]
+        chaos = FaultPlan().add("transport", at=(1,))
+        worker = TpuZmqWorker(
+            get_filter("invert"), host="127.0.0.1",
+            distribute_port=app.dist_port, collect_port=app.coll_port,
+            batch_size=2, use_jpeg=False, raw_size=16, chaos=chaos)
+        results = self._serve_frames(
+            app, worker, payloads,
+            done=lambda r: {0, 2, 3} <= set(r))
+        worker.close()
+        # Frame 1's reply was truncated on the wire → dropped + counted;
+        # the rest round-trip bit-exact.
+        assert 1 not in results
+        assert worker.faults.summary()["by_kind"] == {"transport": 1}
+        for i in results:
+            out = np.frombuffer(results[i], np.uint8).reshape(16, 16, 3)
+            np.testing.assert_array_equal(out, 255 - frames[i])

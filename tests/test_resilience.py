@@ -206,6 +206,105 @@ def test_stateful_pad_unsafe_filter_rejected():
     app.close()
 
 
+def test_geometry_reprobe_releases_slabs_and_counts_fault(rng):
+    """Mid-stream geometry change (the app restarted with a new
+    target_size): the worker re-probes and keeps serving; the abandoned
+    half-staged assembler's slabs are released eagerly (not left to GC)
+    and the event lands under the `geometry` fault kind."""
+    from dvf_tpu.transport.codec import make_codec
+    from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+    app = _Sockets()
+    worker = _mk_worker(app, use_jpeg=True, batch_size=2)
+    codec = make_codec()
+    small = [rng.integers(0, 255, (16, 16, 3), np.uint8) for _ in range(2)]
+    large = [rng.integers(0, 255, (24, 24, 3), np.uint8) for _ in range(2)]
+    payloads = codec.encode_batch(small) + codec.encode_batch(large)
+
+    t = threading.Thread(target=worker.run, kwargs={"max_frames": 4},
+                         daemon=True)
+    t.start()
+
+    def serve(lo, hi):
+        sent, got = lo, 0
+        deadline = time.time() + 30
+        while got < hi - lo and time.time() < deadline:
+            if sent < hi and app.router.poll(5):
+                client = app.router.recv_multipart()[0]
+                app.router.send_multipart(
+                    [client, str(sent).encode(), payloads[sent]])
+                sent += 1
+            if app.pull.poll(5):
+                parts = app.pull.recv_multipart()
+                results[int(parts[0])] = parts[4]
+                got += 1
+        return got
+
+    results: dict = {}
+    # Phase 1: the 16x16 stream — pins the first assembler geometry.
+    assert serve(0, 2) == 2
+    old_asm = worker._asm  # the 16x16-geometry assembler
+    # Phase 2: the stream switches to 24x24 → JpegGeometryError → re-probe.
+    assert serve(2, 4) == 2
+    worker.stop()
+    t.join(timeout=10)
+
+    assert sorted(results) == [0, 1, 2, 3], "re-probe lost frames"
+    # The geometry flip was classified, not silently absorbed …
+    assert worker.faults.summary()["by_kind"] == {"geometry": 1}
+    assert worker.errors == 0  # successful containment, not an error
+    # … and the abandoned assembler's staging buffers were freed eagerly.
+    assert old_asm is not None and old_asm is not worker._asm
+    assert old_asm._chunks == [] and old_asm._mono_pool is None
+    assert worker._asm.batch_shape == (2, 24, 24, 3)
+    # Numerics survive the re-probe: results decode to the inverted input.
+    for i, frame in enumerate(small + large):
+        h, w = codec.probe(results[i])
+        out = np.empty((h, w, 3), np.uint8)
+        codec.decode_batch([results[i]], out=out[None])
+        assert (h, w) == frame.shape[:2]
+    codec.close()
+    worker.close()
+    app.close()
+
+
+def test_shm_ring_source_detects_producer_death():
+    """io/sources.py ShmRingSource: a producer that dies without pushing
+    the EOF sentinel must end the stream via the idle timeout — served
+    frames intact, no hang (the previously-untested containment branch)."""
+    import os
+
+    pytest.importorskip("numpy")
+    try:
+        from dvf_tpu.transport.ring import FrameRing
+    except Exception as e:  # noqa: BLE001 — native shim unavailable
+        pytest.skip(f"native ring shim unavailable: {e}")
+    from dvf_tpu.io.sources import ShmRingSource
+
+    name = f"dvf_test_pdeath_{os.getpid()}"
+    frame = (np.arange(16 * 16 * 3, dtype=np.uint32) % 251).astype(np.uint8)
+    frame = frame.reshape(16, 16, 3)
+    ring = FrameRing(capacity_bytes=1 << 20, shm_name=name, create=True,
+                     max_frame_bytes=16 * 16 * 3 + 64)
+    try:
+        ring.push(frame.tobytes(), 0, time.time())
+        # No EOF sentinel is ever pushed — the producer "died" here.
+        src = ShmRingSource(name, (16, 16, 3), attach_timeout_s=5.0,
+                            idle_timeout_s=0.3)
+        got = []
+        t0 = time.time()
+        for f, _ts in src:
+            if f is None:
+                break
+            got.append(np.array(f))
+        wall = time.time() - t0
+        assert len(got) == 1
+        np.testing.assert_array_equal(got[0], frame)
+        assert wall < 5.0, "producer-death detection hung"
+    finally:
+        ring.close()
+
+
 # ---------------------------------------------------- pipeline resilience
 
 
