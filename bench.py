@@ -214,6 +214,11 @@ def build_out(result, mode, fallback, error):
         "p99_latency_ms": result.get("p99_ms"),
         "compute_p50_ms": result.get("compute_p50_ms"),
         "stage_decomp_ms": result.get("stage_decomp_ms"),
+        # Codec provenance for the encode_ms leg + egress overlap fields
+        # (streamed shard-level egress, runtime/egress.py).
+        "codec": result.get("codec"),
+        "egress": result.get("egress"),
+        "egress_overlap_efficiency": result.get("egress_overlap_efficiency"),
         "lat_target_fps": result.get("lat_target_fps"),
         "lat_batch": result.get("lat_batch"),
         # The latency verdict must travel with the percentiles: without

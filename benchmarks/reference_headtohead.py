@@ -285,6 +285,16 @@ def main(argv=None) -> int:
     rates_matched = (not ours_lat.get("congested")
                      and ours_lat.get("target_fps") == lat_rate)
 
+    # Codec provenance: the same defaults both sides of the JPEG legs use
+    # (the reference worker shim and our RingFrameQueue both build the
+    # default make_codec pool) — quality/threads/backend must travel with
+    # the same-codec speedup they produced.
+    from dvf_tpu.transport.codec import make_codec
+
+    _codec = make_codec()
+    codec_cfg = _codec.config()
+    _codec.close()
+
     doc = {
         "captured_utc": datetime.datetime.now(
             datetime.timezone.utc).isoformat(),
@@ -292,6 +302,7 @@ def main(argv=None) -> int:
         "host": {"cores": os.cpu_count()},
         "workload": {"height": args.height, "width": args.width,
                      "filter": "invert"},
+        "codec": codec_cfg,
         "reference": ref,
         "dvf_tpu_cpu_jpeg_wire": ours_jpeg,
         "dvf_tpu_cpu_raw_wire": ours_raw,

@@ -153,6 +153,7 @@ def bench_stage_decomposition(
     width: int = 1920,
     reps: int = 50,
     transfer_reps: int = 3,
+    measure_encode: bool = True,
 ) -> dict:
     """Per-stage latency decomposition at small batch (VERDICT r3 item 2).
 
@@ -170,6 +171,17 @@ def bench_stage_decomposition(
     ``reps`` full fetches would burn minutes of the bench budget on
     numbers the model discards. H2D must run every rep regardless (the
     donated compute step consumes its input), so it is timed every rep.
+
+    ``measure_encode`` adds the fifth leg a wire-delivery frame crosses:
+    a single-threaded JPEG encode of the fetched batch (host work,
+    tunnel-immune). It is reported per batch as ``encode_ms`` but kept
+    OUT of ``total_ms``: these legs time the serialized monolithic path
+    the latency model decomposes, and since the asynchronous codec plane
+    (runtime/egress.py) the encode leg is overlapped with the next
+    batch's compute rather than additive — the bench's egress stats
+    (``encode_wait_ms`` vs ``encode_ms``) say how completely. The codec
+    actually measured (backend/quality/threads) is recorded under the
+    ``codec`` key.
     """
     import jax
     import numpy as np
@@ -178,6 +190,14 @@ def bench_stage_decomposition(
 
     rng = np.random.default_rng(0)
     out: dict = {}
+    codec = None
+    if measure_encode:
+        from dvf_tpu.transport.codec import make_codec
+
+        # threads=1: this is the per-frame serialized cost the latency
+        # model wants, not pool throughput (measure_codec_fps's choice).
+        codec = make_codec(threads=1)
+        out["codec"] = codec.config()
     for b in batch_sizes:
         shape = (b, height, width, 3)
         engine = Engine(filt)
@@ -208,9 +228,21 @@ def bench_stage_decomposition(
                     d2h_dst = np.empty(y.shape, y.dtype)
                     t3 = time.perf_counter()  # exclude the one-time alloc
                 np.copyto(d2h_dst, np.asarray(y))
-                legs["d2h_ms"].append((time.perf_counter() - t3) * 1e3)
+                t4 = time.perf_counter()
+                legs["d2h_ms"].append((t4 - t3) * 1e3)
+                if (codec is not None and d2h_dst.dtype == np.uint8
+                        and d2h_dst.ndim == 4 and d2h_dst.shape[-1] == 3):
+                    codec.encode_batch(list(d2h_dst))
+                    legs.setdefault("encode_ms", []).append(
+                        (time.perf_counter() - t4) * 1e3)
+        enc = legs.pop("encode_ms", None)
         p50 = {k: round(float(np.percentile(v, 50)), 4) for k, v in legs.items()}
+        # encode_ms deliberately excluded from total_ms: the legacy four
+        # legs are the serialized transfer model; encode is reported
+        # beside them (see docstring).
         p50["total_ms"] = round(sum(p50.values()), 4)
+        if enc:
+            p50["encode_ms"] = round(float(np.percentile(enc, 50)), 4)
         p50["per_frame_compute_ms"] = round(p50["compute_ms"] / b, 4)
         # Self-describing keys (BENCH rounds ≤ 5 published opaque "1"/
         # "2"/"4"), with the measured transfer mode recorded in-band:
@@ -219,6 +251,8 @@ def bench_stage_decomposition(
         # per-shard path's hiding shows up in overlap_efficiency instead.
         p50["transfer_mode"] = "whole_batch"
         out[f"batch_{b}"] = p50
+    if codec is not None:
+        codec.close()
     return out
 
 
@@ -285,7 +319,7 @@ def bench_transfer(batch_size: int, height: int, width: int, reps: int = 3) -> d
 def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
                   queue_size, collect_mode="thread", transport="python",
                   wire="raw", mesh=None, ingest="streamed",
-                  ingest_depth=4) -> dict:
+                  ingest_depth=4, egress="streamed") -> dict:
     import numpy as np
 
     from dvf_tpu.io.sinks import NullSink
@@ -314,6 +348,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
             collect_mode=collect_mode,
             ingest=ingest,
             ingest_depth=ingest_depth,
+            egress=egress,
         ),
         engine=engine,
         queue=queue,
@@ -329,6 +364,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
     wall = time.perf_counter() - t0
     pct = sink.latency_percentiles()
     ingest_stats = stats.get("ingest", {})
+    egress_stats = stats.get("egress", {})
     return {
         "fps": sink.count / wall if wall > 0 else 0.0,
         # Steady-state delivery rate, first→last delivery (LatencyStats
@@ -348,6 +384,13 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
         "ingest_depth": ingest_depth,
         "overlap_efficiency": ingest_stats.get("overlap_efficiency"),
         "ingest_stats": ingest_stats,
+        # The delivery-side mirror: the fetch path actually taken
+        # ("streamed" auto-degrades where streaming cannot win — e.g. the
+        # CPU backend's zero-copy np.asarray) + how much of the per-batch
+        # blocking-D2H cost it hid (obs.metrics.EgressStats).
+        "egress": egress_stats.get("mode", egress),
+        "egress_overlap_efficiency": egress_stats.get("overlap_efficiency"),
+        "egress_stats": egress_stats,
         # Per-kind fault counters (resilience.faults) — a clean bench run
         # asserts an empty dict; any entry here means the measured number
         # absorbed contained faults and is suspect.
@@ -371,6 +414,7 @@ def bench_e2e_streaming(
     mesh=None,
     ingest: str = "streamed",
     ingest_depth: int = 4,
+    egress: str = "streamed",
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
@@ -389,7 +433,7 @@ def bench_e2e_streaming(
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
         collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
-        ingest=ingest, ingest_depth=ingest_depth,
+        ingest=ingest, ingest_depth=ingest_depth, egress=egress,
     )
 
 
@@ -446,6 +490,7 @@ def bench_e2e_latency(
     mesh=None,
     ingest: str = "streamed",
     ingest_depth: int = 4,
+    egress: str = "streamed",
     max_backoffs: int = 2,
     max_retry_stream_s: float = 400.0,
 ) -> dict:
@@ -486,6 +531,7 @@ def bench_e2e_latency(
             queue_size=batch_size,
             collect_mode=collect_mode, transport=transport, wire=wire,
             mesh=mesh, ingest=ingest, ingest_depth=ingest_depth,
+            egress=egress,
         )
         congested = stream_congested(r["delivery_fps"], target_fps,
                                      r["dropped"], r["frames"])

@@ -297,6 +297,7 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         resilient=not args.fail_fast,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        egress=args.egress,
         fault_budget=args.fault_budget,
         fault_window_s=args.fault_window,
         stall_timeout_s=(args.stall_timeout if args.stall_timeout is not None
@@ -425,6 +426,7 @@ def cmd_serve(args) -> int:
         collect_mode=args.collect_mode,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        egress=args.egress,
         fault_budget=args.fault_budget,
         fault_window_s=args.fault_window,
         stall_timeout_s=args.stall_timeout or 0.0,
@@ -441,6 +443,7 @@ def cmd_serve(args) -> int:
             frame_shape=frame_shape,
             capacity_frames=args.queue_size,
             jpeg=(args.wire == "jpeg"),
+            codec_threads=args.codec_threads,
         )
         if args.wire == "jpeg":
             # Host-codec budget check (SURVEY §7 hard part 3): the JPEG
@@ -560,9 +563,12 @@ def cmd_worker(args) -> int:
         batch_size=args.batch,
         use_jpeg=not args.no_jpeg,
         raw_size=args.target_size,
+        jpeg_quality=90,
+        codec_threads=args.codec_threads,
         delay_s=args.delay,
         ingest=args.ingest,
         ingest_depth=args.ingest_depth,
+        egress=args.egress,
         fault_budget=args.fault_budget,
         fault_window_s=args.fault_window,
         chaos=_parse_chaos(args),
@@ -660,7 +666,8 @@ def cmd_bench(args) -> int:
                                 transport=args.transport, wire=args.wire,
                                 mesh=_parse_mesh(args.mesh),
                                 ingest=args.ingest,
-                                ingest_depth=args.ingest_depth)
+                                ingest_depth=args.ingest_depth,
+                                egress=args.egress)
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -674,6 +681,9 @@ def cmd_bench(args) -> int:
             "ingest": r["ingest"],
             "ingest_depth": r["ingest_depth"],
             "overlap_efficiency": r["overlap_efficiency"],
+            # The delivery-side mirror (runtime/egress.py).
+            "egress": r["egress"],
+            "egress_overlap_efficiency": r["egress_overlap_efficiency"],
             # Per-kind contained-fault counters ({} = clean run).
             "faults": r.get("faults", {}),
         }
@@ -697,7 +707,8 @@ def cmd_bench(args) -> int:
                                    transport=args.transport, wire=args.wire,
                                    mesh=_parse_mesh(args.mesh),
                                    ingest=args.ingest,
-                                   ingest_depth=args.ingest_depth)
+                                   ingest_depth=args.ingest_depth,
+                                   egress=args.egress)
             out.update(
                 p50_ms=round(rl["p50_ms"], 3),
                 p99_ms=round(rl["p99_ms"], 3),
@@ -1032,6 +1043,16 @@ def main(argv=None) -> int:
                      help="streamed ingest: max shard transfers in "
                           "flight before staging blocks on the oldest "
                           "(also the per-device sub-chunk granularity)")
+    ing.add_argument("--egress", choices=("streamed", "monolithic"),
+                     default="streamed",
+                     help="result fetch path: 'streamed' issues per-"
+                          "output-shard copy_to_host_async at submit and "
+                          "materializes into preallocated host slabs at "
+                          "collect, overlapping D2H with the tail of "
+                          "compute (runtime/egress.py; auto-degrades "
+                          "where streaming cannot win); 'monolithic' is "
+                          "the classic whole-batch np.asarray escape "
+                          "hatch")
 
     # Shared by the long-running serving subcommands (serve, worker): the
     # resilience knobs — deterministic fault injection for reproducing
@@ -1041,7 +1062,7 @@ def main(argv=None) -> int:
     res.add_argument("--chaos", default=None, metavar="SPEC",
                      help="arm deterministic fault injection: comma-"
                           "separated rules 'site[:key=value]*' over sites "
-                          "decode|transport|h2d|compute|oom|freeze with "
+                          "decode|transport|h2d|d2h|compute|oom|freeze with "
                           "keys every=N, at=I/J/K (0-based event indices), "
                           "p=0.05, count=N, delay=SECONDS, kind=NAME — "
                           "e.g. 'compute:at=3,h2d:every=5:count=2'; "
@@ -1107,6 +1128,11 @@ def main(argv=None) -> int:
                     help="ingest queue: 'ring' routes frames through the "
                          "native C++ shared-memory ring (drop counter shows "
                          "up in stats as dropped_at_ingest)")
+    sp.add_argument("--codec-threads", type=int, default=4,
+                    help="JPEG codec thread-pool size for --wire jpeg "
+                         "(and the serve-side ZmqStreamBridge) — the "
+                         "host-codec throughput knob, SURVEY §7 hard "
+                         "part 3")
     sp.add_argument("--mesh", default=None,
                     help="device mesh for the engine: 'data=2,space=2,"
                          "model=2' (omitted axes = 1) or 'auto[:space|"
@@ -1168,6 +1194,10 @@ def main(argv=None) -> int:
     wp.add_argument("--collect-port", type=int, default=5556)
     wp.add_argument("--batch", type=int, default=8)
     wp.add_argument("--no-jpeg", action="store_true")
+    wp.add_argument("--codec-threads", type=int, default=4,
+                    help="JPEG codec thread-pool size (encode/decode "
+                         "parallelism; also the asynchronous egress "
+                         "encode plane's pool)")
     wp.add_argument("--target-size", type=int, default=512)
     wp.add_argument("--delay", type=float, default=0.0,
                     help="fault injection: sleep this many seconds per batch "

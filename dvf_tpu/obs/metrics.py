@@ -183,6 +183,103 @@ class IngestStats:
         }
 
 
+class EgressStats:
+    """Streamed-egress accounting — the delivery-side mirror of
+    :class:`IngestStats`: how much D2H cost the collect path actually
+    *exposed* vs how much the per-shard ``copy_to_host_async`` issued at
+    submit hid under the tail of compute, and how much encode time the
+    asynchronous codec plane ran under the next batch's compute.
+
+    ``overlap_efficiency`` mirrors the ingest formula::
+
+        efficiency = (d2h_block_ms − exposed_ms) / d2h_block_ms
+
+    where ``d2h_block_ms`` is the calibrated cost of one BLOCKING
+    whole-batch materialization at this signature (measured once by
+    ``Engine.compile`` — ``np.asarray`` + copy into a host destination,
+    the monolithic collect path's serialized fetch) and ``exposed_ms``
+    is the per-batch average the streamed fetch actually spent blocked
+    on shard host copies (``d2h_wait_ms``) plus scattering them into the
+    output slab (``copy_ms``). None when no calibration exists or the
+    monolithic path ran.
+
+    The codec-plane half: ``encode_ms`` is the wall span of one batch's
+    encode inside the pool (submit → last future done), ``encode_wait_ms``
+    is how long the delivery thread actually *blocked* draining it — a
+    wait far below the span is encode running under concurrent
+    decode/compute, the "encode_ms no longer additive" evidence.
+    """
+
+    def __init__(self, requested_mode: str = "streamed", depth: int = 2,
+                 d2h_block_ms: Optional[float] = None):
+        self.requested_mode = requested_mode
+        self.effective_mode = requested_mode
+        self.fallback_reason: Optional[str] = None  # why streamed degraded
+        #   ("zero_copy_backend", "cheap_transfer", "unsupported_sharding",
+        #   "d2h_fault_budget")
+        self.depth = depth               # encode-plane in-flight window
+        self.d2h_block_ms = d2h_block_ms
+        self.batches = 0
+        self.pool_allocs = 0             # slab-pool constructions (stays 1
+        #   across a steady-state run — the allocation-regression tests)
+        self.d2h_wait_ms_total = 0.0     # blocked on shard host copies
+        self.copy_ms_total = 0.0         # scatter into the output slab
+        self.span_ms_total = 0.0
+        self.encode_batches = 0
+        self.encode_ms_total = 0.0       # in-pool wall span per batch
+        self.encode_wait_ms_total = 0.0  # exposed drain wait per batch
+        self.send_batches = 0
+        self.send_ms_total = 0.0
+
+    def record_fetch(self, wait_ms: float, copy_ms: float,
+                     span_ms: float) -> None:
+        self.batches += 1
+        self.d2h_wait_ms_total += wait_ms
+        self.copy_ms_total += copy_ms
+        self.span_ms_total += span_ms
+
+    def record_encode(self, encode_ms: float, wait_ms: float) -> None:
+        self.encode_batches += 1
+        self.encode_ms_total += encode_ms
+        self.encode_wait_ms_total += wait_ms
+
+    def record_send(self, send_ms: float) -> None:
+        self.send_batches += 1
+        self.send_ms_total += send_ms
+
+    def overlap_efficiency(self) -> Optional[float]:
+        if (self.effective_mode != "streamed" or self.batches == 0
+                or not self.d2h_block_ms):
+            return None
+        exposed = (self.d2h_wait_ms_total + self.copy_ms_total) / self.batches
+        return max(0.0, min(1.0, (self.d2h_block_ms - exposed)
+                            / self.d2h_block_ms))
+
+    def summary(self) -> Dict[str, object]:
+        n = max(1, self.batches)
+        ne = max(1, self.encode_batches)
+        eff = self.overlap_efficiency()
+        return {
+            "mode": self.effective_mode,
+            "requested_mode": self.requested_mode,
+            "fallback_reason": self.fallback_reason,
+            "depth": self.depth,
+            "batches": self.batches,
+            "d2h_wait_ms": round(self.d2h_wait_ms_total / n, 4),
+            "copy_ms": round(self.copy_ms_total / n, 4),
+            "d2h_block_ms": (round(self.d2h_block_ms, 4)
+                             if self.d2h_block_ms else None),
+            "overlap_efficiency": (round(eff, 4)
+                                   if eff is not None else None),
+            "encode_batches": self.encode_batches,
+            "encode_ms": round(self.encode_ms_total / ne, 4),
+            "encode_wait_ms": round(self.encode_wait_ms_total / ne, 4),
+            "send_ms": round(self.send_ms_total
+                             / max(1, self.send_batches), 4),
+            "pool_allocs": self.pool_allocs,
+        }
+
+
 class RateLogger:
     """Periodic printer, like the reference's every-5s FPS prints
     (webcam_app.py:88-95)."""

@@ -22,6 +22,15 @@ transfer lane with per-shard spans:
   window in which transfers ran under decode of later shards and device
   compute of the previous batch. Reading the lane against the device
   lane in the merged export shows the stall the streaming removed.
+
+The streamed egress path (runtime/egress.py) mirrors it on the delivery
+side:
+
+- ``egress_d2h`` — one span per output shard's host copy (args: the
+  batch-row range and bytes fetched);
+- ``egress_encode`` — one batch's encode window inside the codec pool
+  (submit → last future done);
+- ``egress_send`` — one batch's wire sends.
 """
 
 from __future__ import annotations
@@ -37,6 +46,14 @@ from typing import Any, Dict, List, Optional
 INGEST_H2D = "ingest_h2d"
 INGEST_STAGE = "ingest_stage"
 INGEST_OVERLAP = "ingest_overlap"
+
+# Streamed-egress span names (runtime/egress.py — the delivery-side
+# mirror): one ``egress_d2h`` span per output-shard host copy, one
+# ``egress_encode`` span per batch's in-pool encode window (submit →
+# last future done), one ``egress_send`` span per batch's wire sends.
+EGRESS_D2H = "egress_d2h"
+EGRESS_ENCODE = "egress_encode"
+EGRESS_SEND = "egress_send"
 
 
 class Tracer:

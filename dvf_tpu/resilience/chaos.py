@@ -15,6 +15,8 @@ site        hook location                           default effect/kind
             received multipart message)             multipart → malformed
 ``h2d``     ``ingest.BatchBuilder._launch`` (per    raise ``h2d``
             shard ``device_put``)                   ChaosFault, or delay
+``d2h``     ``egress.ShardedBatchFetcher.fetch``    raise ``d2h``
+            (per output-shard host copy)            ChaosFault, or delay
 ``compute`` ``Engine.submit``/``submit_resident``   raise ``compute``
             (per batch)                             ChaosFault
 ``oom``     same engine hook, separate site         raise ``oom``
@@ -63,6 +65,7 @@ SITE_KINDS = {
     "decode": FaultKind.DECODE,
     "transport": FaultKind.TRANSPORT,
     "h2d": FaultKind.H2D,
+    "d2h": FaultKind.D2H,
     "compute": FaultKind.COMPUTE,
     "oom": FaultKind.OOM,
     "freeze": FaultKind.STALL,

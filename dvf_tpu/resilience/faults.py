@@ -35,8 +35,11 @@ class FaultKind:
 
     DECODE = "decode"        # frame/JPEG decode, source read
     GEOMETRY = "geometry"    # stream geometry changed mid-flight (re-probe)
-    TRANSPORT = "transport"  # malformed/truncated wire messages, socket errors
+    TRANSPORT = "transport"  # malformed/truncated wire messages, socket
+    #                          errors, result encode/send failures (the
+    #                          egress codec plane's wire-prep domain)
     H2D = "h2d"              # host→device transfer (device_put) failures
+    D2H = "d2h"              # device→host transfer (streamed result fetch)
     COMPUTE = "compute"      # the jitted step / result materialization
     OOM = "oom"              # device memory exhaustion
     STALL = "stall"          # watchdog: in-flight work older than the timeout
@@ -45,7 +48,7 @@ class FaultKind:
 
 ALL_KINDS = (
     FaultKind.DECODE, FaultKind.GEOMETRY, FaultKind.TRANSPORT,
-    FaultKind.H2D, FaultKind.COMPUTE, FaultKind.OOM,
+    FaultKind.H2D, FaultKind.D2H, FaultKind.COMPUTE, FaultKind.OOM,
     FaultKind.STALL, FaultKind.INTERNAL,
 )
 
@@ -62,6 +65,8 @@ _SITE_DEFAULT = {
     "decode": FaultKind.DECODE,
     "transport": FaultKind.TRANSPORT,
     "h2d": FaultKind.H2D,
+    "d2h": FaultKind.D2H,
+    "encode": FaultKind.TRANSPORT,   # egress codec plane: wire-prep domain
     "compute": FaultKind.COMPUTE,
     "worker": FaultKind.COMPUTE,     # worker loop: engine is the main residue
 }

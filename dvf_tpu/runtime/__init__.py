@@ -1,3 +1,7 @@
+from dvf_tpu.runtime.egress import (  # noqa: F401
+    AsyncCodecPlane,
+    ShardedBatchFetcher,
+)
 from dvf_tpu.runtime.engine import Engine  # noqa: F401
 from dvf_tpu.runtime.ingest import ShardedBatchAssembler  # noqa: F401
 from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig  # noqa: F401

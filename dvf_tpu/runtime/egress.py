@@ -1,0 +1,390 @@
+"""Streamed shard-level egress + asynchronous codec plane.
+
+The symmetric twin of :mod:`dvf_tpu.runtime.ingest`, on the D2H side.
+PR 3 streamed the ingest half (decode → per-shard H2D overlapped with
+compute), but every delivery path still blocked on a whole-batch
+``np.asarray(result)`` — one serializing fetch that allocates a fresh
+host batch — and only then encoded, serially. The measured head-to-head
+pins the cost: same-codec throughput is 1.27× the reference while
+raw-wire is 8.3× (benchmarks/REFERENCE_HEADTOHEAD.json) — the pipeline
+is egress/codec-bound. This module closes that gap with the same
+operation-overlap discipline, applied at delivery:
+
+- :class:`ShardedBatchFetcher` — per-output-shard ``copy_to_host_async``
+  issued the moment the batch is submitted (so D2H runs under the tail
+  of compute and the next batch's staging), materialized shard-by-shard
+  into a *preallocated* host slab at collect time (no per-batch
+  allocation; the copy of shard *i* overlaps the in-flight transfer of
+  shard *i+1*);
+- :class:`AsyncCodecPlane` — a bounded-window, order-preserving encoder
+  over the existing ``JpegCodec``/``NativeJpegCodec`` thread pools
+  (``encode_batch_async`` futures): the delivery loop submits a batch's
+  rows and returns to decoding/computing the NEXT batch while the pool
+  encodes; completed batches drain in submission order.
+
+Timeline, monolithic vs streamed (worker-style decode→compute→encode):
+
+    monolithic   decode ████ compute ████ fetch ███ encode ██████ send █
+    streamed     decode ████ compute ████ fetch ▒█          (prefetch hid
+                 encode        ░░ batch k−1 ░░  ██████       most of it)
+                 send                            batch k−1 █
+
+Fallbacks mirror the ingest assembler, recorded in the stats either way:
+
+- ``mode="monolithic"`` (the ``--egress monolithic`` escape hatch) and
+  results that are not shard-addressable keep the classic
+  ``np.asarray`` fetch — byte-for-byte the pre-streaming behavior;
+- a CPU-backend result's ``np.asarray`` is already a zero-copy view of
+  the runtime buffer, so any slab copy is pure added work
+  (``fallback_reason="zero_copy_backend"``; tests monkeypatch
+  ``STREAM_ON_CPU`` to exercise the machinery);
+- a calibrated blocking fetch (``Engine.d2h_block_ms``) below the fixed
+  streaming overhead stays monolithic (``"cheap_transfer"``, the mirror
+  of ingest's ``MIN_STREAM_H2D_MS`` guard);
+- repeated d2h faults degrade streamed → monolithic through the error
+  budget (``"d2h_fault_budget"``, wired in pipeline/serve/worker).
+
+Slot discipline is the staging-pool contract unchanged: the caller
+provides a monotonically increasing slot id per batch and guarantees
+(via its in-flight bound / encode window) that a slab is only revisited
+after its rows have been copied onward or sent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dvf_tpu.obs.metrics import EgressStats
+from dvf_tpu.obs.trace import EGRESS_D2H, EGRESS_ENCODE
+
+EGRESS_MODES = ("streamed", "monolithic")
+
+# Below this calibrated blocking-fetch cost (Engine.d2h_block_ms,
+# measured at compile), the fixed per-batch streaming overhead — shard
+# iteration, slab scatter — exceeds anything overlap can hide, so the
+# fetcher stays monolithic. Mirror of ingest.MIN_STREAM_H2D_MS; tests
+# that exercise the streaming machinery at tiny sizes monkeypatch to 0.
+MIN_STREAM_D2H_MS = 2.0
+
+# On the CPU backend ``np.asarray(result)`` of a single-device result is
+# a zero-copy view — the monolithic path costs literally nothing, and a
+# slab copy would be a pure regression. Tests monkeypatch True to run
+# the streamed machinery on the CPU test backend.
+STREAM_ON_CPU = False
+
+
+class ShardedBatchFetcher:
+    """Fetches engine results into preallocated host slabs, per shard.
+
+    One fetcher per (output signature, sharding); ``prefetch(result)``
+    belongs right after ``Engine.submit`` (it issues the per-shard
+    ``copy_to_host_async`` so the transfer overlaps the tail of compute),
+    ``fetch(result, slot)`` belongs in the collect path (it materializes
+    into the slot's slab and only *waits*, never initiates).
+
+    The returned array is the slab itself on the streamed path — valid
+    until the slot is revisited (the caller's in-flight bound), so
+    consumers that hold rows longer (reorder buffers) must copy them.
+    ``effective_mode`` tells the caller which contract applies; the
+    monolithic path returns a fresh per-batch array exactly as before.
+    """
+
+    def __init__(
+        self,
+        out_shape: Tuple[int, ...],
+        dtype,
+        sharding=None,
+        mode: str = "streamed",
+        slots: int = 5,
+        stats: Optional[EgressStats] = None,
+        tracer=None,
+        track: int = 0,
+        chaos=None,
+    ):
+        if mode not in EGRESS_MODES:
+            raise ValueError(f"egress mode must be one of {EGRESS_MODES}, "
+                             f"got {mode!r}")
+        self.out_shape = tuple(out_shape)
+        self.dtype = np.dtype(dtype)
+        self.sharding = sharding
+        self.mode = mode
+        self.slots = max(1, slots)
+        self.tracer = tracer
+        self.track = track
+        self.chaos = chaos  # resilience.chaos.FaultPlan — the "d2h"
+        #   injection site fires per shard fetch when armed
+        self.stats = stats if stats is not None else EgressStats(
+            requested_mode=mode)
+        self._pool: Optional[List[np.ndarray]] = None
+        self.effective_mode = self._plan()
+        self.stats.effective_mode = self.effective_mode
+
+    def _plan(self) -> str:
+        if self.mode == "monolithic" or self.sharding is None:
+            return "monolithic"
+        try:
+            dev = next(iter(self.sharding.device_set))
+            if dev.platform == "cpu" and not STREAM_ON_CPU:
+                self.stats.fallback_reason = "zero_copy_backend"
+                return "monolithic"
+        except Exception:  # noqa: BLE001 — exotic sharding: stay correct
+            self.stats.fallback_reason = "unsupported_sharding"
+            return "monolithic"
+        cal = self.stats.d2h_block_ms
+        if cal is not None and cal < MIN_STREAM_D2H_MS:
+            self.stats.fallback_reason = "cheap_transfer"
+            return "monolithic"
+        self._pool = [np.empty(self.out_shape, self.dtype)
+                      for _ in range(self.slots)]
+        self.stats.pool_allocs += 1
+        return "streamed"
+
+    # -- submit side ----------------------------------------------------
+
+    def prefetch(self, result: Any) -> None:
+        """Start the D2H now, overlapped with the next batch's staging and
+        the tail of this batch's compute; ``fetch`` then only waits for
+        completion instead of initiating the copy. Per shard on the
+        streamed path so each shard's copy is independently in flight."""
+        try:
+            if self.effective_mode == "streamed":
+                seen = set()
+                for sh in result.addressable_shards:
+                    # Same dedupe as fetch(): replicated placements hold
+                    # identical bytes on every device — starting N
+                    # identical transfers would waste N−1 batches of
+                    # link bandwidth on the submit hot path.
+                    key = tuple((sl.start, sl.stop, sl.step)
+                                for sl in sh.index)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    sh.data.copy_to_host_async()
+            else:
+                result.copy_to_host_async()
+        except AttributeError:
+            pass  # non-jax results (tests/fakes) have nothing to prefetch
+
+    # -- collect side ---------------------------------------------------
+
+    def _streamable(self, result: Any) -> bool:
+        return (self.effective_mode == "streamed"
+                and hasattr(result, "addressable_shards")
+                and getattr(result, "is_fully_addressable", True)
+                and tuple(result.shape) == self.out_shape)
+
+    def fetch(self, result: Any, slot: int) -> np.ndarray:
+        """Materialize one batch; blocks until the device is done (like
+        the ``np.asarray`` it replaces) but scatters shard host copies
+        into the slot's preallocated slab as each one lands."""
+        t_begin = time.perf_counter()
+        if not self._streamable(result):
+            # A mid-stream geometry change can hand this fetcher a batch
+            # compiled at another signature — fall back per batch rather
+            # than corrupt the slab. (Intentional monolithic mode and
+            # non-jax results land here too: the classic fetch.)
+            out = np.asarray(result)
+            self.stats.record_fetch(
+                wait_ms=0.0, copy_ms=0.0,
+                span_ms=(time.perf_counter() - t_begin) * 1e3)
+            return out
+        # Compute wait is not D2H: exclude it from the exposed-transfer
+        # clock so overlap_efficiency judges the fetch, not the device.
+        try:
+            result.block_until_ready()
+        except AttributeError:
+            pass
+        slab = self._pool[slot % self.slots]
+        wait_s = 0.0
+        copy_s = 0.0
+        seen = set()
+        tracer = self.tracer
+        for sh in result.addressable_shards:
+            # Replicated output placements hold identical bytes on every
+            # device — one host copy per distinct index range is enough.
+            key = tuple((sl.start, sl.stop, sl.step) for sl in sh.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            if self.chaos is not None:
+                # Injection site "d2h": a delay rule stalls this shard's
+                # fetch (models a congested link), a raise rule denies it
+                # — exactly where a real transfer fault would surface.
+                self.chaos.fire("d2h")
+            t0 = time.perf_counter()
+            host = np.asarray(sh.data)  # waits on THIS shard's copy only
+            t1 = time.perf_counter()
+            np.copyto(slab[sh.index], host)
+            t2 = time.perf_counter()
+            wait_s += t1 - t0
+            copy_s += t2 - t1
+            if tracer is not None and tracer.enabled:
+                off = time.time() - time.perf_counter()  # monotonic → wall
+                b0 = sh.index[0]
+                tracer.complete(
+                    EGRESS_D2H, t0 + off, t2 + off, self.track,
+                    rows=f"{b0.start or 0}:{b0.stop}", bytes=host.nbytes)
+        self.stats.record_fetch(
+            wait_ms=wait_s * 1e3, copy_ms=copy_s * 1e3,
+            span_ms=(time.perf_counter() - t_begin) * 1e3)
+        return slab
+
+    def owns(self, out: np.ndarray) -> bool:
+        """True when ``out`` is one of this fetcher's pooled slabs — i.e.
+        it will be rewritten once the slot cycles, so rows that outlive
+        the caller's collect step must be copied. The monolithic and
+        per-batch-fallback paths return fresh arrays and stay False."""
+        return self._pool is not None and any(out is s for s in self._pool)
+
+    def release(self) -> None:
+        """Drop the slab pool eagerly (geometry re-probe / degradation:
+        same rationale as ``ShardedBatchAssembler.release``)."""
+        self._pool = None
+
+
+class _EncodeEntry:
+    __slots__ = ("metas", "futures", "payloads", "t_submit", "t_done",
+                 "_remaining", "_lock")
+
+    def __init__(self, metas, futures, payloads, t_submit):
+        self.metas = metas
+        self.futures = futures      # None on the raw (no-encode) path
+        self.payloads = payloads    # raw path: zero-copy memoryviews
+        self.t_submit = t_submit
+        self.t_done = t_submit
+        self._remaining = len(futures) if futures else 0
+        self._lock = threading.Lock()
+
+    def mark_done(self) -> None:
+        """Done-callback (pool thread): stamps the batch's encode span
+        end when its last future completes."""
+        with self._lock:
+            self._remaining -= 1
+            if self._remaining == 0:
+                self.t_done = time.perf_counter()
+
+    def done(self) -> bool:
+        if self.futures is None:
+            return True
+        return all(f.done() for f in self.futures)
+
+    def collect(self) -> List[Tuple[Any, Any, Optional[BaseException]]]:
+        """(meta, payload, error) per row, in submission order; a failed
+        encode surfaces as its row's error instead of poisoning the
+        batch."""
+        if self.futures is None:
+            return [(m, p, None) for m, p in zip(self.metas, self.payloads)]
+        out = []
+        for meta, fut in zip(self.metas, self.futures):
+            try:
+                out.append((meta, fut.result(), None))
+            except Exception as e:  # noqa: BLE001 — per-row containment
+                out.append((meta, None, e))
+        return out
+
+
+class AsyncCodecPlane:
+    """Bounded-window, order-preserving async encode over a codec pool.
+
+    ``submit(rows, metas)`` hands one batch's rows to the codec's thread
+    pool (``encode_batch_async``) and returns immediately; ``ready()``
+    drains *completed head* batches — delivery order is submission order,
+    never completion order. ``ready(block=True)`` (or ``flush``) waits
+    for the head, which is how callers enforce the in-flight window:
+
+        plane.submit(rows, metas)
+        for batch in plane.ready(block=len(plane) > plane.depth):
+            for meta, payload, err in batch: …send…
+
+    The raw (``jpeg=False``) path skips the pool entirely and carries
+    each row as a zero-copy memoryview over the caller's slab — valid
+    until the slab slot is reused, which the window bound guarantees
+    happens only after the send (zmq copies at send time).
+
+    Thread contract: ``submit``/``ready``/``flush`` are called from one
+    delivery thread; only the future done-callbacks run in pool threads.
+    """
+
+    def __init__(self, codec, jpeg: bool = True, depth: int = 2,
+                 stats: Optional[EgressStats] = None, tracer=None,
+                 track: int = 0):
+        if depth < 1:
+            raise ValueError("encode depth must be >= 1")
+        self.codec = codec
+        self.jpeg = jpeg
+        self.depth = depth
+        self.stats = stats
+        self.tracer = tracer
+        self.track = track
+        self._pending: "deque[_EncodeEntry]" = deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def submit(self, rows: Sequence[np.ndarray], metas: Sequence[Any]) -> None:
+        t0 = time.perf_counter()
+        if self.jpeg:
+            futures = self.codec.encode_batch_async(rows)
+            entry = _EncodeEntry(list(metas), futures, None, t0)
+            for f in futures:
+                f.add_done_callback(lambda _f, e=entry: e.mark_done())
+        else:
+            # Raw wire: zero-copy memoryviews over the staged slab rows
+            # (flattened — the wire carries bytes, not shapes).
+            payloads = [row.reshape(-1).data for row in rows]
+            entry = _EncodeEntry(list(metas), None, payloads, t0)
+        self._pending.append(entry)
+
+    def ready(self, block: bool = False) -> List[list]:
+        """Completed head batches, each a list of (meta, payload, error)
+        rows. ``block=True`` waits for at least the head batch (the
+        window-bound path); completed non-head batches always wait their
+        turn — ordered delivery is the contract."""
+        out = []
+        while self._pending:
+            entry = self._pending[0]
+            if not entry.done():
+                if not block:
+                    break
+                tw = time.perf_counter()
+                if entry.futures is not None:
+                    for f in entry.futures:
+                        try:
+                            f.exception()  # waits; result errors surface
+                        except Exception:  # noqa: BLE001 — in collect()
+                            pass
+                wait_ms = (time.perf_counter() - tw) * 1e3
+            else:
+                wait_ms = 0.0
+            self._pending.popleft()
+            block = False  # only the head is owed a wait
+            # Future.done() flips before done-callbacks run, so the batch
+            # can be observed complete with t_done not yet stamped by
+            # mark_done — stamp it here rather than record a 0 ms span.
+            t_done = entry.t_done
+            if entry.futures is not None and t_done <= entry.t_submit:
+                entry.t_done = t_done = time.perf_counter()
+            if self.stats is not None:
+                self.stats.record_encode(
+                    encode_ms=(t_done - entry.t_submit) * 1e3,
+                    wait_ms=wait_ms)
+            tracer = self.tracer
+            if tracer is not None and tracer.enabled and entry.futures:
+                off = time.time() - time.perf_counter()
+                tracer.complete(EGRESS_ENCODE, entry.t_submit + off,
+                                max(entry.t_done, entry.t_submit) + off,
+                                self.track, rows=len(entry.metas))
+            out.append(entry.collect())
+        return out
+
+    def flush(self) -> List[list]:
+        """Drain everything, blocking until the pool finishes."""
+        out = []
+        while self._pending:
+            out.extend(self.ready(block=True))
+        return out

@@ -35,11 +35,12 @@ from typing import Any, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import IngestStats, LatencyStats, RateLogger
+from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats, RateLogger
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.resilience.supervisor import Supervisor
+from dvf_tpu.runtime.egress import EGRESS_MODES, ShardedBatchFetcher
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.sched.queues import DropOldestQueue
@@ -47,8 +48,9 @@ from dvf_tpu.sched.reorder import ReorderBuffer
 
 # Trace track ids (the reference maps worker pids to tracks,
 # distributor.py:129; our executors are stages, not processes).
-# TRACK_H2D is the streamed-ingest transfer lane (per-shard h2d spans).
-TRACK_INGEST, TRACK_DEVICE, TRACK_SINK, TRACK_H2D = 0, 1, 2, 3
+# TRACK_H2D is the streamed-ingest transfer lane (per-shard h2d spans);
+# TRACK_D2H is the streamed-egress mirror (per-shard egress_d2h spans).
+TRACK_INGEST, TRACK_DEVICE, TRACK_SINK, TRACK_H2D, TRACK_D2H = 0, 1, 2, 3, 4
 
 
 @dataclasses.dataclass
@@ -81,6 +83,12 @@ class PipelineConfig:
     ingest_depth: int = 4         # dispatch-depth knob: how many shard
     #   transfers may be in flight before the assembler blocks on the
     #   oldest (also the sub-chunking granularity of a device's shard)
+    egress: str = "streamed"      # result fetch path: "streamed" (default)
+    #   issues per-output-shard copy_to_host_async at submit and
+    #   materializes shard-by-shard into a preallocated host slab at
+    #   collect (runtime/egress.py — auto-degrades where streaming cannot
+    #   win, e.g. the CPU backend's zero-copy np.asarray); "monolithic"
+    #   is the escape hatch — the classic whole-batch np.asarray fetch.
     fault_budget: int = 16        # contained faults per kind inside
     #   fault_window_s before containment escalates (resilience.budget:
     #   drop → degrade → fail); resilient mode only
@@ -129,6 +137,10 @@ class Pipeline:
             raise ValueError(
                 f"ingest must be one of {INGEST_MODES}, got "
                 f"{self.config.ingest!r}")
+        if self.config.egress not in EGRESS_MODES:
+            raise ValueError(
+                f"egress must be one of {EGRESS_MODES}, got "
+                f"{self.config.egress!r}")
         self.engine = engine or Engine(filt, chaos=self.config.chaos)
         if self.config.chaos is not None and self.engine.chaos is None:
             self.engine.chaos = self.config.chaos  # arm a caller-built engine
@@ -162,6 +174,11 @@ class Pipeline:
         self._ingest_mode = self.config.ingest  # may degrade to monolithic
         #   after repeated h2d faults (budget escalation)
         self._degrade_reason: Optional[str] = None
+        self._egress_mode = self.config.egress  # the d2h mirror of the
+        #   above: repeated d2h faults degrade streamed → monolithic fetch
+        self._egress_degrade_reason: Optional[str] = None
+        self._fetcher: Optional[ShardedBatchFetcher] = None
+        self._egress_stats: Optional[EgressStats] = None
         self._supervisor: Optional[Supervisor] = None
         self._recovering = threading.Event()  # dispatch parks while the
         #   supervisor swaps the engine/assembler (see _on_stall)
@@ -283,6 +300,17 @@ class Pipeline:
             print("[pipeline] repeated h2d faults: degrading ingest "
                   "streamed → monolithic", file=sys.stderr, flush=True)
             return True
+        if kind == FaultKind.D2H and self._egress_mode == "streamed":
+            # The delivery-side mirror: repeated fetch faults fall back to
+            # the whole-batch np.asarray path (reason recorded in stats).
+            self._egress_mode = "monolithic"
+            self._egress_degrade_reason = "d2h_fault_budget"
+            old, self._fetcher = self._fetcher, None
+            if old is not None:
+                old.release()
+            print("[pipeline] repeated d2h faults: degrading egress "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
         return False
 
     def _on_stall(self, reason: str) -> None:
@@ -320,6 +348,8 @@ class Pipeline:
             # blocked on the semaphore wakes to the fresh engine.
             self.engine = self.engine.rebuild()
             self._assembler = None
+            self._fetcher = None  # rebuilt against the fresh engine's
+            #   re-calibrated d2h_block_ms on the next collect
             for _ in shed:
                 self._inflight_sem.release()
             # A batch already popped by collect and still materializing
@@ -400,6 +430,34 @@ class Pipeline:
                 # assembler's own replicated_layout/cheap_transfer reasons.
                 self._ingest_stats.fallback_reason = self._degrade_reason
         return asm.begin(slot)
+
+    def _fetcher_for(self):
+        """The streamed-egress fetcher for the engine's compiled output
+        signature (runtime/egress.py) — the delivery-side mirror of
+        ``_builder_for``. Slab pool is max_inflight + 1, same slot
+        discipline: the slab being rewritten belongs to a batch whose
+        rows were already copied onward by collect. Rebuilt when the
+        output signature changes (geometry change, engine rebuild)."""
+        shape = getattr(self.engine, "out_shape", None)
+        dtype = getattr(self.engine, "out_dtype", None)
+        if shape is None:
+            return None  # engine never compiled (shouldn't happen post-submit)
+        f = self._fetcher
+        if f is None or f.out_shape != tuple(shape) or f.dtype != dtype:
+            self._egress_stats = EgressStats(
+                requested_mode=self.config.egress,
+                d2h_block_ms=self.engine.d2h_block_ms)
+            self._fetcher = f = ShardedBatchFetcher(
+                shape, dtype, self.engine.output_sharding,
+                mode=self._egress_mode,
+                slots=self.config.max_inflight + 1,
+                stats=self._egress_stats,
+                tracer=self.tracer, track=TRACK_D2H,
+                chaos=self.config.chaos)
+            if self._egress_degrade_reason is not None:
+                self._egress_stats.fallback_reason = \
+                    self._egress_degrade_reason
+        return f
 
     def _drain_ready(self, pending: "deque") -> bool:
         """Inline collect: retire the oldest batch when the window is full,
@@ -492,14 +550,14 @@ class Pipeline:
                     t0 = time.time()
                     result = (self.engine.submit_resident(batch) if resident
                               else self.engine.submit(batch))
-                    # Start the D2H transfer now, overlapped with the next
-                    # batch's staging + device compute; the collect thread's
-                    # np.asarray then only waits for completion instead of
-                    # initiating the copy.
-                    try:
-                        result.copy_to_host_async()
-                    except AttributeError:
-                        pass
+                    # Start the D2H now — per output shard on the streamed
+                    # egress path — overlapped with the next batch's
+                    # staging + device compute; the collect side's fetch
+                    # then only waits for completion instead of initiating
+                    # the copy (runtime/egress.py).
+                    fetcher = self._fetcher_for()
+                    if fetcher is not None:
+                        fetcher.prefetch(result)
                 except Exception as e:  # noqa: BLE001 — drop this batch
                     if not inline:
                         self._inflight_sem.release()
@@ -530,8 +588,14 @@ class Pipeline:
     def _collect_one(self, seq, meta, valid, result, t0, release=True) -> bool:
         """Materialize one batch into the reorder buffer + sink; returns
         False only when an error escaped containment."""
+        fetcher = self._fetcher
         try:
-            out = np.asarray(result)  # blocks until the device is done
+            # Streamed egress: shard-by-shard host copies into the slot's
+            # preallocated slab (the D2H was issued at submit); monolithic
+            # or a non-streamable result: the classic np.asarray, blocking
+            # until the device is done.
+            out = (fetcher.fetch(result, seq) if fetcher is not None
+                   else np.asarray(result))
         except Exception as e:  # noqa: BLE001 — device error: drop batch
             if self._supervisor is not None:
                 self._supervisor.window.remove(seq)
@@ -548,8 +612,15 @@ class Pipeline:
             "batch_complete", t0, t1, TRACK_DEVICE,
             frames=[i for i, _ in meta],
         )
+        # Streamed fetch returns the slab itself, rewritten after
+        # max_inflight + 1 batches — rows that outlive this call (the
+        # reorder buffer holds them across the frame_delay window) must
+        # own their bytes. The monolithic path's fresh per-batch array
+        # keeps handing out views, exactly as before.
+        copy_rows = fetcher is not None and fetcher.owns(out)
         for row, (idx, ts) in enumerate(meta[:valid]):
-            self.reorder.complete(idx, (out[row], ts))
+            frame = out[row].copy() if copy_rows else out[row]
+            self.reorder.complete(idx, (frame, ts))
         self._deliver()
         return True
 
@@ -695,6 +766,8 @@ class Pipeline:
         }
         if self._ingest_stats is not None:
             out["ingest"] = self._ingest_stats.summary()
+        if self._egress_stats is not None:
+            out["egress"] = self._egress_stats.summary()
         if self.config.chaos is not None:
             out["chaos"] = self.config.chaos.summary()
         return out

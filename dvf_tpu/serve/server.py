@@ -44,10 +44,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import IngestStats, LatencyStats
+from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.resilience.supervisor import InflightWindow, Supervisor
+from dvf_tpu.runtime.egress import (
+    EGRESS_MODES,
+    AsyncCodecPlane,
+    ShardedBatchFetcher,
+)
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.serve.batcher import BatchPlan, ContinuousBatcher
@@ -97,6 +102,11 @@ class ServeConfig:
     #   assembler the single-stream pipeline uses); "monolithic": the
     #   classic stage-all → engine.submit path
     ingest_depth: int = 4         # in-flight shard-transfer window
+    egress: str = "streamed"      # result fetch path: "streamed" issues
+    #   per-output-shard copy_to_host_async at submit and materializes
+    #   into a preallocated host slab at collect (runtime/egress.py;
+    #   auto-degrades where streaming cannot win); "monolithic" is the
+    #   classic whole-batch np.asarray escape hatch
 
 
 class ServeFrontend:
@@ -120,6 +130,10 @@ class ServeFrontend:
             raise ValueError(
                 f"ingest must be one of {INGEST_MODES}, got "
                 f"{self.config.ingest!r}")
+        if self.config.egress not in EGRESS_MODES:
+            raise ValueError(
+                f"egress must be one of {EGRESS_MODES}, got "
+                f"{self.config.egress!r}")
         self.engine = engine or Engine(filt, chaos=self.config.chaos)
         if self.config.chaos is not None and self.engine.chaos is None:
             self.engine.chaos = self.config.chaos  # arm caller-built engine
@@ -148,6 +162,11 @@ class ServeFrontend:
         self._window = InflightWindow()
         self._ingest_mode = self.config.ingest  # may degrade to monolithic
         self._degrade_reason: Optional[str] = None
+        self._egress_mode = self.config.egress  # the d2h mirror: repeated
+        #   fetch faults degrade streamed → monolithic
+        self._egress_degrade_reason: Optional[str] = None
+        self._fetcher: Optional[ShardedBatchFetcher] = None
+        self._egress_stats: Optional[EgressStats] = None
         self._supervisor: Optional[Supervisor] = None
         self._recovering = threading.Event()  # dispatch parks while set
         self._dispatch_parked = threading.Event()  # ack of that park
@@ -334,6 +353,31 @@ class ServeFrontend:
                 self._ingest_stats.fallback_reason = self._degrade_reason
         return self._assembler.begin(seq)
 
+    def _fetcher_for(self):
+        """The streamed-egress fetcher for the engine's compiled output
+        signature — the delivery-side mirror of ``_builder_for``, same
+        slot discipline (max_inflight + 1 slabs; the router copies rows
+        out during route(), so a slab is quiescent before its slot
+        cycles). Built by the dispatch thread; the collect thread only
+        reads it."""
+        shape = getattr(self.engine, "out_shape", None)
+        if shape is None:
+            return None
+        f = self._fetcher
+        if f is None or f.out_shape != tuple(shape):
+            self._egress_stats = EgressStats(
+                requested_mode=self.config.egress,
+                d2h_block_ms=self.engine.d2h_block_ms)
+            self._fetcher = f = ShardedBatchFetcher(
+                shape, self.engine.out_dtype, self.engine.output_sharding,
+                mode=self._egress_mode,
+                slots=self.config.max_inflight + 1,
+                stats=self._egress_stats, chaos=self.config.chaos)
+            if self._egress_degrade_reason is not None:
+                self._egress_stats.fallback_reason = \
+                    self._egress_degrade_reason
+        return f
+
     def _fail(self, e: BaseException) -> None:
         if self._error is None:
             self._error = e
@@ -370,6 +414,15 @@ class ServeFrontend:
             self._degrade_reason = "h2d_fault_budget"
             self._assembler = None
             print("[serve] repeated h2d faults: degrading ingest "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
+        if kind == FaultKind.D2H and self._egress_mode == "streamed":
+            self._egress_mode = "monolithic"
+            self._egress_degrade_reason = "d2h_fault_budget"
+            old, self._fetcher = self._fetcher, None
+            if old is not None:
+                old.release()
+            print("[serve] repeated d2h faults: degrading egress "
                   "streamed → monolithic", file=sys.stderr, flush=True)
             return True
         if kind in (FaultKind.COMPUTE, FaultKind.OOM, FaultKind.INTERNAL):
@@ -472,6 +525,8 @@ class ServeFrontend:
                 t.start()
                 self.engine = self.engine.rebuild()
                 self._assembler = None
+                self._fetcher = None  # re-derive from the fresh engine's
+                #   re-calibrated d2h_block_ms
                 # Second straggler sweep: a dispatch iteration that was
                 # mid-staging when the drain above ran (wedged past the
                 # park deadline) has had the whole engine rebuild to land
@@ -574,10 +629,12 @@ class ServeFrontend:
                     batch, resident = builder.finish(plan.valid)
                     result = (self.engine.submit_resident(batch)
                               if resident else self.engine.submit(batch))
-                    try:
-                        result.copy_to_host_async()
-                    except AttributeError:
-                        pass
+                    # Start the D2H now — per output shard on the streamed
+                    # egress path — so the collect side only waits, never
+                    # initiates (runtime/egress.py).
+                    fetcher = self._fetcher_for()
+                    if fetcher is not None:
+                        fetcher.prefetch(result)
                 except Exception as e:  # noqa: BLE001 — drop this batch
                     sem.release()
                     self.router.discard(plan, kind=classify(e, "dispatch"))
@@ -621,8 +678,17 @@ class ServeFrontend:
                     if self._dispatch_done.is_set() and q.empty():
                         break
                     continue
+                fetcher = self._fetcher
                 try:
-                    out = np.asarray(result)  # waits for the device
+                    # Streamed egress: shard host copies into the slot's
+                    # preallocated slab (D2H issued at submit); fallback:
+                    # the classic whole-batch np.asarray. Either way this
+                    # waits for the device. The router copies rows out
+                    # during route(), so handing it the pooled slab is
+                    # safe — the slot only cycles max_inflight+1 batches
+                    # later.
+                    out = (fetcher.fetch(result, seq) if fetcher is not None
+                           else np.asarray(result))
                 except Exception as e:  # noqa: BLE001 — poisoned batch
                     if self._collect_gen != gen:
                         # Superseded mid-wait: make sure the plan's
@@ -688,6 +754,8 @@ class ServeFrontend:
                 [s.latency for s in every.values()]),
             **({"ingest": self._ingest_stats.summary()}
                if self._ingest_stats is not None else {}),
+            **({"egress": self._egress_stats.summary()}
+               if self._egress_stats is not None else {}),
             **({"supervisor": {
                     "stalls": self._supervisor.stalls,
                     "heartbeat_ages_s": self._supervisor.heartbeat_ages(),
@@ -717,6 +785,8 @@ class ZmqStreamBridge:
         use_jpeg: bool = True,
         raw_size: int = 512,
         jpeg_quality: int = 90,
+        codec_threads: int = 4,
+        encode_depth: int = 2,
         poll_ms: int = 10,
         slo_ms: Optional[float] = None,
     ):
@@ -729,7 +799,13 @@ class ZmqStreamBridge:
         self._ready = READY
         self.frontend = frontend
         self.session_id = frontend.open_stream(slo_ms=slo_ms)
-        self.codec = make_codec(quality=jpeg_quality)
+        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
+        # Asynchronous codec plane (runtime/egress.py): deliveries polled
+        # from the session are batch-encoded on the codec pool while the
+        # loop keeps pumping credits/frames; completed batches drain in
+        # order. Raw mode rides the same plane as zero-copy memoryviews.
+        self.plane = AsyncCodecPlane(self.codec, jpeg=use_jpeg,
+                                     depth=encode_depth)
         self.use_jpeg = use_jpeg
         self.raw_size = raw_size
         self.poll_ms = poll_ms
@@ -766,14 +842,15 @@ class ZmqStreamBridge:
         credits = 0
         served = 0
         budget = self.frontend.config.queue_size
-        # Deliveries popped from the session but not yet on the wire: a
-        # send timeout (stalled PULL peer) must re-try them next
-        # iteration, not discard frames that survived every other
-        # drop-bound in the system.
+        # Encoded deliveries not yet on the wire: a send timeout (stalled
+        # PULL peer) must re-try them next iteration, not discard frames
+        # that survived every other drop-bound in the system. Entries are
+        # (delivery, payload) — encoding happened on the codec plane, so
+        # a retry never pays the encode twice.
         out_pending: "collections.deque" = collections.deque()
         while not self._stop.is_set():
             in_send = False  # containment scope: True only while the
-            #   head out_pending delivery is being encoded/sent
+            #   head out_pending delivery is being sent
             try:
                 while credits < budget:
                     try:
@@ -795,13 +872,27 @@ class ZmqStreamBridge:
                 else:
                     credits = max(0, credits - 1)  # credit decay, see
                     #   transport.zmq_ingress._run_loop
-                out_pending.extend(self.frontend.poll(self.session_id))
+                # All pending deliveries go to the codec plane as ONE
+                # batch encode (pool-parallel), overlapped with the next
+                # iteration's decode/submit work; raw frames ride as
+                # zero-copy memoryviews (zmq copies at send).
+                fresh = self.frontend.poll(self.session_id)
+                if fresh:
+                    self.plane.submit([d.frame for d in fresh], fresh)
+                for batch in self.plane.ready(
+                        block=len(self.plane) > self.plane.depth):
+                    for d, payload, err in batch:
+                        if err is not None:
+                            self.errors += 1  # one bad frame: dropped
+                            print(f"[ZmqStreamBridge] encode failed "
+                                  f"(dropping frame): {err!r}",
+                                  file=sys.stderr)
+                            continue
+                        out_pending.append((d, payload))
                 while out_pending:
-                    d = out_pending[0]
+                    d, payload = out_pending[0]
                     in_send = True  # head delivery is now the one at risk
                     remote_idx, t0 = d.tag
-                    payload = (self.codec.encode_batch([d.frame])[0]
-                               if self.use_jpeg else d.frame.tobytes())
                     try:
                         self.push.send_multipart(result_msg(
                             remote_idx, pid, t0, time.time(), payload))
@@ -815,15 +906,38 @@ class ZmqStreamBridge:
             except Exception as e:  # noqa: BLE001 — per-iteration containment
                 self.errors += 1
                 if in_send and out_pending:
-                    # The head delivery's OWN encode/send raised (never
-                    # zmq.Again — that breaks out above): drop that one
-                    # frame so containment cannot spin on it forever.
-                    # Errors from the ingest half of the iteration leave
-                    # out_pending untouched — a queued good frame must
-                    # not pay for a corrupt incoming payload.
+                    # The head delivery's OWN send raised (never zmq.Again
+                    # — that breaks out above): drop that one frame so
+                    # containment cannot spin on it forever. Errors from
+                    # the ingest half of the iteration leave out_pending
+                    # untouched — a queued good frame must not pay for a
+                    # corrupt incoming payload.
                     out_pending.popleft()
                 print(f"[ZmqStreamBridge] error (continuing): {e!r}",
                       file=sys.stderr)
+        # Loop exit (stop() / max_frames): flush the codec plane and
+        # attempt the tail sends — frames already consumed from the
+        # session must not vanish because they were mid-encode when the
+        # loop ended (the worker's exit drain, mirrored; codec.close in
+        # close() would otherwise cancel the pending futures). Best
+        # effort: a stalled peer's zmq.Again bounds each send at SNDTIMEO.
+        try:
+            for batch in self.plane.flush():
+                for d, payload, err in batch:
+                    if err is None:
+                        out_pending.append((d, payload))
+                    else:
+                        self.errors += 1
+            while out_pending:
+                d, payload = out_pending.popleft()
+                remote_idx, t0 = d.tag
+                self.push.send_multipart(result_msg(
+                    remote_idx, pid, t0, time.time(), payload))
+                served += 1
+        except Exception as e:  # noqa: BLE001 — teardown best-effort
+            self.errors += 1
+            print(f"[ZmqStreamBridge] exit drain failed (dropping tail): "
+                  f"{e!r}", file=sys.stderr)
 
     def close(self) -> None:
         self._stop.set()

@@ -80,6 +80,13 @@ class JpegCodec:
     def encode_batch(self, frames: Sequence[np.ndarray]) -> List[bytes]:
         return list(self.pool.map(self.encode, frames))
 
+    def encode_batch_async(self, frames: Sequence[np.ndarray]) -> list:
+        """Submit each frame to the pool; returns ``[Future[bytes], …]``
+        in frame order — the asynchronous codec plane's entry point
+        (runtime/egress.py): the caller overlaps encode with the next
+        batch's decode/compute and drains futures in order."""
+        return [self.pool.submit(self.encode, f) for f in frames]
+
     def decode_batch(
         self, blobs: Sequence[bytes], out: Optional[np.ndarray] = None
     ) -> np.ndarray:
@@ -96,8 +103,17 @@ class JpegCodec:
             out[i] = f
         return out
 
+    def config(self) -> dict:
+        """Codec provenance for bench JSON: which backend/quality/threads
+        actually produced the encode numbers beside it."""
+        return {"backend": "cv2", "quality": self.quality,
+                "threads": self.pool._max_workers}
+
     def close(self) -> None:
-        self.pool.shutdown(wait=False)
+        # Join the pool: leaked codec threads across a long-lived server's
+        # codec churn (or a test session) accumulate; cancel_futures keeps
+        # the join bounded when an async encode window is still pending.
+        self.pool.shutdown(wait=True, cancel_futures=True)
 
 
 # -- native (jpeg_shim.cpp) ---------------------------------------------
@@ -229,6 +245,11 @@ class NativeJpegCodec:
     def encode_batch(self, frames: Sequence[np.ndarray]) -> List[bytes]:
         return list(self.pool.map(self.encode, frames))
 
+    def encode_batch_async(self, frames: Sequence[np.ndarray]) -> list:
+        """Submit each frame to the pool; returns ``[Future[bytes], …]``
+        in frame order (see :meth:`JpegCodec.encode_batch_async`)."""
+        return [self.pool.submit(self.encode, f) for f in frames]
+
     def decode_batch(
         self, blobs: Sequence[bytes], out: Optional[np.ndarray] = None
     ) -> np.ndarray:
@@ -241,8 +262,14 @@ class NativeJpegCodec:
         list(self.pool.map(self.decode_into, blobs, [out[i] for i in range(len(blobs))]))
         return out
 
+    def config(self) -> dict:
+        """Codec provenance for bench JSON (backend/quality/threads)."""
+        return {"backend": "native", "quality": self.quality,
+                "threads": self.pool._max_workers}
+
     def close(self) -> None:
-        self.pool.shutdown(wait=False)
+        # Join the pool (see JpegCodec.close): bounded by cancel_futures.
+        self.pool.shutdown(wait=True, cancel_futures=True)
 
 
 def measure_codec_fps(height: int, width: int, samples: int = 8,

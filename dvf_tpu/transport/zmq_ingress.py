@@ -30,9 +30,15 @@ from typing import Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
-from dvf_tpu.obs.metrics import IngestStats
+from dvf_tpu.obs.metrics import EgressStats, IngestStats
+from dvf_tpu.obs.trace import EGRESS_SEND
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
+from dvf_tpu.runtime.egress import (
+    EGRESS_MODES,
+    AsyncCodecPlane,
+    ShardedBatchFetcher,
+)
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.transport.codec import JpegGeometryError, make_codec
@@ -91,15 +97,23 @@ class TpuZmqWorker:
         transport: str = "list",
         ingest: str = "streamed",
         ingest_depth: int = 4,
+        egress: str = "streamed",
+        egress_depth: int = 2,
         fault_budget: int = 16,
         fault_window_s: float = 30.0,
         chaos=None,
+        tracer=None,
     ):
         import zmq
 
         if ingest not in INGEST_MODES:
             raise ValueError(f"ingest must be one of {INGEST_MODES}, "
                              f"got {ingest!r}")
+        if egress not in EGRESS_MODES:
+            raise ValueError(f"egress must be one of {EGRESS_MODES}, "
+                             f"got {egress!r}")
+        if egress_depth < 1:
+            raise ValueError("egress depth must be >= 1")
 
         if filt.stateful and not filt.pad_safe:
             # Short batches are padded by repeating the last frame; a
@@ -129,6 +143,10 @@ class TpuZmqWorker:
         self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
         self.ingest = ingest
         self.ingest_depth = ingest_depth
+        self.egress = egress
+        self.egress_depth = egress_depth
+        self.tracer = tracer  # optional obs.trace.Tracer: egress_encode /
+        #   egress_send spans land on track 0 when enabled
         self.faults = FaultStats()
         self.fault_budget = fault_budget
         self.fault_window_s = fault_window_s
@@ -138,6 +156,17 @@ class TpuZmqWorker:
         #   staged-batch assembler (_process_batch); replaces the old raw
         #   staging buffer — slabs are reused across batches identically
         self._ingest_stats: Optional[IngestStats] = None
+        # Streamed egress (runtime/egress.py): per-output-shard fetch into
+        # preallocated slabs + the asynchronous codec plane — encode/send
+        # of batch k overlap the decode/H2D/compute of batch k+1, bounded
+        # by egress_depth batches in flight. Slab pool is egress_depth + 1
+        # so a pending batch's rows (referenced by encode futures / raw
+        # memoryviews) are never rewritten before their sends complete.
+        self._fetcher: Optional[ShardedBatchFetcher] = None
+        self._egress_stats: Optional[EgressStats] = None
+        self._plane: Optional[AsyncCodecPlane] = None
+        self._egress_seq = 0
+        self._egress_degrade_reason: Optional[str] = None
         self.batch_size = batch_size
         self.assemble_timeout_s = assemble_timeout_s
         self.use_jpeg = use_jpeg
@@ -173,11 +202,6 @@ class TpuZmqWorker:
     def stop(self) -> None:
         self._stop.set()
 
-    def _encode(self, batch_u8: np.ndarray):
-        if self.use_jpeg:
-            return self.codec.encode_batch(list(batch_u8))
-        return [row.tobytes() for row in batch_u8]
-
     def _builder(self, h: int, w: int):
         """Per-geometry streamed assembler (runtime/ingest.py) — the same
         ingest implementation the pipeline and serving frontend use.
@@ -200,6 +224,106 @@ class TpuZmqWorker:
             if self._degrade_reason is not None:
                 self._ingest_stats.fallback_reason = self._degrade_reason
         return self._asm.begin(0)
+
+    def _fetcher_for(self):
+        """Per-output-signature streamed-egress fetcher + shared stats
+        (runtime/egress.py). Slab pool is egress_depth + 1: the encode
+        plane holds at most egress_depth batches' rows in flight, so the
+        slab being rewritten always belongs to a batch whose sends
+        completed. Rebuilt when the signature changes (geometry
+        re-probe), releasing the old pool eagerly."""
+        shape = getattr(self.engine, "out_shape", None)
+        if shape is None:
+            return None
+        f = self._fetcher
+        if f is None or f.out_shape != tuple(shape):
+            self._egress_stats = EgressStats(
+                requested_mode=self.egress, depth=self.egress_depth,
+                d2h_block_ms=self.engine.d2h_block_ms)
+            if f is not None:
+                f.release()
+            self._fetcher = f = ShardedBatchFetcher(
+                shape, self.engine.out_dtype, self.engine.output_sharding,
+                mode=self.egress, slots=self.egress_depth + 1,
+                stats=self._egress_stats, chaos=self.chaos)
+            if self._egress_degrade_reason is not None:
+                self._egress_stats.fallback_reason = \
+                    self._egress_degrade_reason
+            if self._plane is not None:
+                self._plane.stats = self._egress_stats
+        return f
+
+    def _plane_for(self):
+        """The asynchronous codec plane, shared across batches: encodes
+        on the codec's thread pool, drains in submission order, bounded
+        at egress_depth batches in flight."""
+        if self._plane is None:
+            self._plane = AsyncCodecPlane(
+                self.codec, jpeg=self.use_jpeg, depth=self.egress_depth,
+                stats=self._egress_stats, tracer=self.tracer)
+        return self._plane
+
+    def _pump_egress(self, pid: bytes, block: bool = False) -> None:
+        """Drain completed encode batches onto the wire, in order. A
+        failed encode drops its row; a failed send drops the batch
+        remainder (the pre-plane whole-batch at-most-once semantics) —
+        both counted under the ``transport`` fault kind and bounded by
+        the error budget, so a permanently dead collector still fails
+        instead of silently dropping forever."""
+        plane = self._plane
+        if plane is None:
+            return
+        for batch in plane.ready(block=block):
+            t_send = time.perf_counter()
+            for (idx, t0, t1), payload, err in batch:
+                if err is not None:
+                    self.errors += 1
+                    self.faults.record(FaultKind.TRANSPORT, err)
+                    if (escalate(self._budget, FaultKind.TRANSPORT,
+                                 self._degrade) == ErrorBudget.FAIL):
+                        raise FaultError(
+                            FaultKind.TRANSPORT,
+                            f"transport fault budget exhausted "
+                            f"(> {self.fault_budget} encode failures in "
+                            f"{self.fault_window_s:g}s); last: {err!r}",
+                            fatal=True) from err
+                    print(f"[TpuZmqWorker] encode failed (dropping "
+                          f"frame {idx}): {err!r}", file=sys.stderr)
+                    continue
+                try:
+                    self.push.send_multipart(
+                        result_msg(idx, pid, t0, t1, payload))
+                except Exception as e:  # noqa: BLE001 — dead/stalled peer
+                    self.errors += 1
+                    self.faults.record(FaultKind.TRANSPORT, e)
+                    if (escalate(self._budget, FaultKind.TRANSPORT,
+                                 self._degrade) == ErrorBudget.FAIL):
+                        raise FaultError(
+                            FaultKind.TRANSPORT,
+                            f"transport fault budget exhausted "
+                            f"(> {self.fault_budget} send failures in "
+                            f"{self.fault_window_s:g}s); last: {e!r}",
+                            fatal=True) from e
+                    print(f"[TpuZmqWorker] send failed (dropping batch "
+                          f"remainder): {e!r}", file=sys.stderr)
+                    break  # at-most-once: drop this batch's tail
+            t_done = time.perf_counter()
+            if self._egress_stats is not None:
+                self._egress_stats.record_send((t_done - t_send) * 1e3)
+            if self.tracer is not None and self.tracer.enabled:
+                off = time.time() - time.perf_counter()
+                self.tracer.complete(EGRESS_SEND, t_send + off,
+                                     t_done + off, 0, rows=len(batch))
+
+    def drain_egress(self, pid: Optional[bytes] = None) -> None:
+        """Flush the codec plane: block until every pending encode has
+        completed and its sends were attempted (clean shutdown, tests)."""
+        if self._plane is None:
+            return
+        if pid is None:
+            pid = str(os.getpid()).encode()
+        while len(self._plane):
+            self._pump_egress(pid, block=True)
 
     def _decode_jpeg(self, blobs, valid):
         """Decode a JPEG batch chunk-by-chunk into the assembler's shard
@@ -293,14 +417,26 @@ class TpuZmqWorker:
             # drop/reorder logic, like the reference's --delay
             # (inverter.py:37-38,55-56).
             time.sleep(self.delay_s)
-        out = np.asarray(self.engine.submit_resident(batch) if resident
-                         else self.engine.submit(batch))
+        result = (self.engine.submit_resident(batch) if resident
+                  else self.engine.submit(batch))
+        # Streamed egress: issue the per-shard D2H immediately, fetch into
+        # the preallocated slab, and hand the rows to the asynchronous
+        # codec plane — encode/send of THIS batch overlap the decode/H2D/
+        # compute of the next one (bounded at egress_depth batches).
+        fetcher = self._fetcher_for()
+        if fetcher is not None:
+            fetcher.prefetch(result)
+            out = fetcher.fetch(result, self._egress_seq)
+        else:
+            out = np.asarray(result)
+        self._egress_seq += 1
         t1 = time.time()
-        payloads = self._encode(out[:valid])
-        for idx, payload in zip(indices, payloads):
-            self.push.send_multipart(result_msg(idx, pid, t0, t1, payload))
+        plane = self._plane_for()
+        plane.submit([out[i] for i in range(valid)],
+                     [(idx, t0, t1) for idx in indices])
         self.frames_processed += valid
         self.batches += 1
+        self._pump_egress(pid, block=len(plane) > plane.depth)
 
     def run(self, max_frames: Optional[int] = None) -> None:
         """Serve until stop() (or until ``max_frames`` processed — tests).
@@ -321,6 +457,10 @@ class TpuZmqWorker:
     def _run_loop(self, pid, credits, pending, first_recv_t, max_frames):
         while not self._stop.is_set():
             try:
+                # Drain any encode batches the codec pool finished while
+                # this loop was decoding/computing — non-blocking, so an
+                # idle poll cycle still ships completed results promptly.
+                self._pump_egress(pid, block=False)
                 # Keep batch_size READYs outstanding so the app's ROUTER can
                 # stream us frames back-to-back (the reference worker holds
                 # exactly one, worker.py:39-46; credits generalize that).
@@ -432,6 +572,16 @@ class TpuZmqWorker:
                 # the loop by re-raising forever.
                 pending = []
                 first_recv_t = None
+        # Clean exit (stop() or max_frames): flush the codec plane so the
+        # tail batches reach the wire before run() returns — async egress
+        # must not turn a bounded serve into an at-most-once-minus-tail.
+        try:
+            self.drain_egress(pid)
+        except FaultError as e:
+            if e.fatal:
+                raise
+            self.errors += 1
+            self.faults.record(e.kind, e)
 
     def _degrade(self, kind: str) -> bool:
         """First-overflow degradation: repeated h2d faults fall back from
@@ -447,6 +597,15 @@ class TpuZmqWorker:
             print("[TpuZmqWorker] repeated h2d faults: degrading ingest "
                   "streamed → monolithic", file=sys.stderr, flush=True)
             return True
+        if kind == FaultKind.D2H and self.egress == "streamed":
+            self.egress = "monolithic"
+            self._egress_degrade_reason = "d2h_fault_budget"
+            old, self._fetcher = self._fetcher, None
+            if old is not None:
+                old.release()
+            print("[TpuZmqWorker] repeated d2h faults: degrading egress "
+                  "streamed → monolithic", file=sys.stderr, flush=True)
+            return True
         return False
 
     def stats(self) -> dict:
@@ -459,6 +618,8 @@ class TpuZmqWorker:
             "faults": self.faults.summary(),
             **({"ingest": self._ingest_stats.summary()}
                if self._ingest_stats is not None else {}),
+            **({"egress": self._egress_stats.summary()}
+               if self._egress_stats is not None else {}),
             **({"chaos": self.chaos.summary()}
                if self.chaos is not None else {}),
         }
@@ -471,6 +632,15 @@ class TpuZmqWorker:
         # wedged (e.g. mid-compile) we leak rather than segfault.
         got_lock = self._run_lock.acquire(timeout=10.0)
         try:
+            if got_lock:
+                # Best-effort flush of the codec plane before the pool is
+                # shut down (covers direct _process_batch drivers that
+                # never ran the loop's own exit drain).
+                try:
+                    self.drain_egress()
+                except Exception as e:  # noqa: BLE001 — teardown path
+                    print(f"[TpuZmqWorker] close(): egress drain failed: "
+                          f"{e!r}", file=sys.stderr)
             if self._ring is not None:
                 if got_lock:
                     self._ring.close()

@@ -30,8 +30,37 @@ if _platform == "cpu":
         # force_host_platform_device_count above already applies.
         pass
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def _codec_threads():
+    return {t for t in threading.enumerate()
+            if t.name.startswith("dvf-jpeg") and t.is_alive()}
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _codec_pools_joined_on_close():
+    """Codec pools must be joined on close (codec.close → pool.shutdown
+    wait=True): a leaked dvf-jpeg worker thread at session end means some
+    codec was never closed, or close() stopped joining — a long-lived
+    server churning codecs would accumulate threads forever. Session
+    scope (not per-test): module-scoped codec fixtures legitimately keep
+    a pool open across tests, but every pool must be gone once all
+    fixtures have finalized. A short grace window absorbs shutdown
+    latency; test_egress_stream pins the prompt-join property directly."""
+    yield
+    leaked = _codec_threads()
+    deadline = time.time() + 5.0
+    while leaked and time.time() < deadline:
+        time.sleep(0.05)
+        leaked = {t for t in leaked if t.is_alive()}
+    assert not leaked, (
+        f"codec pool threads leaked (close() not called, or no longer "
+        f"joining?): {sorted(t.name for t in leaked)}")
 
 
 def pytest_configure(config):

@@ -5,6 +5,7 @@ rate-controlled latency mode, adaptive knobs — not performance numbers.
 """
 
 import numpy as np
+import pytest
 
 from dvf_tpu.benchmarks import (
     bench_device_resident,
@@ -73,11 +74,22 @@ def test_stage_decomposition_fields():
 
     d = bench_stage_decomposition(get_filter("invert"), (1, 2), 16, 16, reps=3)
     # Self-describing keys (the pre-r06 payload published opaque "1"/"2")
-    # with the measured transfer mode recorded in-band.
-    assert set(d) == {"batch_1", "batch_2"}
-    for b, legs in d.items():
-        for k in ("staging_ms", "h2d_ms", "compute_ms", "d2h_ms"):
+    # with the measured transfer mode recorded in-band, plus the codec
+    # provenance for the encode leg (r06: quality/threads/backend must
+    # travel with the encode_ms they produced).
+    assert set(d) == {"batch_1", "batch_2", "codec"}
+    assert set(d["codec"]) == {"backend", "quality", "threads"}
+    assert d["codec"]["threads"] == 1  # per-frame serialized cost
+    for b in ("batch_1", "batch_2"):
+        legs = d[b]
+        for k in ("staging_ms", "h2d_ms", "compute_ms", "d2h_ms",
+                  "encode_ms"):
             assert legs[k] >= 0, (b, k, legs)
+        # encode_ms is reported beside the four serialized-transfer legs
+        # but excluded from their total (the codec plane overlaps it).
+        assert legs["total_ms"] == pytest.approx(
+            legs["staging_ms"] + legs["h2d_ms"] + legs["compute_ms"]
+            + legs["d2h_ms"], abs=0.01)
         assert legs["total_ms"] >= legs["compute_ms"]
         assert legs["transfer_mode"] == "whole_batch"
         assert legs["per_frame_compute_ms"] == round(

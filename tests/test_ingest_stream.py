@@ -460,7 +460,10 @@ def _zmq_process(ingest, batches=3, batch=4, size=16):
 
     class _StubPush:
         def send_multipart(self, parts):
-            sent.append(parts)
+            # Copy: raw-mode payloads are zero-copy memoryviews over the
+            # egress slab (real zmq copies at send; a capturing stub must
+            # too, or slab reuse would mutate earlier captures).
+            sent.append([bytes(p) for p in parts])
 
         def close(self, *a):
             pass
@@ -479,6 +482,10 @@ def _zmq_process(ingest, batches=3, batch=4, size=16):
                 pending.append((idx, f.tobytes()))
                 idx += 1
             worker._process_batch(pending, b"pid")
+        # The asynchronous codec plane may still hold the tail batches;
+        # a direct driver flushes explicitly (the run loop does this on
+        # exit).
+        worker.drain_egress(b"pid")
         out = {}
         for parts in sent:
             i = int(parts[0].decode())
@@ -614,6 +621,7 @@ def test_zmq_worker_steady_state_allocates_nothing(monkeypatch):
                         pending.append((idx, f.tobytes()))
                         idx += 1
                     worker._process_batch(pending, b"pid")
+                worker.drain_egress(b"pid")
             finally:
                 worker.close()
         finally:
