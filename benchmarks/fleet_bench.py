@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Fleet scaling round: N-replica aggregate throughput vs one engine.
+
+Runs ``dvf_tpu.benchmarks.bench_fleet_scaling`` (process replicas, each
+pinned to its own core, compute-dominated workload) and persists the
+round to ``benchmarks/FLEET_BENCH.json`` with timestamp + git rev.
+
+Reading the artifact: ``scaling["N"]`` is aggregate fps at N replicas
+over the 1-replica baseline; ``parallel_capacity`` is the measured
+CPU-parallelism of the machine (two busy processes vs one). Linear
+session scaling means ``scaling[N] ≈ min(N, parallel_capacity)`` — on a
+dedicated ≥N-core host the ≥1.8× bar at N=2, on an oversubscribed VM
+the fleet saturates whatever parallel capacity actually exists (the
+committed round from the CI container records capacity ≈ 1.3 and
+scaling to match; ``tests/test_fleet.py::test_two_replica_scaling``
+asserts the ≥1.8× bar wherever capacity permits).
+
+Usage: python benchmarks/fleet_bench.py [--sessions N] [--frames N]
+                                        [--replicas 1,2] [--size 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "FLEET_BENCH.json")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=300,
+                    help="frames per session per round")
+    ap.add_argument("--replicas", default="1,2",
+                    help="comma-separated replica counts to measure")
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--out", default=OUT_PATH)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dvf_tpu.benchmarks import bench_fleet_scaling
+
+    t0 = time.time()
+    result = bench_fleet_scaling(
+        sessions=args.sessions,
+        frames_per_session=args.frames,
+        height=args.size, width=args.size, batch=args.batch,
+        replica_counts=tuple(int(x) for x in args.replicas.split(",")),
+    )
+    try:
+        rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True,
+                             cwd=os.path.dirname(OUT_PATH)).stdout.strip()
+    except OSError:
+        rev = None
+    payload = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git_rev": rev,
+        "wall_s": round(time.time() - t0, 1),
+        "nproc": os.cpu_count(),
+        **result,
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -554,3 +554,165 @@ def bench_e2e_latency(
         attempts += 1
         target_fps = retry_target
         n_frames = retry_frames
+
+
+# The fleet scaling workload: compute-dominated on purpose (a fused
+# 3-deep blur chain runs ~7 ms/frame on one CPU core at 256², an order
+# of magnitude over the ~0.5 ms/frame the front door spends shipping a
+# frame), so the measured ratio is replica scaling, not RPC overhead.
+FLEET_BENCH_FILTER = (
+    "chain", {"specs": ["gaussian_blur", "gaussian_blur", "gaussian_blur"]})
+
+
+def measure_parallel_capacity(n: int = 2, seconds: float = 1.5) -> float:
+    """How much CPU-bound throughput ``n`` concurrent processes actually
+    get vs one — the machine's REAL parallel capacity, which is what an
+    N-replica CPU fleet scales into. On a dedicated host this is ~n; on
+    an oversubscribed VM it can be barely 1.x even when ``nproc`` says n
+    (observed on the CI container: nproc=2, capacity ≈ 1.3 — no quota,
+    just steal). The fleet scaling test is GUARDED on this number: a
+    ≥1.8× 2-replica claim is only falsifiable where the hardware can
+    express 2-way parallelism at all, exactly like a multi-device test
+    is guarded on device count. The bench records it beside the scaling
+    ratio so a capacity-bound artifact is self-describing."""
+    import subprocess
+    import sys
+
+    script = ("import time\nn=0\nt0=time.perf_counter()\n"
+              f"while time.perf_counter()-t0<{seconds}: n+=1\nprint(n)")
+
+    def run(k: int) -> int:
+        procs = [subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE, text=True)
+                 for _ in range(k)]
+        return sum(int(p.communicate()[0]) for p in procs)
+
+    one = run(1)
+    many = run(n)
+    return round(many / max(1, one), 3)
+
+
+def bench_fleet_scaling(
+    filter_spec=FLEET_BENCH_FILTER,
+    sessions: int = 2,
+    frames_per_session: int = 100,
+    height: int = 256,
+    width: int = 256,
+    batch: int = 4,
+    replica_counts=(1, 2),
+    mode: str = "process",
+    pin_replicas: bool = True,
+    deadline_s: float = 180.0,
+) -> dict:
+    """Fleet scaling round: aggregate multi-session throughput at each
+    replica count, same workload, same per-replica resources.
+
+    Per round: open ``sessions`` streams through a FleetFrontend with N
+    replicas, warm each replica (one delivered frame per session — the
+    engine compile must not sit inside the timed window), then blast
+    ``frames_per_session`` frames per session from one thread each and
+    time until every frame is delivered. Delivery polling runs
+    ``meta_only`` so the front door counts frames instead of copying N
+    replicas' pixels through one Python loop. ``scaling[n] =
+    fps[n] / fps[min]`` is the headline (the acceptance bar for a
+    2-replica CPU fleet is ≥ 1.8×); per-round ``faults``/``recoveries``
+    ride along replica-attributed so a dirty round is self-evident.
+
+    ``pin_replicas`` (process mode) pins replica i to CPU core i — the
+    CPU stand-in for "each replica owns its chips". Without it the
+    1-replica baseline's XLA pool spreads over every core and the fleet
+    has nothing left to scale into; with it both rounds hold per-replica
+    resources fixed, which is the claim being measured.
+    """
+    import threading
+
+    import numpy as np
+
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.serve import ServeConfig
+    frame = np.random.default_rng(7).integers(
+        0, 255, size=(height, width, 3), dtype=np.uint8)
+    rounds = {}
+    for n in replica_counts:
+        cfg = FleetConfig(
+            replicas=n, mode=mode, filter_spec=tuple(filter_spec),
+            serve=ServeConfig(
+                batch_size=batch,
+                max_sessions=max(16, sessions),
+                queue_size=frames_per_session + 8,  # throughput round:
+                #   no drop-oldest losses, the wall clock is the bound
+                out_queue_size=frames_per_session + 8,  # ditto on the
+                #   poll side: N fast replicas can outrun one poll loop
+                #   transiently; delivered frames must wait, not drop
+                slo_ms=600_000.0,
+            ),
+            pin_replicas_to_cores=(pin_replicas and mode == "process"),
+        )
+        fleet = FleetFrontend(config=cfg)
+        with fleet:
+            sids = [fleet.open_stream() for _ in range(sessions)]
+            # Warm every replica: one frame per session, delivered.
+            for sid in sids:
+                fleet.submit(sid, frame)
+            deadline = time.perf_counter() + deadline_s
+            warm = {sid: 0 for sid in sids}
+            while (any(c < 1 for c in warm.values())
+                   and time.perf_counter() < deadline):
+                for sid in sids:
+                    warm[sid] += len(fleet.poll(sid, meta_only=True))
+                time.sleep(0.002)
+
+            def blast(sid: str) -> None:
+                for _ in range(frames_per_session):
+                    fleet.submit(sid, frame)
+
+            threads = [threading.Thread(target=blast, args=(sid,))
+                       for sid in sids]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            got = {sid: 0 for sid in sids}
+            target = frames_per_session
+            while (any(c < target for c in got.values())
+                   and time.perf_counter() < deadline):
+                for sid in sids:
+                    got[sid] += len(fleet.poll(sid, meta_only=True))
+                # Throttle: a hot poll loop would burn a core of the
+                # parent's own and hammer every (pinned) worker with
+                # poll RPCs — the out queues are sized to hold the whole
+                # round, so coarse sweeps lose nothing but measurement
+                # granularity (~ms on a multi-second round).
+                time.sleep(0.004)
+            wall = time.perf_counter() - t0
+            for t in threads:
+                t.join()
+            stats = fleet.stats()
+        delivered = sum(got.values())
+        rounds[n] = {
+            "replicas": n,
+            "fps": round(delivered / wall, 2) if wall > 0 else 0.0,
+            "delivered": delivered,
+            "expected": sessions * frames_per_session,
+            "wall_s": round(wall, 3),
+            "sessions": sessions,
+            "faults": stats["faults"]["by_kind"],
+            "faults_by_replica": stats["faults"].get("by_replica", {}),
+            "recoveries": stats["recoveries"],
+            "spillovers": stats["spillovers"],
+            "per_replica_frames": {
+                rid: row.get("engine_frames")
+                for rid, row in stats["replicas"].items()},
+        }
+    base = min(replica_counts)
+    base_fps = rounds[base]["fps"] or 1e-9
+    return {
+        "parallel_capacity": measure_parallel_capacity(max(replica_counts)),
+        "mode": mode,
+        "filter": [filter_spec[0], filter_spec[1]],
+        "frame": [height, width, 3],
+        "batch": batch,
+        "pinned_replicas": bool(pin_replicas and mode == "process"),
+        "rounds": {str(n): r for n, r in rounds.items()},
+        "scaling": {str(n): round(rounds[n]["fps"] / base_fps, 3)
+                    for n in replica_counts},
+    }

@@ -539,6 +539,172 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _fleet_chaos_split(args):
+    """Split one ``--chaos`` spec into the fleet-level plan (``replica``
+    site rules — fired by the front door's health monitor) and the
+    serve-level spec string forwarded to every replica (which parses its
+    own plan, so per-replica event streams stay deterministic)."""
+    if not getattr(args, "chaos", None):
+        return None, None
+    fleet_rules, serve_rules = [], []
+    for part in args.chaos.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        (fleet_rules if part.split(":", 1)[0].strip() == "replica"
+         else serve_rules).append(part)
+    fleet_plan = None
+    if fleet_rules:
+        from dvf_tpu.resilience import FaultPlan
+
+        try:
+            fleet_plan = FaultPlan.parse(",".join(fleet_rules),
+                                         seed=args.chaos_seed)
+        except ValueError as e:
+            raise SystemExit(f"error: bad --chaos spec: {e}")
+    return fleet_plan, (",".join(serve_rules) or None)
+
+
+def cmd_fleet(args) -> int:
+    """Multi-replica serving demo: N synthetic client streams through a
+    FleetFrontend — one front door, ``--replicas`` engine replicas with
+    session affinity, spillover admission, and supervised replica
+    replacement. ``--scaling`` runs the fleet scaling round instead
+    (aggregate throughput at 1..N replicas; benchmarks/fleet_bench.py
+    persists the same round)."""
+    _force_platform()
+
+    import threading
+
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.serve import AdmissionError, ServeConfig
+
+    if args.scaling:
+        from dvf_tpu.benchmarks import bench_fleet_scaling
+
+        counts = tuple(sorted({1, args.replicas}))
+        out = bench_fleet_scaling(
+            sessions=args.sessions, frames_per_session=args.frames,
+            height=args.height, width=args.width, batch=args.batch,
+            replica_counts=counts, mode=args.mode)
+        print(json.dumps(out, default=float))
+        return 0
+
+    fleet_chaos, serve_chaos_spec = _fleet_chaos_split(args)
+    name = args.filter
+    if "|" in name:
+        members = [p.strip() for p in name.split("|") if p.strip()]
+        filter_spec = ("chain", {"specs": members})
+    else:
+        filter_spec = (name,
+                       json.loads(args.filter_config)
+                       if args.filter_config else {})
+    serve_cfg = ServeConfig(
+        batch_size=args.batch,
+        max_sessions=args.max_sessions if args.max_sessions else max(16, args.sessions),
+        queue_size=args.queue_size,
+        slo_ms=args.slo_ms,
+        ingest=args.ingest,
+        ingest_depth=args.ingest_depth,
+        egress=args.egress,
+        fault_budget=args.fault_budget,
+        fault_window_s=args.fault_window,
+        stall_timeout_s=(args.stall_timeout
+                         if args.stall_timeout is not None else 30.0),
+    )
+    config = FleetConfig(
+        replicas=args.replicas,
+        mode=args.mode,
+        serve=serve_cfg,
+        filter_spec=filter_spec,
+        health_poll_s=args.health_poll,
+        chaos=fleet_chaos,
+        chaos_spec=serve_chaos_spec,
+        chaos_seed=args.chaos_seed,
+        devices_per_replica=args.devices_per_replica,
+    )
+
+    n = args.sessions
+    base = args.rate if args.rate > 0 else 30.0
+    rates = [base * 2.0 * (i + 1) / (n + 1) for i in range(n)]
+    polled: dict = {}
+
+    fleet = FleetFrontend(config=config)
+
+    def drive(sid: str, rate: float, seed: int) -> None:
+        src = SyntheticSource(height=args.height, width=args.width,
+                              n_frames=args.frames, rate=rate, seed=seed)
+        for frame, ts in src:
+            if frame is None:
+                break
+            try:
+                fleet.submit(sid, frame, ts=ts)
+            except Exception:  # noqa: BLE001 — a session orphaned by
+                return         # replica loss just ends its stream
+
+    with fleet:
+        sids = []
+        for _ in range(n):
+            try:
+                sids.append(fleet.open_stream(
+                    slo_ms=args.slo_ms,
+                    frame_shape=(args.height, args.width, 3)))
+            except AdmissionError as e:
+                print(f"error: admission refused: {e}", file=sys.stderr)
+                return 2
+        drivers = [
+            threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
+            for i, (sid, rate) in enumerate(zip(sids, rates))
+        ]
+        for t in drivers:
+            t.start()
+        while any(t.is_alive() for t in drivers):
+            for sid in sids:
+                polled[sid] = polled.get(sid, 0) + len(
+                    fleet.poll(sid, meta_only=True))
+            time.sleep(0.01)
+        for sid in sids:
+            fleet.close(sid, drain=True)  # graceful: the tail serves
+        # Poll the tails until the fleet goes quiescent (no delivery for
+        # a grace window — sheds/drops mean polled < submitted is a
+        # legitimate end state, so "nothing moved" is the signal, with a
+        # first-compile-sized grace).
+        deadline = time.time() + 60.0
+        last_move = time.time()
+        while time.time() < deadline and time.time() - last_move < 3.0:
+            moved = 0
+            for sid in sids:
+                got = len(fleet.poll(sid, meta_only=True))
+                polled[sid] = polled.get(sid, 0) + got
+                moved += got
+            if moved:
+                last_move = time.time()
+            time.sleep(0.01)
+        stats = fleet.stats()
+
+    out = {
+        "replicas": {
+            rid: {k: row.get(k) for k in ("state", "restarts", "sessions",
+                                          "engine_frames", "recoveries")}
+            for rid, row in stats["replicas"].items()
+        },
+        "sessions": stats["sessions"],
+        "polled": polled,
+        "aggregate": stats["aggregate"],
+        "spillovers": stats["spillovers"],
+        "admission_rejections": stats["rejections"],
+        "replica_losses": stats["replica_losses"],
+        "migrated_sessions": stats["migrated_sessions"],
+        "order_violations": stats["order_violations"],
+        "faults": stats["faults"]["by_kind"],
+        "faults_by_replica": stats["faults"].get("by_replica", {}),
+        "recoveries": stats["recoveries"],
+    }
+    print(json.dumps(out, default=float))
+    return 0
+
+
 def cmd_worker(args) -> int:
     if args.stall_timeout is not None:
         # The worker's processing loop is synchronous (decode → step →
@@ -1166,6 +1332,45 @@ def main(argv=None) -> int:
                     help="admission cap for --sessions mode "
                          "(0 = max(16, --sessions))")
 
+    fl = sub.add_parser(
+        "fleet", parents=[plat, ing, res],
+        help="multi-replica serving: N engines behind one front door")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="engine replica count behind the front door")
+    fl.add_argument("--mode", choices=("local", "process"), default="process",
+                    help="replica transport: 'process' = one child "
+                         "process per replica (own jax runtime/cores — "
+                         "the scale-out shape); 'local' = in-process "
+                         "frontends on slices of the local device mesh")
+    fl.add_argument("--sessions", type=int, default=4,
+                    help="synthetic client streams to multiplex")
+    fl.add_argument("--filter", default="invert")
+    fl.add_argument("--filter-config", default=None,
+                    help="JSON kwargs for the filter")
+    fl.add_argument("--height", type=int, default=256)
+    fl.add_argument("--width", type=int, default=256)
+    fl.add_argument("--frames", type=int, default=120,
+                    help="frames per stream")
+    fl.add_argument("--rate", type=float, default=30.0,
+                    help="base stream fps (streams spread 0.4–1.6×)")
+    fl.add_argument("--batch", type=int, default=4)
+    fl.add_argument("--queue-size", type=int, default=10)
+    fl.add_argument("--slo-ms", type=float, default=1000.0)
+    fl.add_argument("--max-sessions", type=int, default=0,
+                    help="PER-REPLICA admission cap (0 = max(16, "
+                         "--sessions)); the fleet's total gate is the "
+                         "sum over healthy replicas")
+    fl.add_argument("--health-poll", type=float, default=0.25,
+                    help="replica health monitor cadence (seconds)")
+    fl.add_argument("--devices-per-replica", type=int, default=0,
+                    help="local mode: devices per replica engine "
+                         "(0 = even split)")
+    fl.add_argument("--scaling", action="store_true",
+                    help="run the fleet scaling round instead of the "
+                         "demo: aggregate throughput at 1 and "
+                         "--replicas replicas, core-pinned workers "
+                         "(benchmarks/fleet_bench.py persists this)")
+
     cp = sub.add_parser(
         "camera",  # host-only (no jax): the --platform flag would be a no-op
         help="push frames into a shared-memory ring for a serve process")
@@ -1278,7 +1483,7 @@ def main(argv=None) -> int:
     try:
         return {
             "filters": cmd_filters, "doctor": cmd_doctor,
-            "serve": cmd_serve, "worker": cmd_worker,
+            "serve": cmd_serve, "worker": cmd_worker, "fleet": cmd_fleet,
             "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
             "camera": cmd_camera,
         }[args.cmd](args)

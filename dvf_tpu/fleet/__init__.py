@@ -1,0 +1,41 @@
+"""Fleet tier: N engine replicas behind one front door.
+
+The scale-out layer above ``dvf_tpu/serve`` — the "millions of users"
+axis. One ``FleetFrontend`` routes client sessions across N complete
+replicas (each a ``ServeFrontend`` + engine, in-process on a device
+slice or in its own process) with session affinity, spillover admission,
+replica health tracking with drain → migrate → restart, and fleet-merged
+stats. Underneath, ``MultiHostEngine`` is the multi-process engine path:
+one replica spanning every host of a ``jax.distributed`` cluster, with
+per-host ingest/egress shards feeding one pjit program.
+"""
+
+from dvf_tpu.fleet.admission import SpilloverAdmission
+from dvf_tpu.fleet.multiproc import MultiHostEngine
+from dvf_tpu.fleet.replica import (
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    RESTARTING,
+    LocalReplica,
+    ProcessReplica,
+    ReplicaHandle,
+    ReplicaLostError,
+)
+from dvf_tpu.fleet.router import FLEET_MODES, FleetConfig, FleetFrontend
+
+__all__ = [
+    "DEAD",
+    "DRAINING",
+    "FLEET_MODES",
+    "FleetConfig",
+    "FleetFrontend",
+    "HEALTHY",
+    "LocalReplica",
+    "MultiHostEngine",
+    "ProcessReplica",
+    "RESTARTING",
+    "ReplicaHandle",
+    "ReplicaLostError",
+    "SpilloverAdmission",
+]

@@ -1,0 +1,156 @@
+"""Replica worker process: one ServeFrontend behind a pickle RPC.
+
+Spawned by ``fleet.replica.ProcessReplica`` as
+
+    python -m dvf_tpu.fleet._worker --port P --replica-id rN
+
+The child connects back to the parent's listener (no open ports of its
+own), receives the wire config (filter spec + ServeConfig fields + chaos
+spec — specs, not objects; see ProcessReplica), builds and starts the
+frontend, and then serves RPCs single-threaded: the frontend's own
+dispatch/collect threads do the concurrent work, so one request loop is
+enough, and it makes replica-side op ordering trivially serial.
+
+Platform/devices come from the environment the parent staged
+(``JAX_PLATFORMS``, ``XLA_FLAGS``): they must be set before jax imports,
+which is exactly what a fresh process guarantees and an in-process
+replica cannot — the reason the process transport exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _serve_config(fields: dict, chaos_spec, chaos_seed: int,
+                  replica_id: str):
+    from dvf_tpu.serve import ServeConfig
+
+    chaos = None
+    if chaos_spec:
+        from dvf_tpu.resilience import FaultPlan
+
+        chaos = FaultPlan.parse(chaos_spec, seed=chaos_seed)
+    return ServeConfig(**fields, chaos=chaos, replica_label=replica_id)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--replica-id", default="r?")
+    args = ap.parse_args(argv)
+
+    import socket
+
+    from dvf_tpu.fleet.replica import recv_msg, send_msg
+
+    sock = socket.create_connection((args.host, args.port), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    frontend = None
+    try:
+        send_msg(sock, ("hello", os.getpid()))
+        op = recv_msg(sock)
+        if op[0] != "config":
+            send_msg(sock, ("err", "ServeError", f"expected config, got {op[0]!r}"))
+            return 2
+        cfg = op[1]
+        # Pin BEFORE jax/XLA initialize (the frontend import below), so
+        # every thread the runtime spawns inherits the replica's core
+        # budget — the fleet's per-replica resource isolation on CPU.
+        if cfg.get("cpu_affinity") and hasattr(os, "sched_setaffinity"):
+            os.sched_setaffinity(0, set(cfg["cpu_affinity"]))
+        try:
+            import numpy as np
+
+            from dvf_tpu.ops import get_filter
+            from dvf_tpu.serve import ServeFrontend
+
+            name, kwargs = cfg["filter"]
+            frontend = ServeFrontend(
+                get_filter(name, **(kwargs or {})),
+                _serve_config(cfg.get("serve", {}), cfg.get("chaos_spec"),
+                              cfg.get("chaos_seed", 0),
+                              cfg.get("replica_id", args.replica_id)),
+            ).start()
+        except Exception as e:  # noqa: BLE001 — startup failure → parent
+            send_msg(sock, ("err", type(e).__name__, str(e)))
+            return 2
+        send_msg(sock, ("ready", os.getpid()))
+        submit_errors = 0
+
+        while True:
+            try:
+                op = recv_msg(sock)
+            except (ConnectionError, OSError):
+                break  # parent went away: shut down with it
+            kind = op[0]
+            if kind == "submit1":
+                # One-way hot path: NO reply (the fleet index is parent-
+                # assigned; an ack would serialize every frame on this
+                # loop's GIL latency). Errors are counted and exported
+                # via health/stats — the frames themselves are covered
+                # by at-most-once accounting.
+                _, sid, frame, ts, tag = op
+                try:
+                    frontend.submit(sid, frame, ts=ts, tag=tag)
+                except Exception as e:  # noqa: BLE001 — freshness-first
+                    submit_errors += 1
+                    print(f"[fleet-worker] submit dropped: {e!r}",
+                          file=sys.stderr, flush=True)
+                continue
+            try:
+                if kind == "stop":
+                    send_msg(sock, ("ok", None))
+                    break
+                elif kind == "open":
+                    _, sid, slo_ms, frame_shape, frame_dtype = op
+                    out = frontend.open_stream(
+                        session_id=sid, slo_ms=slo_ms,
+                        frame_shape=frame_shape,
+                        frame_dtype=(np.dtype(frame_dtype)
+                                     if frame_dtype else None))
+                elif kind == "poll":
+                    _, sid, max_items, meta_only = op
+                    got = frontend.poll(sid, max_items)
+                    out = ([d._replace(frame=None) for d in got]
+                           if meta_only else got)
+                elif kind == "close":
+                    _, sid, drain = op
+                    out = frontend.close(sid, drain=drain)
+                elif kind == "release":
+                    out = frontend.release(op[1])
+                elif kind == "drain":
+                    out = frontend.drain(timeout=op[1])
+                elif kind == "health":
+                    out = dict(frontend.health(),
+                               submit_errors=submit_errors)
+                elif kind == "stats":
+                    out = {"stats": frontend.stats(),
+                           "latency": frontend.latency_snapshot(),
+                           "health": dict(frontend.health(),
+                                          submit_errors=submit_errors)}
+                else:
+                    raise ValueError(f"unknown replica op {kind!r}")
+            except Exception as e:  # noqa: BLE001 — op errors cross the
+                # wire by name; the loop itself keeps serving
+                send_msg(sock, ("err", type(e).__name__, str(e)))
+                continue
+            send_msg(sock, ("ok", out))
+    finally:
+        if frontend is not None:
+            try:
+                frontend.stop(timeout=5.0)
+            except Exception:  # noqa: BLE001 — exit-path best effort
+                pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Fleet admission: least-loaded placement with spillover.
+
+The profiling-driven adaptive distributed-inference pattern (PAPERS.md,
+arXiv:2605.25682) at the serving layer: new sessions open on the
+least-loaded healthy replica; when that replica's own admission gate is
+full (``serve``-level ``max_sessions``), the open *spills over* to the
+next candidate instead of failing; only when EVERY healthy replica has
+refused does the fleet reject. Load is the router's count of sessions it
+has bound to each replica — a placement heuristic only; the replica's
+own gate stays the source of truth, so a stale count can cost one extra
+spillover hop, never a wrong admission.
+
+Affinity is the other half of placement and is deliberately NOT here:
+once a session is bound, every one of its frames goes to that replica
+(per-session index monotonicity needs one reorder buffer), so placement
+decisions happen only at open and at migration — both route through
+:meth:`SpilloverAdmission.candidates`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+class SpilloverAdmission:
+    """Candidate ordering + admission counters for the fleet router."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spillovers = 0   # opens that fell past their first choice
+        self.rejections = 0   # opens refused by every healthy replica
+
+    def candidates(
+        self,
+        replicas: Sequence,                  # ReplicaHandle, .state/.id
+        load: Dict[str, int],                # router's sessions-per-replica
+        exclude: Optional[Iterable[str]] = None,
+    ) -> List:
+        """Healthy replicas, least-loaded first (id as tiebreak so equal
+        loads place deterministically). ``exclude`` drops specific ids —
+        migration must not re-place a session on the replica it is
+        fleeing."""
+        from dvf_tpu.fleet.replica import HEALTHY
+
+        banned = set(exclude or ())
+        ok = [r for r in replicas
+              if r.state == HEALTHY and r.id not in banned]
+        return sorted(ok, key=lambda r: (load.get(r.id, 0), r.id))
+
+    def record_spillover(self, n: int = 1) -> None:
+        with self._lock:
+            self.spillovers += n
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self.rejections += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spillovers": self.spillovers,
+                    "rejections": self.rejections}

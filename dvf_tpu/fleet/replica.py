@@ -1,0 +1,498 @@
+"""Replica manager: one handle per engine replica, two transports.
+
+A *replica* is one complete ``serve.ServeFrontend`` (its own Engine, its
+own dispatch/collect threads, its own fault budgets and watchdog). The
+fleet router (`fleet.router`) talks to replicas only through the
+:class:`ReplicaHandle` interface defined here, so the same routing /
+affinity / drain logic runs over both transports:
+
+:class:`LocalReplica`
+    The frontend lives in this process, on a device *slice* of the local
+    mesh (N replicas partition ``jax.devices()``). Zero IPC cost — the
+    mode for single-process deployments, unit tests, and TPU hosts where
+    all replicas share one PJRT client.
+
+:class:`ProcessReplica`
+    The frontend lives in a child process (``fleet._worker``) with its
+    own jax runtime, reached over a length-prefixed pickle RPC on a
+    localhost socket. This is the scale-out shape: replica loss is a real
+    process death, replica restart is a real respawn, and on CPU each
+    replica owns its own cores/GIL — the configuration the fleet scaling
+    bench measures. A replica that should span *hosts* runs the
+    multi-process engine path (`fleet.multiproc.MultiHostEngine`) inside
+    its worker process, with the other hosts joining via
+    ``jax.distributed``.
+
+Every RPC failure (socket error, timeout, dead process) surfaces as
+:class:`ReplicaLostError`; the router classifies it as a ``replica``
+fault and runs the drain → migrate → restart procedure. Handles are
+transport only: session placement and health policy live in the router.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from dvf_tpu.serve.session import (
+    AdmissionError,
+    ServeError,
+    SessionClosedError,
+)
+
+# Replica lifecycle states (fleet-owned; the handle just stores them).
+HEALTHY, DRAINING, RESTARTING, DEAD = (
+    "healthy", "draining", "restarting", "dead")
+
+# Live replica child processes, for the session-end leak guard in
+# tests/conftest.py: a fleet test that leaks a worker process would
+# otherwise keep a whole jax runtime alive past the suite.
+_LIVE_PROCS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class ReplicaLostError(ServeError):
+    """The replica's process/channel is gone (or it timed out) — the
+    fleet tier's signal to drain, migrate, and restart."""
+
+
+# -- wire protocol (ProcessReplica <-> fleet._worker) --------------------
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    (n,) = struct.unpack("!I", header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the replica channel")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# Exceptions that cross the RPC boundary by NAME (worker sends
+# ("err", type_name, message); the parent re-raises the mapped type so
+# fleet admission/session semantics survive the process hop).
+_WIRE_ERRORS = {
+    "AdmissionError": AdmissionError,
+    "SessionClosedError": SessionClosedError,
+    "ServeError": ServeError,
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+}
+
+
+def raise_wire_error(type_name: str, message: str) -> None:
+    exc_type = _WIRE_ERRORS.get(type_name, ServeError)
+    if exc_type is KeyError:
+        raise KeyError(message)
+    raise exc_type(f"{message}" if exc_type is not ServeError
+                   else f"[{type_name}] {message}")
+
+
+# -- handle interface ----------------------------------------------------
+
+class ReplicaHandle:
+    """Transport-agnostic view of one replica (see module docstring)."""
+
+    def __init__(self, replica_id: str):
+        self.id = replica_id
+        self.state = DEAD          # until start() succeeds
+        self.restarts = 0
+        self.started_at: Optional[float] = None
+
+    # lifecycle
+    def start(self) -> "ReplicaHandle":
+        raise NotImplementedError
+
+    def stop(self, timeout: float = 10.0) -> None:
+        raise NotImplementedError
+
+    def restart(self) -> None:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        """Hard loss, for chaos/tests: the replica becomes unreachable
+        NOW (process replicas die for real)."""
+        raise NotImplementedError
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    # serving ops (any may raise ReplicaLostError)
+    def open_stream(self, session_id, slo_ms=None, frame_shape=None,
+                    frame_dtype=None) -> str:
+        raise NotImplementedError
+
+    def submit(self, session_id, frame, ts=None, tag=None) -> None:
+        """Enqueue one frame. No return value by contract: the fleet
+        assigns indices itself, and the process transport is one-way on
+        this path (see ProcessReplica._send_only)."""
+        raise NotImplementedError
+
+    def poll(self, session_id, max_items=None, meta_only=False) -> list:
+        raise NotImplementedError
+
+    def close(self, session_id, drain=True) -> None:
+        raise NotImplementedError
+
+    def release(self, session_id) -> None:
+        raise NotImplementedError
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        raise NotImplementedError
+
+    def health(self) -> dict:
+        raise NotImplementedError
+
+    def stats_full(self) -> dict:
+        """{"stats": frontend.stats(), "latency": latency_snapshot(),
+        "health": health()} — one RPC for the whole export."""
+        raise NotImplementedError
+
+
+class LocalReplica(ReplicaHandle):
+    """In-process replica: a ServeFrontend over a device slice."""
+
+    def __init__(self, replica_id: str, frontend_factory):
+        super().__init__(replica_id)
+        self._make = frontend_factory   # () -> started ServeFrontend
+        self.frontend = None
+        self._lost = False
+
+    def start(self) -> "LocalReplica":
+        self.frontend = self._make()
+        self._lost = False
+        self.state = HEALTHY
+        self.started_at = time.monotonic()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        fe, self.frontend = self.frontend, None
+        self.state = DEAD
+        if fe is not None:
+            try:
+                fe.stop(timeout=timeout)
+            except Exception:  # noqa: BLE001 — teardown best-effort; a
+                pass           # failed replica's stored error re-raises
+
+    def restart(self) -> None:
+        self.stop(timeout=2.0)
+        self.start()
+        self.restarts += 1  # on success only (see ProcessReplica)
+
+    def kill(self) -> None:
+        # Simulated hard loss: ops fail from now on; the abandoned
+        # frontend is torn down best-effort (unlike a process kill there
+        # is no OS to reap its threads for us). Lifecycle state is NOT
+        # touched — the router's monitor owns it: it must still see this
+        # replica as one whose loss needs handling.
+        self._lost = True
+        fe, self.frontend = self.frontend, None
+        if fe is not None:
+            try:
+                fe.stop(timeout=2.0)
+            except Exception:  # noqa: BLE001 — it is being abandoned
+                pass
+
+    def alive(self) -> bool:
+        return (not self._lost and self.frontend is not None
+                and self.frontend._error is None)
+
+    def _fe(self):
+        if self._lost or self.frontend is None:
+            raise ReplicaLostError(f"replica {self.id} is lost")
+        return self.frontend
+
+    def open_stream(self, session_id, slo_ms=None, frame_shape=None,
+                    frame_dtype=None) -> str:
+        return self._fe().open_stream(
+            session_id=session_id, slo_ms=slo_ms,
+            frame_shape=frame_shape, frame_dtype=frame_dtype)
+
+    def submit(self, session_id, frame, ts=None, tag=None) -> int:
+        return self._fe().submit(session_id, frame, ts=ts, tag=tag)
+
+    def poll(self, session_id, max_items=None, meta_only=False) -> list:
+        got = self._fe().poll(session_id, max_items)
+        if meta_only:
+            got = [d._replace(frame=None) for d in got]
+        return got
+
+    def close(self, session_id, drain=True) -> None:
+        self._fe().close(session_id, drain=drain)
+
+    def release(self, session_id) -> None:
+        self._fe().release(session_id)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        return self._fe().drain(timeout=timeout)
+
+    def health(self) -> dict:
+        return self._fe().health()
+
+    def stats_full(self) -> dict:
+        fe = self._fe()
+        return {"stats": fe.stats(), "latency": fe.latency_snapshot(),
+                "health": fe.health()}
+
+
+class ProcessReplica(ReplicaHandle):
+    """Replica in a child process, reached over the pickle RPC.
+
+    ``wire_config`` is the dict ``fleet._worker`` builds its frontend
+    from: ``{"replica_id", "filter": (name, kwargs), "serve": {simple
+    ServeConfig fields}, "chaos_spec", "chaos_seed"}`` — specs, not
+    objects, because filters (closures) and armed FaultPlans (locks)
+    don't pickle. Each replica parses its OWN chaos plan, so event
+    streams stay deterministic per replica.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        wire_config: dict,
+        env: Optional[Dict[str, str]] = None,
+        startup_timeout_s: float = 120.0,
+        rpc_timeout_s: float = 60.0,
+    ):
+        super().__init__(replica_id)
+        self._wire_config = dict(wire_config, replica_id=replica_id)
+        self._env = dict(env) if env is not None else None
+        self._startup_timeout_s = startup_timeout_s
+        self._rpc_timeout_s = rpc_timeout_s
+        self._proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._lost = False
+        self.pid: Optional[int] = None
+
+    def _child_env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        # The child defaults to ONE device and no test-harness device
+        # forcing: a replica's parallelism is its own mesh's business
+        # (override via the env dict for multi-device replicas).
+        env["XLA_FLAGS"] = ""
+        env.pop("JAX_NUM_CPU_DEVICES", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        if self._env:
+            env.update(self._env)
+        return env
+
+    def start(self) -> "ProcessReplica":
+        listener = socket.socket()
+        try:
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(1)
+            listener.settimeout(self._startup_timeout_s)
+            port = listener.getsockname()[1]
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "dvf_tpu.fleet._worker",
+                 "--port", str(port), "--replica-id", self.id],
+                env=self._child_env(),
+                stdout=subprocess.DEVNULL,
+                stderr=(None
+                        if os.environ.get("DVF_FLEET_WORKER_STDERR") == "1"
+                        else subprocess.DEVNULL),
+                # close_fds=False keeps posix_spawn eligible: a restart
+                # from a large parent (a loaded test suite, a long-lived
+                # server) must not have to FORK the whole address space
+                # just to exec a worker — observed as transient respawn
+                # failures under memory pressure. The worker dials its
+                # own socket and ignores inherited fds.
+                close_fds=False,
+            )
+            _LIVE_PROCS.add(self._proc)
+            try:
+                self._sock, _ = listener.accept()
+            except socket.timeout:
+                raise ReplicaLostError(
+                    f"replica {self.id}: worker never connected within "
+                    f"{self._startup_timeout_s:.0f}s (spawn failed?)")
+        finally:
+            listener.close()
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(self._startup_timeout_s)
+        hello = recv_msg(self._sock)
+        if not (isinstance(hello, tuple) and hello[0] == "hello"):
+            raise ReplicaLostError(f"replica {self.id}: bad hello {hello!r}")
+        self.pid = hello[1]
+        send_msg(self._sock, ("config", self._wire_config))
+        ready = recv_msg(self._sock)
+        if not (isinstance(ready, tuple) and ready[0] == "ready"):
+            raise ReplicaLostError(
+                f"replica {self.id}: worker failed to start: {ready!r}")
+        self._sock.settimeout(self._rpc_timeout_s)
+        self._lost = False
+        self.state = HEALTHY
+        self.started_at = time.monotonic()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self.state = DEAD
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.settimeout(min(timeout, 5.0))
+                send_msg(sock, ("stop",))
+                recv_msg(sock)
+            except Exception:  # noqa: BLE001 — it may already be dead
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        proc, self._proc = self._proc, None
+        if proc is not None:
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+
+    def restart(self) -> None:
+        self.stop(timeout=5.0)
+        self.start()
+        self.restarts += 1  # counted on SUCCESS only: the router's
+        #   restart budget bounds replica loss events, not respawn
+        #   attempts that never produced a replica
+
+    def kill(self) -> None:
+        # Real hard loss (state untouched — the router's monitor owns
+        # lifecycle and must still handle this as a fresh loss).
+        self._lost = True
+        if self._proc is not None:
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def alive(self) -> bool:
+        return (not self._lost and self._proc is not None
+                and self._proc.poll() is None)
+
+    def _rpc(self, op: Tuple, timeout: Optional[float] = None,
+             lock_timeout: Optional[float] = None) -> Any:
+        # The channel lock serializes ops on the one socket. A bounded
+        # lock_timeout keeps the health monitor's short-timeout probe
+        # honest: a submit's sendall against a non-draining worker can
+        # hold the lock for up to rpc_timeout_s, and the monitor must
+        # not be wedged behind it (a busy channel reads as "try next
+        # tick", not as replica loss — the blocked submit itself will
+        # classify a truly dead worker within its own socket timeout).
+        if lock_timeout is not None:
+            if not self._lock.acquire(timeout=lock_timeout):
+                raise TimeoutError(
+                    f"replica {self.id}: channel busy for "
+                    f"{lock_timeout:.1f}s (op {op[0]!r} skipped)")
+        else:
+            self._lock.acquire()
+        try:
+            if self._lost or self._sock is None:
+                raise ReplicaLostError(f"replica {self.id} is lost")
+            try:
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                send_msg(self._sock, op)
+                reply = recv_msg(self._sock)
+            except (OSError, ConnectionError, EOFError,
+                    pickle.UnpicklingError) as e:
+                self._lost = True
+                raise ReplicaLostError(
+                    f"replica {self.id}: RPC {op[0]!r} failed: {e!r}")
+            finally:
+                if timeout is not None and self._sock is not None:
+                    try:
+                        self._sock.settimeout(self._rpc_timeout_s)
+                    except OSError:
+                        pass
+        finally:
+            self._lock.release()
+        if reply[0] == "ok":
+            return reply[1]
+        if reply[0] == "err":
+            raise_wire_error(reply[1], reply[2])
+        raise ReplicaLostError(f"replica {self.id}: bad reply {reply[0]!r}")
+
+    def _send_only(self, op: Tuple) -> None:
+        """Fire-and-forget op (no reply): the hot submit path. Waiting
+        for a reply would serialize every frame on the worker's GIL
+        latency (~one thread-switch interval per frame — measured 5 ms,
+        an order of magnitude over the wire cost); the socket itself is
+        the backpressure — a slow worker fills its buffers and sendall
+        blocks. Replica-side errors are counted there and surface
+        through ``health()``/``stats`` (``submit_errors``) instead of a
+        per-frame ack; frame loss is already accounted by the fleet's
+        index-gap arithmetic (submitted − delivered)."""
+        with self._lock:
+            if self._lost or self._sock is None:
+                raise ReplicaLostError(f"replica {self.id} is lost")
+            try:
+                send_msg(self._sock, op)
+            except (OSError, ConnectionError) as e:
+                self._lost = True
+                raise ReplicaLostError(
+                    f"replica {self.id}: send {op[0]!r} failed: {e!r}")
+
+    def open_stream(self, session_id, slo_ms=None, frame_shape=None,
+                    frame_dtype=None) -> str:
+        return self._rpc(("open", session_id, slo_ms, frame_shape,
+                          str(frame_dtype) if frame_dtype is not None
+                          else None))
+
+    def submit(self, session_id, frame, ts=None, tag=None) -> None:
+        self._send_only(("submit1", session_id, frame, ts, tag))
+
+    def poll(self, session_id, max_items=None, meta_only=False) -> list:
+        return self._rpc(("poll", session_id, max_items, meta_only))
+
+    def close(self, session_id, drain=True) -> None:
+        self._rpc(("close", session_id, drain))
+
+    def release(self, session_id) -> None:
+        self._rpc(("release", session_id))
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        return self._rpc(("drain", timeout), timeout=timeout + 10.0)
+
+    def health(self) -> dict:
+        # Short timeouts on BOTH the socket and the channel lock: the
+        # monitor polls this at hertz rates and must never sit behind a
+        # slow submit for the full RPC budget (TimeoutError = "busy,
+        # retry next tick"; liveness and the submit path's own socket
+        # timeout still catch real deaths).
+        return self._rpc(("health",), timeout=5.0, lock_timeout=5.0)
+
+    def stats_full(self) -> dict:
+        return self._rpc(("stats",))
+
+
+def live_worker_processes() -> List[subprocess.Popen]:
+    """Still-running replica child processes (the conftest leak guard)."""
+    return [p for p in list(_LIVE_PROCS) if p.poll() is None]

@@ -1,0 +1,736 @@
+"""The fleet front door: N engine replicas behind one serving API.
+
+``FleetFrontend`` is the scale-out tier ABOVE ``serve.ServeFrontend``:
+the same open/submit/poll/close/stats surface, but backed by N complete
+replicas (each a frontend + engine, in-process on a device slice or in
+its own process — `fleet.replica`). What the fleet adds over one
+frontend:
+
+**Session affinity.** A session is bound to one replica at open and every
+one of its frames goes there — per-session index monotonicity needs one
+reorder buffer, so affinity is correctness, not just cache-friendliness.
+The fleet owns the *client-visible* index space (submit assigns fleet
+indices, carried through the replica as the slot ``tag`` exactly like the
+ZMQ bridge carries remote indices), so a session keeps its index space
+across a replica migration.
+
+**Spillover admission.** Opens place on the least-loaded healthy replica
+and spill to the next when a replica's own gate refuses; the fleet
+rejects only when every healthy replica has (`fleet.admission`).
+
+**Replica health + supervised replacement.** A monitor thread polls
+liveness and each replica's ``health()`` export (fed by the PR 4
+supervisor: a frontend that exhausted a fault budget or declared its
+engine unrecoverable reads ``ok: False``). A lost or unhealthy replica is
+DRAINED — no new sessions, bound sessions migrate to surviving replicas
+(their delivered tail is salvaged when the replica is still reachable;
+frames in flight on a dead one are gone: the reference's at-most-once
+semantics, now one level up) — then restarted and rejoined, bounded by
+``max_restarts``. Losses are classified as ``replica`` faults,
+attributed per replica (`resilience.faults`), and injectable via the
+``replica`` chaos site (`resilience.chaos`).
+
+**Fleet stats.** Per-replica exports merge into one view: weighted
+latency snapshots → fleet p50/p99 (``LatencyStats.merge_snapshots``),
+fault summaries → one table with ``by_replica`` attribution
+(`fleet.stats`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dvf_tpu.fleet.admission import SpilloverAdmission
+from dvf_tpu.fleet.replica import (
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    RESTARTING,
+    LocalReplica,
+    ProcessReplica,
+    ReplicaHandle,
+    ReplicaLostError,
+)
+from dvf_tpu.fleet.stats import (
+    merge_fault_summaries,
+    merge_latency_snapshots,
+    replica_row,
+)
+from dvf_tpu.resilience.faults import FaultKind, FaultStats
+from dvf_tpu.serve import ServeConfig
+from dvf_tpu.serve.session import (
+    AdmissionError,
+    Delivery,
+    ServeError,
+    SessionClosedError,
+)
+
+FLEET_MODES = ("local", "process")
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    replicas: int = 2
+    mode: str = "local"           # "local": in-process frontends on
+    #   device slices (one jax runtime); "process": one child process
+    #   per replica (own jax runtime, own cores — the scale-out shape)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    #   per-replica frontend template (replica_label is stamped per
+    #   replica; chaos stays fleet-level — see chaos/chaos_spec below)
+    filter_spec: Optional[Tuple[str, dict]] = None  # (name, kwargs) for
+    #   process replicas, which rebuild the filter from the registry
+    #   (closures don't pickle); optional sugar for local mode too
+    health_poll_s: float = 0.25   # monitor cadence (liveness + health())
+    max_restarts: int = 2         # per replica, before it stays DEAD
+    migrate: bool = True          # move a lost replica's sessions to
+    #   survivors (False: they close; the client sees SessionClosedError)
+    devices_per_replica: int = 0  # local mode: devices per engine slice
+    #   (0 = even split of jax.devices() across replicas)
+    replica_env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #   process mode: extra env for workers
+    pin_replicas_to_cores: bool = False  # process mode: pin replica i to
+    #   CPU core i (round-robin over this process's affinity mask) — the
+    #   CPU-backend stand-in for "each replica owns its chips": without
+    #   it one replica's XLA pool spreads over every core and an N-
+    #   replica fleet has nothing left to scale into (the fleet scaling
+    #   bench pins; serving defaults don't)
+    startup_timeout_s: float = 120.0
+    rpc_timeout_s: float = 60.0
+    drain_timeout_s: float = 10.0
+    max_retired: int = 64         # closed sessions kept poll-able; the
+    #   oldest (and its salvaged tail frames) evicted beyond this —
+    #   serve's retention discipline, mirrored: a churning fleet must
+    #   not pin every dead session's tail forever
+    chaos: Any = None             # fleet-level FaultPlan: the "replica"
+    #   site fires in the health monitor (one event per replica per
+    #   tick); per-replica serve-level chaos rides chaos_spec instead so
+    #   each replica owns a deterministic plan of its own
+    chaos_spec: Optional[str] = None
+    chaos_seed: int = 0
+
+
+class _FleetSession:
+    """Fleet-side record of one client session: its replica binding, the
+    client-visible index space, and the migration bookkeeping."""
+
+    __slots__ = ("sid", "replica_id", "replica_sid", "generation",
+                 "next_index", "last_index", "slo_ms", "frame_shape",
+                 "frame_dtype", "lock", "tail", "migrations", "lost",
+                 "polled", "closed", "orphaned", "load_counted")
+
+    def __init__(self, sid: str, replica_id: str, slo_ms, frame_shape,
+                 frame_dtype):
+        self.sid = sid
+        self.replica_id = replica_id
+        self.replica_sid = sid           # sid@gN after migrations
+        self.generation = 0
+        self.next_index = 0              # fleet-owned index space
+        self.last_index = -1             # monotonicity watermark (poll)
+        self.slo_ms = slo_ms
+        self.frame_shape = frame_shape   # declared at open (may be None)
+        self.frame_dtype = frame_dtype
+        self.lock = threading.Lock()
+        self.tail: List[Delivery] = []   # salvaged pre-migration deliveries
+        self.migrations = 0
+        self.lost = 0                    # submits dropped on a lost replica
+        self.polled = 0                  # deliveries handed to the client
+        self.closed = False
+        self.orphaned = False            # no replica could take it
+        self.load_counted = True         # guards double-decrement
+
+
+class FleetFrontend:
+    """N-replica serving tier behind one front door (module docstring)."""
+
+    def __init__(self, filt=None, config: Optional[FleetConfig] = None):
+        self.config = config or FleetConfig()
+        if self.config.mode not in FLEET_MODES:
+            raise ValueError(
+                f"mode must be one of {FLEET_MODES}, got "
+                f"{self.config.mode!r}")
+        if self.config.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.config.mode == "process" and self.config.filter_spec is None:
+            raise ValueError(
+                "process mode needs filter_spec=(name, kwargs): a filter "
+                "object's closures cannot cross the process boundary")
+        if filt is None:
+            if self.config.filter_spec is None:
+                raise ValueError("need a filter or config.filter_spec")
+            from dvf_tpu.ops import get_filter
+
+            name, kwargs = self.config.filter_spec
+            filt = get_filter(name, **(kwargs or {}))
+        self.filter = filt
+        self.faults = FaultStats()        # fleet-observed faults (replica
+        #   losses), attributed per replica via record(..., replica=)
+        self.admission = SpilloverAdmission()
+        self.replica_losses = 0
+        self.migrated_sessions = 0
+        self.orphaned_sessions = 0
+        self.order_violations = 0         # should stay 0: the affinity +
+        #   migration protocol guarantees per-session index monotonicity
+        self._replicas: "Dict[str, ReplicaHandle]" = {}
+        self._load: Dict[str, int] = {}
+        self._sessions: Dict[str, _FleetSession] = {}
+        self._retired: Dict[str, _FleetSession] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()       # session/load registries
+        self._open_lock = threading.Lock()  # serializes placements
+        self._loss_lock = threading.Lock()  # serializes loss handling
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._started = False
+        for i in range(self.config.replicas):
+            rid = f"r{i}"
+            self._replicas[rid] = self._make_replica(rid, i)
+            self._load[rid] = 0
+
+    # -- replica construction -------------------------------------------
+
+    def _make_replica(self, rid: str, index: int) -> ReplicaHandle:
+        if self.config.mode == "process":
+            serve_fields = {
+                f.name: getattr(self.config.serve, f.name)
+                for f in dataclasses.fields(ServeConfig)
+                if f.name not in ("chaos", "replica_label")
+            }
+            affinity = None
+            if self.config.pin_replicas_to_cores:
+                import os as _os
+
+                if hasattr(_os, "sched_getaffinity"):
+                    cores = sorted(_os.sched_getaffinity(0))
+                    affinity = [cores[index % len(cores)]]
+            return ProcessReplica(
+                rid,
+                wire_config={
+                    "filter": self.config.filter_spec,
+                    "serve": serve_fields,
+                    "chaos_spec": self.config.chaos_spec,
+                    "chaos_seed": self.config.chaos_seed + index,
+                    "cpu_affinity": affinity,
+                },
+                env=self.config.replica_env,
+                startup_timeout_s=self.config.startup_timeout_s,
+                rpc_timeout_s=self.config.rpc_timeout_s,
+            )
+        return LocalReplica(rid, self._local_factory(rid, index))
+
+    def _local_factory(self, rid: str, index: int):
+        """Factory for one in-process replica: a frontend whose engine
+        lives on this replica's slice of the local devices — N local
+        replicas partition ``jax.devices()`` instead of contending for
+        all of them."""
+        config = self.config
+
+        def make():
+            import jax
+
+            from dvf_tpu.parallel.mesh import auto_mesh_config, make_mesh
+            from dvf_tpu.runtime.engine import Engine
+            from dvf_tpu.serve import ServeFrontend
+
+            devs = jax.devices()
+            per = config.devices_per_replica or max(
+                1, len(devs) // config.replicas)
+            start = (index * per) % len(devs)
+            chunk = devs[start:start + per] or devs[:1]
+            chaos = None
+            if config.chaos_spec:
+                from dvf_tpu.resilience import FaultPlan
+
+                chaos = FaultPlan.parse(config.chaos_spec,
+                                        seed=config.chaos_seed + index)
+            scfg = dataclasses.replace(config.serve, replica_label=rid,
+                                       chaos=chaos)
+            engine = Engine(self.filter,
+                            mesh=make_mesh(auto_mesh_config(len(chunk)),
+                                           devices=chunk))
+            return ServeFrontend(self.filter, scfg, engine=engine).start()
+
+        return make
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "FleetFrontend":
+        if self._started:
+            raise ServeError("fleet already started")
+        self._started = True
+        errors: List[BaseException] = []
+
+        def boot(r: ReplicaHandle) -> None:
+            try:
+                r.start()
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=boot, args=(r,),
+                                    name=f"dvf-fleet-boot-{r.id}")
+                   for r in self._replicas.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            self.stop()
+            raise ServeError(f"fleet start failed: {errors[0]!r}") from errors[0]
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="dvf-fleet-health", daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=timeout)
+            self._monitor = None
+        threads = [threading.Thread(target=r.stop, args=(timeout,),
+                                    name=f"dvf-fleet-stop-{r.id}")
+                   for r in self._replicas.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+
+    def __enter__(self) -> "FleetFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API -----------------------------------------------------
+
+    def open_stream(
+        self,
+        session_id: Optional[str] = None,
+        slo_ms: Optional[float] = None,
+        frame_shape: Optional[tuple] = None,
+        frame_dtype: Any = None,
+    ) -> str:
+        """Admit one stream on the least-loaded healthy replica,
+        spilling over when a replica's own gate refuses; raises
+        ``AdmissionError`` only when every healthy replica has."""
+        with self._open_lock:
+            sid = (session_id if session_id is not None
+                   else f"fs{next(self._ids)}")
+            with self._lock:
+                if sid in self._sessions or sid in self._retired:
+                    raise ServeError(f"session id {sid!r} already exists")
+                load = dict(self._load)
+            cands = self.admission.candidates(
+                list(self._replicas.values()), load)
+            if not cands:
+                self.admission.record_rejection()
+                raise AdmissionError("no healthy replicas in the fleet")
+            hops = 0
+            last_refusal: Optional[AdmissionError] = None
+            for r in cands:
+                born = r.started_at  # incarnation marker, see below
+                try:
+                    r.open_stream(sid, slo_ms=slo_ms,
+                                  frame_shape=frame_shape,
+                                  frame_dtype=frame_dtype)
+                except AdmissionError as e:
+                    last_refusal = e
+                    hops += 1
+                    continue
+                except ReplicaLostError as e:
+                    self._note_loss(r, e)
+                    hops += 1
+                    continue
+                if hops:
+                    self.admission.record_spillover(hops)
+                s = _FleetSession(sid, r.id, slo_ms, frame_shape,
+                                  frame_dtype)
+                with self._lock:
+                    self._sessions[sid] = s
+                    self._load[r.id] = self._load.get(r.id, 0) + 1
+                if r.state != HEALTHY or r.started_at != born:
+                    # The replica was lost (or already replaced — fresh
+                    # started_at) between the replica-side open and our
+                    # registration, so the monitor's session snapshot
+                    # missed this one: migrate it ourselves instead of
+                    # handing the client a permanently stranded sid.
+                    self._migrate(s, r, reachable=False)
+                return sid
+            self.admission.record_rejection()
+            raise AdmissionError(
+                f"every healthy replica refused this stream "
+                f"({len(cands)} tried; last refusal: {last_refusal})")
+
+    def submit(self, session_id: str, frame: np.ndarray,
+               ts: Optional[float] = None, tag: Any = None) -> int:
+        """Enqueue one frame; returns its FLEET index — the session's
+        client-visible index space, owned here so it survives replica
+        migration. A frame submitted while the session's replica is lost
+        (pre-migration window) is dropped and counted (``lost``):
+        freshness-first at-most-once, the same contract as every other
+        drop bound in the system."""
+        s = self._session(session_id)
+        with s.lock:
+            if s.closed or s.orphaned:
+                raise SessionClosedError(
+                    f"session {session_id!r} is closed"
+                    + (" (orphaned by replica loss)" if s.orphaned else ""))
+            idx = s.next_index
+            s.next_index += 1
+            if s.frame_shape is None:
+                # Learn the geometry from the first frame: a later
+                # migration re-declares it, so a survivor pinned to a
+                # different signature refuses at the migration open
+                # (clean orphan) instead of silently eating mismatched
+                # frames forever.
+                s.frame_shape = tuple(frame.shape)
+                s.frame_dtype = frame.dtype
+            r = self._replicas[s.replica_id]
+            try:
+                r.submit(s.replica_sid, frame, ts=ts, tag=(idx, tag))
+            except ReplicaLostError as e:
+                s.lost += 1
+                self._note_loss(r, e)
+            except (SessionClosedError, KeyError):
+                # Replica-side close/forget raced a migration or replica
+                # replacement; the frame is gone but the session lives
+                # on its (re)bound replica.
+                s.lost += 1
+        return idx
+
+    def poll(self, session_id: str,
+             max_items: Optional[int] = None,
+             meta_only: bool = False) -> list:
+        """Pop completed deliveries (fleet index space). Salvaged
+        pre-migration tail first, then the live replica. ``meta_only``
+        drops the frame payloads — the fleet bench's counting mode, so
+        measuring N replicas doesn't serialize N replicas' pixels
+        through the front door."""
+        s = self._session(session_id)
+        out: List[Delivery] = []
+        with s.lock:
+            if s.tail:
+                take = (len(s.tail) if max_items is None
+                        else min(max_items, len(s.tail)))
+                out.extend(s.tail[:take])
+                del s.tail[:take]
+            want = None if max_items is None else max_items - len(out)
+            if want is None or want > 0:
+                if not s.orphaned:
+                    r = self._replicas[s.replica_id]
+                    try:
+                        got = r.poll(s.replica_sid, want,
+                                     meta_only=meta_only)
+                    except (ReplicaLostError, KeyError) as e:
+                        if isinstance(e, ReplicaLostError):
+                            self._note_loss(r, e)
+                        got = []
+                    out.extend(self._map_deliveries(s, got))
+            for d in out:
+                if d.index <= s.last_index:
+                    self.order_violations += 1
+                else:
+                    s.last_index = d.index
+            s.polled += len(out)
+        return out
+
+    def _map_deliveries(self, s: _FleetSession, got: list) -> list:
+        """Replica deliveries → fleet deliveries: the fleet index rides
+        the slot tag (ZMQ-bridge style); the user's tag comes back out."""
+        mapped = []
+        for d in got:
+            if isinstance(d.tag, tuple) and len(d.tag) == 2:
+                fleet_idx, user_tag = d.tag
+            else:  # untagged (shouldn't happen): fall back to replica idx
+                fleet_idx, user_tag = d.index, d.tag
+            mapped.append(d._replace(index=fleet_idx, tag=user_tag))
+        return mapped
+
+    def close(self, session_id: str, drain: bool = True) -> None:
+        s = self._session(session_id)
+        with s.lock:
+            s.closed = True
+            self._uncount_load(s)
+            if not s.orphaned:
+                r = self._replicas[s.replica_id]
+                try:
+                    r.close(s.replica_sid, drain=drain)
+                except (ReplicaLostError, KeyError) as e:
+                    if isinstance(e, ReplicaLostError):
+                        self._note_loss(r, e)
+        self._retire(session_id, s)
+
+    def _retire(self, session_id: str, s: _FleetSession) -> None:
+        """Move a closed session to the bounded retired map (still
+        poll-able for its tail until evicted or released)."""
+        with self._lock:
+            if self._sessions.pop(session_id, None) is not None:
+                self._retired[session_id] = s
+                while len(self._retired) > self.config.max_retired:
+                    self._retired.pop(next(iter(self._retired)))
+
+    def release(self, session_id: str) -> None:
+        """Forget a session: drop its binding and its replica-side
+        retained tail."""
+        with self._lock:
+            s = self._sessions.pop(session_id, None)
+            if s is None:
+                s = self._retired.pop(session_id, None)
+        if s is None:
+            return
+        with s.lock:
+            if not s.closed:
+                raise ServeError(
+                    f"session {session_id!r} is still open; close() first")
+            s.tail.clear()
+            if not s.orphaned:
+                r = self._replicas[s.replica_id]
+                try:
+                    r.release(s.replica_sid)
+                except (ReplicaLostError, KeyError, ServeError):
+                    pass
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._sessions.values() if not s.closed)
+
+    def _session(self, session_id: str) -> _FleetSession:
+        with self._lock:
+            s = (self._sessions.get(session_id)
+                 or self._retired.get(session_id))
+        if s is None:
+            raise KeyError(f"unknown session {session_id!r}")
+        return s
+
+    def _uncount_load(self, s: _FleetSession) -> None:
+        """Placement-load decrement, exactly once per session."""
+        if s.load_counted:
+            s.load_counted = False
+            with self._lock:
+                if self._load.get(s.replica_id, 0) > 0:
+                    self._load[s.replica_id] -= 1
+
+    # -- replica health + replacement -----------------------------------
+
+    def _note_loss(self, r: ReplicaHandle, exc: BaseException) -> None:
+        """Any thread observed a replica failure: wake the monitor,
+        which owns the drain/migrate/restart procedure (and records the
+        loss exactly once — a thousand failed submits against one dead
+        replica is ONE replica fault, not a thousand)."""
+        del exc
+        self._wake.set()
+
+    def _monitor_loop(self) -> None:
+        chaos = self.config.chaos
+        while not self._stop.is_set():
+            self._wake.wait(self.config.health_poll_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            for r in list(self._replicas.values()):
+                if self._stop.is_set():
+                    return
+                if r.state in (RESTARTING, DEAD):
+                    continue
+                if chaos is not None:
+                    try:
+                        chaos.fire("replica")
+                    except Exception as e:  # noqa: BLE001 — ChaosFault
+                        # Injected replica loss: make it REAL (a process
+                        # replica dies for good) so recovery is exercised
+                        # against an actually-unreachable peer.
+                        r.kill()
+                        self._handle_loss(r, e)
+                        continue
+                if not r.alive():
+                    self._handle_loss(r, ReplicaLostError(
+                        f"replica {r.id}: process/frontend died"))
+                    continue
+                try:
+                    h = r.health()
+                except ReplicaLostError as e:
+                    self._handle_loss(r, e)
+                    continue
+                except Exception:  # noqa: BLE001 — transient RPC noise:
+                    continue       # liveness will catch a real death
+                if not h.get("ok", False):
+                    self._handle_loss(
+                        r, ServeError(f"replica {r.id} unhealthy: "
+                                      f"{h.get('error')}"),
+                        reachable=True)
+
+    def _handle_loss(self, r: ReplicaHandle, exc: BaseException,
+                     reachable: bool = False) -> None:
+        """The supervised replacement procedure (monitor thread; also
+        safe from stop paths): drain (no new sessions — state flips out
+        of HEALTHY, so admission skips it), migrate or close its
+        sessions, then restart and rejoin within the restart budget."""
+        with self._loss_lock:
+            if r.state not in (HEALTHY, DRAINING):
+                return  # already handled (or permanently dead)
+            r.state = DRAINING
+            self.replica_losses += 1
+            self.faults.record(FaultKind.REPLICA, exc, replica=r.id)
+            bound = [s for s in self._snapshot_sessions()
+                     if s.replica_id == r.id and not s.orphaned]
+            for s in bound:
+                self._migrate(s, r, reachable=reachable)
+            if reachable:
+                # Live-but-broken (tripped budget / unrecoverable
+                # engine): tear the old frontend down before respawning.
+                try:
+                    r.stop(timeout=2.0)
+                except Exception:  # noqa: BLE001 — already broken
+                    pass
+            if r.restarts < self.config.max_restarts:
+                r.state = RESTARTING
+                last: Optional[BaseException] = None
+                for _ in range(2):  # one retry: a respawn that failed
+                    # transiently (loaded host, slow accept) gets a
+                    # second chance before the replica is written off
+                    try:
+                        r.restart()  # start() flips state to HEALTHY
+                        with self._lock:
+                            self._load[r.id] = 0
+                        last = None
+                        break
+                    except Exception as e:  # noqa: BLE001 — judged below
+                        last = e
+                        time.sleep(0.5)
+                if last is not None:
+                    r.state = DEAD
+                    self.faults.record(FaultKind.REPLICA, last,
+                                       replica=r.id)
+                    print(f"[fleet] replica {r.id} restart failed "
+                          f"(now dead): {last!r}",
+                          file=sys.stderr, flush=True)
+            else:
+                r.state = DEAD
+
+    def _snapshot_sessions(self) -> List[_FleetSession]:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def _migrate(self, s: _FleetSession, old: ReplicaHandle,
+                 reachable: bool) -> None:
+        """Move one session off a lost/draining replica. Monotonicity
+        argument: the binding swaps under ``s.lock``, the same lock every
+        submit/poll holds for its whole replica round-trip — so the tail
+        salvage below sees everything the old replica will ever deliver
+        for this session, and every frame submitted after the swap
+        carries a fleet index larger than anything salvaged."""
+        with s.lock:
+            if s.closed or s.orphaned or s.replica_id != old.id:
+                return
+            # Salvage what the old replica already completed: its router
+            # delivered into the session out-queue; in-flight frames
+            # beyond that are written off (at-most-once). Best-effort
+            # and attempted even when liveness said dead — an in-process
+            # replica whose ENGINE failed still serves its out-queues
+            # (a dead process replica just raises immediately here).
+            try:
+                old.close(s.replica_sid, drain=False)
+            except Exception:  # noqa: BLE001 — salvage best-effort
+                pass
+            try:
+                s.tail.extend(self._map_deliveries(
+                    s, old.poll(s.replica_sid, None)))
+            except Exception:  # noqa: BLE001
+                pass
+            orphan = not self.config.migrate
+            if not orphan:
+                with self._lock:
+                    load = dict(self._load)
+                for target in self.admission.candidates(
+                        list(self._replicas.values()), load,
+                        exclude={old.id}):
+                    new_sid = f"{s.sid}@g{s.generation + 1}"
+                    try:
+                        target.open_stream(new_sid, slo_ms=s.slo_ms,
+                                           frame_shape=s.frame_shape,
+                                           frame_dtype=s.frame_dtype)
+                    except (AdmissionError, ReplicaLostError):
+                        continue
+                    self._uncount_load(s)
+                    s.generation += 1
+                    s.replica_id = target.id
+                    s.replica_sid = new_sid
+                    s.migrations += 1
+                    s.load_counted = True
+                    with self._lock:
+                        self._load[target.id] = (
+                            self._load.get(target.id, 0) + 1)
+                    self.migrated_sessions += 1
+                    return
+                # Nobody could take it: it closes under the client.
+                orphan = True
+            s.orphaned = True
+            s.closed = True
+            self.orphaned_sessions += 1
+            self._uncount_load(s)
+        if orphan:
+            self._retire(s.sid, s)
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """The fleet view: per-replica rows + merged latency/faults."""
+        exports: Dict[str, Optional[dict]] = {}
+        for rid, r in self._replicas.items():
+            try:
+                exports[rid] = r.stats_full() if r.state == HEALTHY else None
+            except ReplicaLostError as e:
+                self._note_loss(r, e)
+                exports[rid] = None
+            except Exception:  # noqa: BLE001 — stats must never throw
+                exports[rid] = None
+        with self._lock:
+            sessions = {**self._retired, **self._sessions}
+            load = dict(self._load)
+        session_rows = {}
+        for sid, s in sessions.items():
+            session_rows[sid] = {
+                "replica": s.replica_id,
+                "submitted": s.next_index,
+                "polled": s.polled,
+                "lost": s.lost,
+                "migrations": s.migrations,
+                "state": ("orphaned" if s.orphaned
+                          else "closed" if s.closed else "open"),
+            }
+        return {
+            "replicas": {
+                rid: replica_row(r, exports.get(rid), load.get(rid, 0))
+                for rid, r in self._replicas.items()
+            },
+            "sessions": session_rows,
+            "open_sessions": sum(1 for s in sessions.values()
+                                 if not s.closed),
+            "replica_losses": self.replica_losses,
+            "migrated_sessions": self.migrated_sessions,
+            "orphaned_sessions": self.orphaned_sessions,
+            "order_violations": self.order_violations,
+            **self.admission.stats(),
+            "faults": merge_fault_summaries(
+                self.faults.summary(),
+                {rid: (e or {}).get("stats", {}).get("faults")
+                 for rid, e in exports.items()}),
+            "recoveries": {
+                rid: (e or {}).get("stats", {}).get("recoveries", 0)
+                for rid, e in exports.items()
+            },
+            "replica_restarts": sum(r.restarts
+                                    for r in self._replicas.values()),
+            "aggregate": merge_latency_snapshots(
+                {rid: (e or {}).get("latency")
+                 for rid, e in exports.items()}),
+            **({"chaos": self.config.chaos.summary()}
+               if self.config.chaos is not None else {}),
+        }

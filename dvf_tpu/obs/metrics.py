@@ -7,6 +7,7 @@ arithmetic and adds percentiles, which the north-star metric requires
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -60,51 +61,119 @@ class LatencyStats:
     def summary(self) -> Dict[str, float]:
         return {"fps": self.fps(), "count": self.count, **self.percentiles()}
 
-    @classmethod
-    def merged(cls, stats: "list[LatencyStats]",
-               qs=(50, 90, 99)) -> Dict[str, float]:
-        """Fleet-level summary across several recorders (the serving
-        frontend's per-session stats → one aggregate p50/p99 export).
+    def snapshot(self) -> Dict[str, object]:
+        """One recorder's mergeable export: samples + decimation stride +
+        time span, as plain JSON/pickle-safe values. This is what crosses
+        a process boundary when a fleet replica ships its latency data to
+        the front door (``LatencyStats.merge_snapshots`` on the other
+        side) — the object form can't ride an RPC.
 
-        Percentiles weight each recorder's samples by its decimation
-        stride, so a long-running stream that has been decimated 2:1
-        still counts each surviving sample for the ~stride deliveries it
-        represents. fps is total deliveries over the union time span —
-        the fleet's delivery rate, not a mean of per-stream rates.
+        The sample list is read ONCE (list() is atomic under the GIL):
+        collect threads append — and decimate, swapping the list and
+        doubling ``_stride`` — concurrently with this read. Pairing one
+        list snapshot with one stride read keeps samples/weights the same
+        length; a stride doubled between the two reads only skews
+        weighting transiently, never crashes. ``pid`` tags the time base:
+        ``t0``/``t1`` are ``perf_counter`` values, comparable only within
+        one process.
         """
+        return {
+            "samples_ms": list(self.samples_ms),
+            "stride": float(self._stride),
+            "t0": self.t0,
+            "t1": self.t1,
+            "count": self.count,
+            "pid": os.getpid(),
+        }
+
+    @classmethod
+    def combined(cls, stats: "list[LatencyStats]") -> Dict[str, object]:
+        """Many recorders → ONE snapshot (per-sample ``weights`` carry
+        each recorder's stride) — the per-replica half of the fleet
+        export: a frontend merges its sessions here, the fleet tier
+        merges replicas' combined snapshots with ``merge_snapshots``."""
         stats = [s for s in stats if s.count]
-        if not stats:
+        samples: List[float] = []
+        weights: List[float] = []
+        for s in stats:
+            part = list(s.samples_ms)
+            samples.extend(part)
+            weights.extend([float(s._stride)] * len(part))
+        live = [s for s in stats if s.t0 is not None]
+        return {
+            "samples_ms": samples,
+            "weights": weights,
+            "t0": min((s.t0 for s in live), default=None),
+            "t1": max((s.t1 for s in live), default=None),
+            "count": sum(s.count for s in stats),
+            "pid": os.getpid(),
+        }
+
+    @classmethod
+    def merge_snapshots(cls, snaps: "list[dict]",
+                        qs=(50, 90, 99)) -> Dict[str, float]:
+        """Weighted summary over :meth:`snapshot`/:meth:`combined`
+        exports — the percentile/fps arithmetic behind :meth:`merged`,
+        split out so it also works on data that crossed a process
+        boundary (fleet replicas).
+
+        Percentiles weight each sample by its recorder's decimation
+        stride, so a long-running stream decimated 2:1 still counts each
+        surviving sample for the ~stride deliveries it represents. fps
+        is total deliveries over the union time span when every snapshot
+        shares one time base (same ``pid`` — perf_counter origins are
+        per-process); across processes it falls back to total deliveries
+        over the LONGEST single span, which is the right wall-clock
+        denominator for replicas that ran concurrently.
+        """
+        snaps = [s for s in snaps if s and s.get("count")]
+        if not snaps:
             return {"fps": 0.0, "count": 0,
                     **{f"p{q}_ms": float("nan") for q in qs}}
-        # Snapshot each recorder's sample list ONCE (list() is atomic
-        # under the GIL): collect threads append — and decimate, swapping
-        # the list and doubling _stride — concurrently with this read.
-        # Pairing a snapshot with a stride read keeps samples/weights the
-        # same length; a stride doubled between the two reads only skews
-        # weighting transiently, never crashes.
-        snaps = []
-        for s in stats:
-            samples = list(s.samples_ms)
-            if samples:
-                snaps.append((np.asarray(samples), float(s._stride)))
-        if not snaps:  # count incremented before the first append lands
-            return {"fps": 0.0, "count": sum(s.count for s in stats),
+        count = sum(int(s["count"]) for s in snaps)
+        parts = []
+        for s in snaps:
+            arr = np.asarray(s["samples_ms"], dtype=float)
+            if not len(arr):
+                continue
+            w = (np.asarray(s["weights"], dtype=float)
+                 if s.get("weights") is not None
+                 else np.full(len(arr), float(s.get("stride", 1.0))))
+            parts.append((arr, w))
+        if not parts:  # count incremented before the first append landed
+            return {"fps": 0.0, "count": count,
                     **{f"p{q}_ms": float("nan") for q in qs}}
-        samples = np.concatenate([a for a, _ in snaps])
-        weights = np.concatenate(
-            [np.full(len(a), stride) for a, stride in snaps])
+        samples = np.concatenate([a for a, _ in parts])
+        weights = np.concatenate([w for _, w in parts])
         order = np.argsort(samples)
         cum = np.cumsum(weights[order])
         out: Dict[str, float] = {}
         for q in qs:
             k = int(np.searchsorted(cum, q / 100.0 * cum[-1]))
             out[f"p{q}_ms"] = float(samples[order][min(k, len(samples) - 1)])
-        t0 = min(s.t0 for s in stats)
-        t1 = max(s.t1 for s in stats)
-        count = sum(s.count for s in stats)
-        out["fps"] = (count - 1) / (t1 - t0) if count > 1 and t1 > t0 else 0.0
+        spans = [s for s in snaps
+                 if s.get("t0") is not None and s.get("t1") is not None]
+        fps = 0.0
+        if spans and count > 1:
+            if len({s.get("pid") for s in spans}) <= 1:
+                dt = (max(s["t1"] for s in spans)
+                      - min(s["t0"] for s in spans))
+            else:
+                dt = max(s["t1"] - s["t0"] for s in spans)
+            if dt > 0:
+                fps = (count - 1) / dt
+        out["fps"] = fps
         out["count"] = count
         return out
+
+    @classmethod
+    def merged(cls, stats: "list[LatencyStats]",
+               qs=(50, 90, 99)) -> Dict[str, float]:
+        """Fleet-level summary across several recorders (the serving
+        frontend's per-session stats → one aggregate p50/p99 export).
+        Same-process sugar over :meth:`merge_snapshots`."""
+        return cls.merge_snapshots(
+            [s.snapshot() for s in stats if s.count], qs=qs)
 
 
 class IngestStats:

@@ -196,6 +196,53 @@ class ElasticMeshRunner:
         return out
 
 
+def local_output_rows(out: jax.Array) -> np.ndarray:
+    """This process's egress shard of a global result: the batch rows
+    its local devices hold, reassembled in global row order.
+
+    The delivery-side mirror of :func:`host_local_batch` — multi-host
+    egress where each host materializes ONLY the rows it can address
+    (device→host over its own PCIe, no cross-host gather; the remote
+    rows belong to the remote hosts' egress). Replicated placements are
+    deduped by shard index so a value comes back exactly once, and
+    non-batch sharding (a ``space`` axis splitting H) is stitched back
+    together per batch interval — a row is returned whole or not at
+    all: if this process holds only part of a row's pieces (a layout
+    that shards H *across* hosts, inverting the data-outermost rule),
+    that is an error, not a silently garbled frame."""
+    seen = {}
+    for s in out.addressable_shards:
+        key = tuple((sl.start, sl.stop) for sl in s.index)
+        if key not in seen:
+            seen[key] = s
+
+    def bounds(sl, dim):
+        return (sl.start or 0, out.shape[dim] if sl.stop is None else sl.stop)
+
+    intervals = sorted({bounds(k_shard.index[0], 0)
+                        for k_shard in seen.values()})
+    parts = []
+    for b0, b1 in intervals:
+        owned = [s for s in seen.values()
+                 if bounds(s.index[0], 0) == (b0, b1)]
+        buf = np.empty((b1 - b0, *out.shape[1:]), out.dtype)
+        filled = 0
+        for s in owned:
+            rest = tuple(slice(*bounds(sl, d + 1))
+                         for d, sl in enumerate(s.index[1:]))
+            data = np.asarray(s.data)
+            buf[(slice(0, b1 - b0), *rest)] = data
+            filled += data.size
+        if filled != buf.size:
+            raise ValueError(
+                f"rows [{b0}:{b1}) are only partially addressable from "
+                f"this process ({filled}/{buf.size} elements) — per-host "
+                f"egress needs every non-batch shard of a local row to "
+                f"be local (keep the data axis outermost across hosts)")
+        parts.append(buf)
+    return np.concatenate(parts, axis=0)
+
+
 def host_local_batch(mesh: Mesh, local_batch: np.ndarray) -> jax.Array:
     """Assemble the GLOBAL sharded frame batch from this host's frames.
 

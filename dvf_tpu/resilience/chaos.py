@@ -26,6 +26,13 @@ site        hook location                           default effect/kind
                                                     so the stall watchdog
                                                     has something real to
                                                     catch
+``replica`` fleet health monitor                    declare the replica
+            (``fleet.router.FleetFrontend``, per    being checked LOST
+            replica per poll tick)                  (process replicas are
+                                                    actually killed) →
+                                                    ``replica`` fault,
+                                                    drain + migrate +
+                                                    restart
 =========== ======================================= =====================
 
 Triggers are event-indexed (``at`` — explicit 0-based event numbers at
@@ -35,7 +42,10 @@ sites (one event per blob/message/put/submit). Caveat: the ``freeze``
 site counts collect-loop *iterations*, including empty queue polls, so
 its event indices are machine-timing dependent — use small ``at``
 indices (the loop starts polling immediately) or ``delay``-only rules
-when reproducibility matters. A probabilistic
+when reproducibility matters; the ``replica`` site counts health-poll
+events the same way — one event per replica per monitor tick, replicas
+checked in id order, so a small ``at`` index selects a victim replica
+deterministically (``at=0`` = the first replica, first tick). A probabilistic
 ``p`` trigger exists for soak-style runs (seeded, but only deterministic
 when a single thread drives the site). The ``--chaos`` CLI flag parses
 the same spec everywhere (serve, worker), so a failure found in a test
@@ -69,6 +79,7 @@ SITE_KINDS = {
     "compute": FaultKind.COMPUTE,
     "oom": FaultKind.OOM,
     "freeze": FaultKind.STALL,
+    "replica": FaultKind.REPLICA,
 }
 
 

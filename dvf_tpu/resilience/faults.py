@@ -43,13 +43,16 @@ class FaultKind:
     COMPUTE = "compute"      # the jitted step / result materialization
     OOM = "oom"              # device memory exhaustion
     STALL = "stall"          # watchdog: in-flight work older than the timeout
+    REPLICA = "replica"      # a fleet engine replica was lost (process died,
+    #                          RPC channel broke, health check failed) —
+    #                          the fleet tier's drain/migrate/restart domain
     INTERNAL = "internal"    # everything else (bookkeeping bugs, sinks)
 
 
 ALL_KINDS = (
     FaultKind.DECODE, FaultKind.GEOMETRY, FaultKind.TRANSPORT,
     FaultKind.H2D, FaultKind.D2H, FaultKind.COMPUTE, FaultKind.OOM,
-    FaultKind.STALL, FaultKind.INTERNAL,
+    FaultKind.STALL, FaultKind.REPLICA, FaultKind.INTERNAL,
 )
 
 # Default classification for exceptions that carry no kind of their own,
@@ -116,20 +119,36 @@ class FaultStats:
     One instance per pipeline/frontend/worker; ``summary()`` is embedded
     in their ``stats()`` exports and the bench JSON so a BENCH round can
     assert exact per-kind counts (zero, for a clean run).
+
+    ``replica``: the fleet tier runs one frontend (and so one FaultStats)
+    per engine replica; labeling the recorder attributes every fault —
+    and every fault record — to the replica that absorbed it, so the
+    merged fleet export (and a fleet bench round's ``faults`` JSON) can
+    say *which* replica ate what instead of anonymous per-kind counters.
+    Single-engine paths leave it None and the summary shape is unchanged.
     """
 
-    def __init__(self):
+    def __init__(self, replica: Optional[str] = None):
         self._lock = threading.Lock()
+        self.replica = replica
         self.counts: Dict[str, int] = {}
         self.last: Dict[str, dict] = {}
+        self.by_replica: Dict[str, Dict[str, int]] = {}
 
-    def record(self, kind: str, exc: Optional[BaseException] = None) -> None:
+    def record(self, kind: str, exc: Optional[BaseException] = None,
+               replica: Optional[str] = None) -> None:
+        rep = replica if replica is not None else self.replica
         with self._lock:
             self.counts[kind] = self.counts.get(kind, 0) + 1
-            self.last[kind] = {
+            rec = {
                 "error": repr(exc) if exc is not None else None,
                 "ts": time.time(),
             }
+            if rep is not None:
+                rec["replica"] = rep
+                per = self.by_replica.setdefault(rep, {})
+                per[kind] = per.get(kind, 0) + 1
+            self.last[kind] = rec
 
     def count(self, kind: str) -> int:
         with self._lock:
@@ -141,8 +160,37 @@ class FaultStats:
 
     def summary(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "total": sum(self.counts.values()),
                 "by_kind": dict(self.counts),
                 "last": {k: dict(v) for k, v in self.last.items()},
             }
+            if self.by_replica:
+                out["by_replica"] = {r: dict(kinds)
+                                     for r, kinds in self.by_replica.items()}
+            return out
+
+    def absorb_summary(self, summary: dict,
+                       replica: Optional[str] = None) -> None:
+        """Fold another recorder's exported ``summary()`` into this one —
+        the fleet front door merging per-replica exports that arrived
+        over an RPC (the recorder object itself never crosses the
+        process boundary). ``replica`` attributes the absorbed counts
+        when the source summary carries no ``by_replica`` of its own."""
+        by_kind = summary.get("by_kind", {}) or {}
+        by_replica = summary.get("by_replica") or (
+            {replica: by_kind} if replica is not None and by_kind else {})
+        with self._lock:
+            for kind, n in by_kind.items():
+                self.counts[kind] = self.counts.get(kind, 0) + int(n)
+            for rep, kinds in by_replica.items():
+                per = self.by_replica.setdefault(rep, {})
+                for kind, n in kinds.items():
+                    per[kind] = per.get(kind, 0) + int(n)
+            for kind, rec in (summary.get("last", {}) or {}).items():
+                rec = dict(rec)
+                if replica is not None and "replica" not in rec:
+                    rec["replica"] = replica
+                mine = self.last.get(kind)
+                if mine is None or (rec.get("ts") or 0) >= (mine.get("ts") or 0):
+                    self.last[kind] = rec

@@ -269,6 +269,14 @@ class Engine:
         self.compile(tuple(batch_shape), dtype)
 
     @property
+    def signature(self) -> Optional[Tuple]:
+        """The compiled ``((B, H, W, C), dtype)`` signature, or None
+        before the first compile — what the serving frontend's
+        admission-time geometry check compares a declared stream shape
+        against (serve.ServeFrontend.open_stream)."""
+        return self._signature
+
+    @property
     def input_sharding(self):
         """The batch sharding the compiled step actually expects (set by
         compile(); may differ from the naive batch_sharding when the

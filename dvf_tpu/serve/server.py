@@ -107,6 +107,10 @@ class ServeConfig:
     #   into a preallocated host slab at collect (runtime/egress.py;
     #   auto-degrades where streaming cannot win); "monolithic" is the
     #   classic whole-batch np.asarray escape hatch
+    replica_label: Optional[str] = None  # fleet tier: this frontend is
+    #   replica N of a fleet — every fault record it emits carries the
+    #   label, so the merged fleet export can attribute per-replica
+    #   (resilience.faults.FaultStats). None outside a fleet.
 
 
 class ServeFrontend:
@@ -145,7 +149,9 @@ class ServeFrontend:
         self._ids = itertools.count()
         self.admission_rejections = 0
         self.errors = 0
-        self.faults = FaultStats()   # per-kind counters + last errors
+        self.faults = FaultStats(replica=self.config.replica_label)
+        #   per-kind counters + last errors (replica-attributed in a fleet)
+        self._draining = False       # fleet drain hook: open_stream refuses
         self.recoveries = 0          # supervised engine rebuilds
         self._budget = ErrorBudget(limit=self.config.fault_budget,
                                    window_s=self.config.fault_window_s)
@@ -234,6 +240,67 @@ class ServeFrontend:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- replica-embeddable lifecycle (fleet drain hooks) ---------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting new sessions; existing ones keep flowing.
+        The first half of a fleet replica drain — reversible only by
+        building a fresh frontend (a draining replica restarts, it does
+        not un-drain)."""
+        with self._lock:
+            self._draining = True
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful replica drain: refuse new sessions, close every open
+        session with ``drain=True`` (queued + in-flight frames still
+        deliver), and wait until all of them have retired. Returns True
+        when fully drained within ``timeout`` — False means frames may
+        still be in flight (a broken engine can't serve its tail; the
+        fleet tier writes those off as ``replica`` losses)."""
+        self.begin_drain()
+        with self._lock:
+            sids = list(self._sessions)
+        for sid in sids:
+            try:
+                self.close(sid, drain=True)
+            except KeyError:
+                pass  # retired between the snapshot and the close
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.open_count() == 0:
+                return True
+            if self._error is not None or self._stop.is_set():
+                break
+            time.sleep(0.005)
+        return self.open_count() == 0
+
+    def health(self) -> dict:
+        """Cheap liveness/health export for a fleet monitor: no
+        percentile work, no per-session scan — safe to poll at hertz
+        rates over an RPC. ``ok`` is False once the frontend has failed
+        hard (error budget exhausted / fail-fast fault): the fleet
+        drains and replaces such a replica."""
+        err = self._error
+        return {
+            "ok": err is None,
+            "error": repr(err) if err is not None else None,
+            "draining": self._draining,
+            "open_sessions": self.open_count(),
+            "recoveries": self.recoveries,
+            "fault_total": self.faults.total(),
+            "stalls": (self._supervisor.stalls
+                       if self._supervisor is not None else 0),
+        }
+
+    def latency_snapshot(self) -> dict:
+        """All sessions' latency samples as ONE mergeable snapshot
+        (``LatencyStats.combined``) — the per-replica half of the fleet
+        p50/p99 export; the front door merges replicas' snapshots with
+        ``LatencyStats.merge_snapshots``."""
+        with self._lock:
+            every = {**self._retired, **self._sessions}
+        return LatencyStats.combined([s.latency for s in every.values()])
+
     # -- client API ------------------------------------------------------
 
     def open_stream(
@@ -241,11 +308,24 @@ class ServeFrontend:
         session_id: Optional[str] = None,
         slo_ms: Optional[float] = None,
         sink: Any = None,
+        frame_shape: Optional[tuple] = None,
+        frame_dtype: Any = None,
     ) -> str:
         """Admit one new stream; returns its session id.
 
         Raises ``AdmissionError`` at the ``max_sessions`` cap — overload
-        is refused at the door, not absorbed as unbounded queueing."""
+        is refused at the door, not absorbed as unbounded queueing — and
+        when the frontend is draining (fleet replica teardown).
+
+        ``frame_shape``/``frame_dtype`` declare the stream's geometry at
+        admission time: a declaration that mismatches the engine's
+        compiled signature (or the geometry this frontend already pinned)
+        is refused HERE, as an ``AdmissionError``, instead of surfacing
+        frames later as a ``geometry`` fault in the batcher. The first
+        declaration on an unpinned frontend pins it — the seam the
+        (op, geometry) bucketing work extends: a bucketed frontend will
+        route the declaration to a compatible engine instead of refusing.
+        """
         cfg = SessionConfig(
             queue_size=self.config.queue_size,
             slo_ms=slo_ms if slo_ms is not None else self.config.slo_ms,
@@ -253,17 +333,53 @@ class ServeFrontend:
             reorder_capacity=self.config.reorder_capacity,
             out_queue_size=self.config.out_queue_size,
         )
+        declared = None
+        if frame_shape is not None:
+            declared = (tuple(int(d) for d in frame_shape),
+                        np.dtype(frame_dtype if frame_dtype is not None
+                                 else np.uint8))
+        elif frame_dtype is not None:
+            raise ValueError("frame_dtype given without frame_shape")
         with self._lock:
+            if self._draining:
+                self.admission_rejections += 1
+                raise AdmissionError(
+                    "frontend is draining (no new sessions admitted)")
             if len(self._sessions) >= self.config.max_sessions:
                 self.admission_rejections += 1
                 raise AdmissionError(
                     f"session limit reached ({self.config.max_sessions} "
                     f"open); close a stream or raise max_sessions")
+            if declared is not None:
+                pinned = self._pinned_signature_locked()
+                if pinned is not None and declared != pinned:
+                    self.admission_rejections += 1
+                    raise AdmissionError(
+                        f"declared frame signature {declared[0]}/"
+                        f"{declared[1]} does not match this frontend's "
+                        f"compiled signature {pinned[0]}/{pinned[1]} "
+                        f"(one program serves all sessions — geometry is "
+                        f"per-frontend, not per-stream)")
+                if pinned is None:
+                    self._frame_shape, self._frame_dtype = declared
             sid = session_id if session_id is not None else f"s{next(self._ids)}"
             if sid in self._sessions or sid in self._retired:
                 raise ServeError(f"session id {sid!r} already exists")
             self._sessions[sid] = StreamSession(sid, cfg, sink=sink)
         return sid
+
+    def _pinned_signature_locked(self) -> Optional[tuple]:
+        """The per-frame (shape, dtype) this frontend is committed to:
+        the engine's compiled signature when one exists (a caller-built
+        engine may arrive pre-compiled), else the shape pinned by the
+        first submit/declaration. None = still free."""
+        sig = self.engine.signature
+        if sig is not None:
+            (batch_shape, dtype) = sig
+            return (tuple(batch_shape[1:]), np.dtype(dtype))
+        if self._frame_shape is not None:
+            return (tuple(self._frame_shape), np.dtype(self._frame_dtype))
+        return None
 
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
@@ -733,6 +849,7 @@ class ServeFrontend:
             "sessions": session_stats,
             "open_sessions": len(live),
             "retired_sessions": len(retired),
+            "draining": self._draining,
             "admission_rejections": self.admission_rejections,
             # Sum of the per-session counters (covers deadline sheds AND
             # hard-close discards) so the aggregate always reconciles

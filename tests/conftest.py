@@ -71,6 +71,41 @@ def pytest_configure(config):
         "markers", "chaos: deterministic fault-injection tests (seeded "
                    "FaultPlans, CPU backend, bounded wall time — run in "
                    "tier-1; select with -m chaos)")
+    config.addinivalue_line(
+        "markers", "fleet: multi-replica serving tier tests (CPU backend, "
+                   "bounded timeouts; some spawn replica worker "
+                   "subprocesses — run in tier-1, select with -m fleet; "
+                   "capacity-gated scaling assertions skip cleanly where "
+                   "the host can't express real parallelism)")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _fleet_resources_released():
+    """Fleet tests must not leak replica worker subprocesses or fleet
+    service threads past the suite: a leaked worker pins a whole jax
+    runtime (and its sockets) beyond session end. Checked at session
+    scope with a grace window, like the codec-pool guard below; only
+    consults the fleet registry when fleet code was actually imported."""
+    yield
+    import sys as _sys
+
+    mod = _sys.modules.get("dvf_tpu.fleet.replica")
+    deadline = time.time() + 10.0
+    if mod is not None:
+        leaked = mod.live_worker_processes()
+        while leaked and time.time() < deadline:
+            time.sleep(0.1)
+            leaked = mod.live_worker_processes()
+        assert not leaked, (
+            f"fleet worker processes leaked (FleetFrontend.stop not "
+            f"called?): pids {[p.pid for p in leaked]}")
+    fleet_threads = {t for t in threading.enumerate()
+                    if t.name.startswith("dvf-fleet") and t.is_alive()}
+    while fleet_threads and time.time() < deadline:
+        time.sleep(0.05)
+        fleet_threads = {t for t in fleet_threads if t.is_alive()}
+    assert not fleet_threads, (
+        f"fleet threads leaked: {sorted(t.name for t in fleet_threads)}")
 
 
 @pytest.fixture

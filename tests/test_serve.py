@@ -468,3 +468,109 @@ def test_cli_serve_multi_demo(capsys):
     assert out["aggregate"]["count"] == 48
     assert out["admission_rejections"] == 0
     assert out["errors"] == 0
+
+
+class TestAdmissionSignatureCheck:
+    """Satellite: a geometry/dtype declared at open_stream that can't
+    run on this frontend's compiled program is refused AT ADMISSION
+    (AdmissionError) instead of surfacing later as a geometry fault in
+    the batcher — the seam signature bucketing will extend."""
+
+    def test_mismatch_vs_pinned_signature_refused_at_open(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        with fe:
+            a = fe.open_stream(frame_shape=(H, W, 3))
+            fe.submit(a, tagged_frame(0, 0))
+            before = fe.stats()["admission_rejections"]
+            with pytest.raises(AdmissionError, match="signature"):
+                fe.open_stream(frame_shape=(H + 8, W, 3))
+            with pytest.raises(AdmissionError, match="signature"):
+                fe.open_stream(frame_shape=(H, W, 3),
+                               frame_dtype=np.float32)
+            assert fe.stats()["admission_rejections"] == before + 2
+
+    def test_mismatch_vs_precompiled_engine_refused_at_open(self):
+        """A caller-built engine arrives already compiled: the declared
+        shape is checked against ITS signature, not just first-submit
+        pinning."""
+        from dvf_tpu.runtime.engine import Engine
+
+        filt = get_filter("invert")
+        engine = Engine(filt)
+        engine.compile((2, H, W, 3), np.uint8)
+        fe = ServeFrontend(filt, ServeConfig(batch_size=2), engine=engine)
+        with fe:
+            with pytest.raises(AdmissionError, match="signature"):
+                fe.open_stream(frame_shape=(H * 2, W, 3))
+            sid = fe.open_stream(frame_shape=(H, W, 3))  # match: admitted
+            assert sid
+
+    def test_declaration_pins_unpinned_frontend(self):
+        """First declaration pins the frontend: a later submit at a
+        different geometry gets the pinned-signature ValueError."""
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2))
+        with fe:
+            sid = fe.open_stream(frame_shape=(H, W, 3))
+            with pytest.raises(ValueError, match="pinned signature"):
+                fe.submit(sid, np.zeros((H + 2, W, 3), np.uint8))
+
+
+class TestReplicaLifecycleHooks:
+    """Satellite: the fleet-facing drain/health hooks on the frontend."""
+
+    def test_begin_drain_refuses_new_sessions(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        with fe:
+            a = fe.open_stream()
+            fe.begin_drain()
+            with pytest.raises(AdmissionError, match="draining"):
+                fe.open_stream()
+            # Existing sessions keep flowing while draining.
+            fe.submit(a, tagged_frame(0, 0))
+            deadline = time.time() + 20
+            got = []
+            while not got and time.time() < deadline:
+                got = fe.poll(a)
+                time.sleep(0.005)
+            assert [d.index for d in got] == [0]
+            assert fe.stats()["draining"] is True
+
+    def test_drain_serves_tails_and_retires_everything(self):
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        with fe:
+            sids = [fe.open_stream() for _ in range(3)]
+            for j in range(4):
+                for sid in sids:
+                    fe.submit(sid, tagged_frame(0, j))
+            assert fe.drain(timeout=30.0) is True
+            assert fe.open_count() == 0
+            # drained ≠ dropped: every queued frame was served and is
+            # still poll-able off the retired sessions.
+            for sid in sids:
+                assert [d.index for d in fe.poll(sid)] == list(range(4))
+            health = fe.health()
+            assert health["ok"] and health["draining"]
+
+    def test_latency_snapshot_matches_merged_aggregate(self):
+        from dvf_tpu.obs.metrics import LatencyStats
+
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, slo_ms=60_000.0))
+        with fe:
+            sid = fe.open_stream()
+            for j in range(6):
+                fe.submit(sid, tagged_frame(0, j))
+            deadline = time.time() + 20
+            n = 0
+            while n < 6 and time.time() < deadline:
+                n += len(fe.poll(sid))
+                time.sleep(0.005)
+            snap = fe.latency_snapshot()
+            agg = fe.stats()["aggregate"]
+        merged = LatencyStats.merge_snapshots([snap])
+        assert merged["count"] == agg["count"] == 6
+        assert merged["p50_ms"] == pytest.approx(agg["p50_ms"])
