@@ -26,6 +26,7 @@ import numpy as np  # noqa: E402
 
 GEOMETRIES = [("512sq", 512, 512), ("720p", 720, 1280), ("1080p", 1080, 1920)]
 THREADS = (1, 4, 8)
+DIRTY_RATIOS = (0.0, 0.1, 0.5, 1.0)
 
 
 def _frame(h: int, w: int) -> np.ndarray:
@@ -51,7 +52,83 @@ def bench_codec(codec, frames, reps: int) -> dict:
         "encode_fps": round(n / enc_s, 1),
         "decode_fps": round(n / dec_s, 1),
         "jpeg_kb": round(len(blobs[0]) / 1024, 1),
+        "host_cpus": os.cpu_count(),
     }
+
+
+def _dirty_stream(h: int, w: int, tile: int, dirty_ratio: float,
+                  n: int) -> list:
+    """``n`` frames where each frame re-randomizes ``dirty_ratio`` of
+    the tile grid IN PLACE on the previous frame (a cumulative walk, so
+    per-frame change is exactly the requested ratio — reverting to a
+    fixed base would dirty both the new picks and the old ones) — the
+    delta wire's cost driver, swept independently of content entropy
+    (noise tiles: worst-case bytes for whatever IS dirty)."""
+    rng = np.random.default_rng(7)
+    f = rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+    nty, ntx = h // tile, w // tile
+    k = int(round(dirty_ratio * nty * ntx))
+    frames = [f]
+    for _ in range(n - 1):
+        f = f.copy()
+        if k:
+            picks = rng.choice(nty * ntx, size=k, replace=False)
+            for p in picks:
+                i, j = divmod(int(p), ntx)
+                f[i * tile:(i + 1) * tile, j * tile:(j + 1) * tile] = \
+                    rng.integers(0, 255, (tile, tile, 3), np.uint8)
+        frames.append(f)
+    return frames
+
+
+def bench_delta(h: int, w: int, dirty_ratio: float, reps: int,
+                tile: int = 32, keyframe_interval: int = 48) -> dict:
+    """Delta-wire cycle at one dirty ratio: sequential encode + decode of
+    a stream whose per-frame change is exactly ``dirty_ratio`` of the
+    tile grid (scene-cut disabled via ratio > 1 so a 100% row measures
+    the tiled path, not a keyframe fallback)."""
+    from dvf_tpu.transport.codec import DeltaCodec, make_codec
+
+    frames = _dirty_stream(h, w, tile, dirty_ratio, n=16)
+    enc = DeltaCodec(make_codec(quality=90, threads=1), tile=tile,
+                     keyframe_interval=keyframe_interval,
+                     scene_cut_ratio=1.01)
+    dec = DeltaCodec(make_codec(quality=90, threads=1), tile=tile,
+                     keyframe_interval=keyframe_interval,
+                     on_gap="composite")
+    try:
+        blobs = [enc.encode(f) for f in frames]      # warm
+        out = np.empty((h, w, 3), np.uint8)
+        for b in blobs:
+            dec.decode_into(b, out)
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(max(1, reps // 16)):
+            for f in frames:
+                enc.encode(f)
+                n += 1
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        m = 0
+        for _ in range(max(1, reps // 16)):
+            for b in blobs:
+                dec.decode_into(b, out)
+                m += 1
+        dec_s = time.perf_counter() - t0
+        stats = enc.stats()
+        return {
+            "encode_fps": round(n / enc_s, 1),
+            "decode_fps": round(m / dec_s, 1),
+            "wire_kb": round(stats["payload_bytes"]
+                             / max(1, stats["frames"]) / 1024, 1),
+            "dirty_ratio": dirty_ratio,
+            "measured_dirty_ratio": stats["dirty_ratio"],
+            "keyframe_interval": keyframe_interval,
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        enc.close()
+        dec.close()
 
 
 def main(argv=None) -> int:
@@ -84,6 +161,16 @@ def main(argv=None) -> int:
                 results[f"{gname}/{iname}/t{threads}"] = r
                 print(f"[codec-bench] {gname} {iname} t{threads}: {r}",
                       file=sys.stderr, flush=True)
+        # Temporal-delta wire rows: the same geometry swept over the
+        # dirty ratio the delta codec's cost actually scales with
+        # (0/10/50/100% of tiles re-randomized per frame; worst-case
+        # noise content in whatever IS dirty).
+        for dirty in DIRTY_RATIOS:
+            reps = max(4, args.reps * 512 * 512 // (h * w))
+            r = bench_delta(h, w, dirty, reps)
+            results[f"{gname}/delta/d{int(dirty * 100)}"] = r
+            print(f"[codec-bench] {gname} delta d{int(dirty * 100)}: {r}",
+                  file=sys.stderr, flush=True)
 
     doc = {
         "generated_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
@@ -107,13 +194,27 @@ def main(argv=None) -> int:
         "knob needs real cores to bite (both shims release the GIL "
         "inside libjpeg).",
         "",
-        "| geometry | impl | threads | encode fps | decode fps | jpeg KB |",
+        "Delta rows (impl `delta`): temporal-delta wire "
+        "(transport.codec.DeltaCodec over the native/cv2 JPEG codec, "
+        "tile 32, keyframe every 48) at a swept dirty ratio — the d0 row "
+        "is the static-stream floor (change detection + keyframe "
+        "amortization only), d100 the every-tile-dirty ceiling. The "
+        "`thr./dirty` column is the thread count for full-frame rows and "
+        "the dirty-ratio percentage for delta rows; wire KB is the mean "
+        "per-frame payload (keyframes amortized in). NB: delta rows run "
+        "NOISE content (worst case for whatever is dirty) while the "
+        "full-frame rows keep the legacy smooth-gradient frame, so "
+        "compare delta rows against a noise full-frame baseline "
+        "(DELTA_BENCH.json's `full_jpeg` row), not across this table.",
+        "",
+        "| geometry | impl | thr./dirty | encode fps | decode fps | wire KB |",
         "|---|---|---|---|---|---|",
     ]
     for key, r in results.items():
         g, i, t = key.split("/")
+        kb = r.get("jpeg_kb", r.get("wire_kb"))
         lines.append(f"| {g} | {i} | {t[1:]} | {r['encode_fps']} | "
-                     f"{r['decode_fps']} | {r['jpeg_kb']} |")
+                     f"{r['decode_fps']} | {kb} |")
     mpath = os.path.join(args.out_dir, "CODEC_BENCH.md")
     with open(mpath, "w") as f:
         f.write("\n".join(lines) + "\n")

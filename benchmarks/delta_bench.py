@@ -1,0 +1,228 @@
+"""Temporal-delta wire + codec assist benchmark → DELTA_BENCH.json.
+
+Quantifies the two halves of the PR-7 attack on the host codec roofline
+(ROADMAP open item 3) on THIS host, CPU backend:
+
+1. **Delta wire** (``transport.codec.DeltaCodec``): codec-level cycle
+   fps across a dirty-ratio sweep at the head-to-head geometry, plus the
+   full pipeline e2e A/B — same engine, same ring transport, same
+   low-motion stream, full-frame JPEG wire vs delta wire — which is the
+   number the REFERENCE_HEADTOHEAD low-motion row is built from.
+2. **Codec assist** (``runtime.codec_assist`` + the native shim's
+   ``jpeg_write_raw_data`` entry): host encode cost when the device has
+   already done RGB→YCbCr + 4:2:0 (entropy path only, half the input
+   bytes) vs the full host encode.
+
+Usage: python benchmarks/delta_bench.py [--seconds 8] [--out-dir benchmarks]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+DIRTY_RATIOS = (0.0, 0.05, 0.1, 0.5, 1.0)
+
+
+def bench_cycle_sweep(height: int, width: int) -> dict:
+    """Codec-level cycle fps (sequential encode+decode, one core) per
+    dirty ratio, against the full-frame JPEG cycle at the same geometry
+    and content class (noise — worst case for whatever is dirty)."""
+    from benchmarks.codec_bench import _dirty_stream, bench_delta
+    from dvf_tpu.transport.codec import make_codec
+
+    rows = {}
+    for dirty in DIRTY_RATIOS:
+        rows[f"d{int(dirty * 100)}"] = bench_delta(
+            height, width, dirty, reps=64)
+    codec = make_codec(quality=90, threads=1)
+    try:
+        frames = _dirty_stream(height, width, 32, 1.0, n=8)
+        blobs = [codec.encode(f) for f in frames]
+        out = np.empty((height, width, 3), np.uint8)
+        if hasattr(codec, "decode_into"):
+            codec.decode_into(blobs[0], out)
+        t0 = time.perf_counter()
+        for _ in range(8):
+            for f in frames:
+                codec.encode(f)
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(8):
+            for b in blobs:
+                if hasattr(codec, "decode_into"):
+                    codec.decode_into(b, out)
+                else:
+                    codec.decode(b)
+        dec_s = time.perf_counter() - t0
+        rows["full_jpeg"] = {
+            "encode_fps": round(64 / enc_s, 1),
+            "decode_fps": round(64 / dec_s, 1),
+            "jpeg_kb": round(len(blobs[0]) / 1024, 1),
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        codec.close()
+    return rows
+
+
+def bench_e2e_ab(height: int, width: int, seconds: float) -> dict:
+    """Full pipeline (ring transport) A/B on the SAME low-motion stream:
+    full-frame JPEG wire vs delta wire — plus the raw wire as the
+    zero-codec ceiling. Collect mode 'thread' matches the committed
+    head-to-head legs; delta keyframe interval 48 is recorded in the
+    row's wire provenance."""
+    from dvf_tpu.benchmarks import bench_e2e_streaming
+    from dvf_tpu.io.sinks import NullSink
+    from dvf_tpu.io.sources import SyntheticSource
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.runtime.engine import Engine
+    from dvf_tpu.runtime.pipeline import Pipeline, PipelineConfig
+    from dvf_tpu.transport.ring_queue import RingFrameQueue
+
+    filt = get_filter("invert")
+
+    def run(wire: str, n_frames: int) -> dict:
+        engine = Engine(filt)
+        engine.compile((8, height, width, 3), np.uint8)
+        queue = RingFrameQueue((height, width, 3), capacity_frames=64,
+                               wire=wire, delta_keyframe_interval=48)
+        sink = NullSink()
+        pipe = Pipeline(
+            SyntheticSource(height=height, width=width, n_frames=n_frames,
+                            motion="block"),
+            filt, sink,
+            PipelineConfig(batch_size=8, queue_size=64, frame_delay=0,
+                           max_inflight=4),
+            engine=engine, queue=queue)
+        t0 = time.perf_counter()
+        try:
+            stats = pipe.run()
+        finally:
+            queue.close()
+        wall = time.perf_counter() - t0
+        row = {"fps": round(sink.count / wall, 1), "frames": sink.count,
+               "faults": stats.get("faults", {}).get("by_kind", {}),
+               **queue.wire_stats()}
+        return row
+
+    # Frame budget from a quick probe per wire (frame-bounded runs).
+    out = {}
+    for wire in ("jpeg", "delta", "raw"):
+        probe = run(wire, 200)
+        frames = max(200, min(6000, int(probe["fps"] * seconds)))
+        out[wire] = run(wire, frames)
+    out["speedup_delta_vs_jpeg"] = (
+        round(out["delta"]["fps"] / out["jpeg"]["fps"], 2)
+        if out["jpeg"]["fps"] else None)
+    # Sanity guard: a delta A/B that absorbed faults or re-keyed most
+    # frames (scene-cut storms report dirty_ratio=None — keyframes carry
+    # that story) is not measuring the delta path.
+    enc = out["delta"].get("encode", {})
+    out["delta"]["healthy"] = (
+        not out["delta"]["faults"]
+        and (enc.get("dirty_ratio") or 0) < 0.5
+        and enc.get("keyframes", 0) < 0.25 * max(1, enc.get("frames", 1)))
+    return out
+
+
+def bench_assist(height: int, width: int) -> dict:
+    """Host encode cost: full RGB path vs entropy-only from
+    device-converted YCbCr 4:2:0 planes (native shim only)."""
+    from dvf_tpu.runtime.codec_assist import DeviceCodecAssist
+    from dvf_tpu.transport.codec import NativeJpegCodec
+
+    try:
+        codec = NativeJpegCodec(quality=90, threads=1)
+    except (RuntimeError, OSError) as e:
+        return {"available": False, "reason": str(e)}
+    try:
+        if not hasattr(codec._lib, "dvf_jpeg_encode_ycbcr420"):
+            return {"available": False, "reason": "shim predates assist"}
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        frame = rng.integers(0, 255, (height, width, 3), np.uint8)
+        assist = DeviceCodecAssist()
+        y, cb, cr = assist.planes(jnp.asarray(frame[None]))
+        y, cb, cr = y[0], cb[0], cr[0]
+        blob_full = codec.encode(frame)
+        blob_assist = codec.encode_ycbcr420(y, cb, cr)
+        reps = max(8, 64 * 512 * 512 // (height * width))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.encode(frame)
+        full_s = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.encode_ycbcr420(y, cb, cr)
+        assist_s = (time.perf_counter() - t0) / reps
+        return {
+            "available": True,
+            "full_encode_fps": round(1.0 / full_s, 1),
+            "assist_encode_fps": round(1.0 / assist_s, 1),
+            "host_speedup": round(full_s / assist_s, 2),
+            "full_kb": round(len(blob_full) / 1024, 1),
+            "assist_kb": round(len(blob_assist) / 1024, 1),
+            "host_input_bytes_ratio": 0.5,  # 1.5 B/px vs 3 B/px
+        }
+    finally:
+        codec.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--height", type=int, default=480)
+    ap.add_argument("--width", type=int, default=640)
+    ap.add_argument("--out-dir", default=os.path.join(REPO, "benchmarks"))
+    args = ap.parse_args(argv)
+
+    os.environ["DVF_FORCE_PLATFORM"] = "cpu"
+    from benchtools import git_rev
+    from dvf_tpu.cli import _force_platform
+
+    _force_platform()
+    from dvf_tpu.transport.codec import jpeg_wire_budget
+
+    doc = {
+        "generated_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "code_rev": git_rev(REPO),
+        "host_cpus": os.cpu_count(),
+        "workload": {"height": args.height, "width": args.width,
+                     "filter": "invert", "motion": "block",
+                     "tile": 32, "keyframe_interval": 48},
+        "cycle_sweep": bench_cycle_sweep(args.height, args.width),
+        "e2e": bench_e2e_ab(args.height, args.width, args.seconds),
+        "codec_assist": bench_assist(args.height, args.width),
+        # The budget model's recommendation at a webcam-like 10% dirty
+        # ratio — what serve's wire-mode warning computes at admission.
+        "wire_budget_at_10pct_dirty": jpeg_wire_budget(
+            args.height, args.width, threads=4,
+            expected_dirty_ratio=0.1, keyframe_interval=48),
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    path = os.path.join(args.out_dir, "DELTA_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({
+        "e2e_jpeg_fps": doc["e2e"]["jpeg"]["fps"],
+        "e2e_delta_fps": doc["e2e"]["delta"]["fps"],
+        "speedup_delta_vs_jpeg": doc["e2e"]["speedup_delta_vs_jpeg"],
+        "assist": doc["codec_assist"].get("host_speedup"),
+        "written": path}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

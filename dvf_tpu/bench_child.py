@@ -118,6 +118,22 @@ def main(argv=None) -> int:
                          "materializes into preallocated slabs at collect; "
                          "monolithic is the classic whole-batch np.asarray "
                          "baseline")
+    ap.add_argument("--transport", choices=("python", "ring"),
+                    default="python",
+                    help="e2e ingest transport; ring puts the native shm "
+                         "ring (and with --wire, a codec) on the hot path")
+    ap.add_argument("--wire", choices=("raw", "jpeg", "delta"),
+                    default="raw",
+                    help="e2e ring payload format — lets a BENCH round "
+                         "A/B full-frame vs temporal-delta wire in the "
+                         "same harness (delta's codec cost scales with "
+                         "--motion's dirty ratio; wire/dirty-ratio "
+                         "provenance lands in the result JSON)")
+    ap.add_argument("--motion", choices=("roll", "block", "none"),
+                    default="roll",
+                    help="e2e synthetic stream motion: roll = full-motion "
+                         "worst case, block = webcam-like low motion, "
+                         "none = static")
     ap.add_argument("--mode", choices=("probe", "headline", "device", "e2e"),
                     default="headline")
     ap.add_argument("--no-decomp", action="store_true",
@@ -281,9 +297,17 @@ def main(argv=None) -> int:
             r = bench_e2e_streaming(filt, n_frames, args.e2e_batch,
                                     args.height, args.width,
                                     collect_mode=args.collect_mode,
+                                    transport=args.transport,
+                                    wire=args.wire,
+                                    motion=args.motion,
                                     ingest=args.ingest,
                                     ingest_depth=args.ingest_depth,
                                     egress=args.egress)
+        if "wire" in r:
+            # Wire provenance + delta accounting (dirty ratio, keyframes,
+            # resyncs): a --wire delta A/B row must say what it measured.
+            result.update(transport=args.transport, wire=args.wire,
+                          motion=args.motion, wire_stats=r["wire"])
         result.update(
             e2e_fps=round(r["fps"], 1),
             e2e_frames=r["frames"],
@@ -322,6 +346,9 @@ def main(argv=None) -> int:
             rl = bench_e2e_latency(filt, n_lat, args.lat_batch,
                                    args.height, args.width, target,
                                    collect_mode=args.collect_mode,
+                                   transport=args.transport,
+                                   wire=args.wire,
+                                   motion=args.motion,
                                    ingest=args.ingest,
                                    ingest_depth=args.ingest_depth,
                                    egress=args.egress)

@@ -194,8 +194,10 @@ def bench_stage_decomposition(
     if measure_encode:
         from dvf_tpu.transport.codec import make_codec
 
-        # threads=1: this is the per-frame serialized cost the latency
-        # model wants, not pool throughput (measure_codec_fps's choice).
+        # threads=1: this is the per-frame serialized CYCLE cost the
+        # latency model wants — the same quantity measure_codec_fps's
+        # explicit mode="cycle" reports (pool throughput is its other,
+        # now separately-named, mode).
         codec = make_codec(threads=1)
         out["codec"] = codec.config()
     for b in batch_sizes:
@@ -335,7 +337,7 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
 
         queue = RingFrameQueue((height, width, 3),
                                capacity_frames=queue_size,
-                               jpeg=(wire == "jpeg"))
+                               wire=wire)
     pipe = Pipeline(
         source,
         filt,
@@ -396,6 +398,10 @@ def _run_pipeline(filt, source, batch_size, height, width, max_inflight,
         # absorbed contained faults and is suspect.
         "faults": stats.get("faults", {}).get("by_kind", {}),
         "recoveries": stats.get("recoveries", 0),
+        # Wire provenance + delta accounting (dirty ratio, keyframes,
+        # resyncs) when the ring transport carried a codec wire — the
+        # bench JSON must say WHICH wire produced the fps beside it.
+        **({"wire": queue.wire_stats()} if queue is not None else {}),
     }
 
 
@@ -415,21 +421,27 @@ def bench_e2e_streaming(
     ingest: str = "streamed",
     ingest_depth: int = 4,
     egress: str = "streamed",
+    motion: str = "roll",
 ) -> dict:
     """Throughput mode: unthrottled source (rate=0), deep queue.
 
     ``transport="ring"`` routes ingest through the native C++ ring
     (``wire="jpeg"`` additionally JPEG-encodes at capture and decodes into
     the dispatch staging buffer — the measured cost of the reference's
-    use_jpeg path, SURVEY §7 hard part 3). The p50/p99 this returns are
-    congestion numbers (queue depth), kept for backward compatibility —
-    use :func:`bench_e2e_latency` for the latency claim.
+    use_jpeg path, SURVEY §7 hard part 3; ``wire="delta"`` rides the
+    temporal-delta codec, whose cost scales with the stream's dirty
+    ratio — pick ``motion`` accordingly: ``"roll"`` is the full-motion
+    worst case, ``"block"`` the webcam-like low-motion regime the delta
+    win is claimed for). The p50/p99 this returns are congestion numbers
+    (queue depth), kept for backward compatibility — use
+    :func:`bench_e2e_latency` for the latency claim.
     """
     from dvf_tpu.io.sources import SyntheticSource
 
     return _run_pipeline(
         filt,
-        SyntheticSource(height=height, width=width, n_frames=n_frames, rate=rate),
+        SyntheticSource(height=height, width=width, n_frames=n_frames,
+                        rate=rate, motion=motion),
         batch_size, height, width, max_inflight,
         queue_size if queue_size is not None else max(64, 4 * batch_size),
         collect_mode=collect_mode, transport=transport, wire=wire, mesh=mesh,
@@ -491,6 +503,7 @@ def bench_e2e_latency(
     ingest: str = "streamed",
     ingest_depth: int = 4,
     egress: str = "streamed",
+    motion: str = "roll",
     max_backoffs: int = 2,
     max_retry_stream_s: float = 400.0,
 ) -> dict:
@@ -526,7 +539,7 @@ def bench_e2e_latency(
         r = _run_pipeline(
             filt,
             SyntheticSource(height=height, width=width, n_frames=n_frames,
-                            rate=target_fps),
+                            rate=target_fps, motion=motion),
             batch_size, height, width, max_inflight,
             queue_size=batch_size,
             collect_mode=collect_mode, transport=transport, wire=wire,

@@ -442,10 +442,12 @@ def cmd_serve(args) -> int:
         queue = RingFrameQueue(
             frame_shape=frame_shape,
             capacity_frames=args.queue_size,
-            jpeg=(args.wire == "jpeg"),
+            wire=args.wire,
             codec_threads=args.codec_threads,
+            delta_tile=args.delta_tile,
+            delta_keyframe_interval=args.delta_keyframe_interval,
         )
-        if args.wire == "jpeg":
+        if args.wire in ("jpeg", "delta"):
             # Host-codec budget check (SURVEY §7 hard part 3): the JPEG
             # wire costs one encode + one decode PER FRAME on this host's
             # cores, and at high rates that — not the TPU — is the
@@ -456,14 +458,26 @@ def cmd_serve(args) -> int:
 
             # Budget against the pool the pipeline ACTUALLY runs: the
             # ring queue's codec pool (default 4 threads), clamped to
-            # physical cores inside jpeg_wire_budget.
-            budget = jpeg_wire_budget(frame_shape[0], frame_shape[1],
-                                      threads=queue.codec_pool_threads)
-            if args.rate and args.rate > budget["capacity_fps"]:
+            # physical cores inside jpeg_wire_budget — which measures the
+            # single-thread codec CYCLE explicitly (mode="cycle"): the
+            # model multiplies one cycle by usable workers, so pool
+            # throughput would double-count the pool. The delta wire's
+            # ceiling depends on the stream's dirty ratio, which is
+            # unknowable before frames flow — budget it at a webcam-like
+            # 10% so the warning still catches hopeless rates.
+            budget = jpeg_wire_budget(
+                frame_shape[0], frame_shape[1],
+                threads=queue.codec_pool_threads,
+                expected_dirty_ratio=(0.1 if args.wire == "delta"
+                                      else None),
+                keyframe_interval=args.delta_keyframe_interval)
+            cap_key = ("delta_capacity_fps" if args.wire == "delta"
+                       else "capacity_fps")
+            if args.rate and args.rate > budget[cap_key]:
                 print(
-                    f"[serve] WARNING: --wire jpeg cannot sustain "
+                    f"[serve] WARNING: --wire {args.wire} cannot sustain "
                     f"--rate {args.rate:g}: measured codec capacity on "
-                    f"this host is ~{budget['capacity_fps']} fps at "
+                    f"this host is ~{budget[cap_key]} fps at "
                     f"{frame_shape[0]}x{frame_shape[1]} "
                     f"({budget['codec_workers']} usable codec workers; "
                     f"{budget['per_core_encode_fps']} enc / "
@@ -473,7 +487,7 @@ def cmd_serve(args) -> int:
                     file=sys.stderr, flush=True)
             elif not args.quiet:
                 print(
-                    f"[serve] jpeg wire budget: ~{budget['capacity_fps']} "
+                    f"[serve] {args.wire} wire budget: ~{budget[cap_key]} "
                     f"fps ceiling at {frame_shape[0]}x{frame_shape[1]} on "
                     f"this host ({budget['cores']} cores)",
                     file=sys.stderr, flush=True)
@@ -728,6 +742,10 @@ def cmd_worker(args) -> int:
         collect_port=args.collect_port,
         batch_size=args.batch,
         use_jpeg=not args.no_jpeg,
+        wire=args.wire,
+        delta_tile=args.delta_tile,
+        delta_keyframe_interval=args.delta_keyframe_interval,
+        delta_device=args.delta_device,
         raw_size=args.target_size,
         jpeg_quality=90,
         codec_threads=args.codec_threads,
@@ -827,13 +845,19 @@ def cmd_bench(args) -> int:
     h, w = spec["h"], spec["w"]
 
     if args.e2e:
+        if args.wire != "raw" and args.transport != "ring":
+            print("error: --wire jpeg/delta needs --transport ring "
+                  "(the codec wire rides the ring payloads)",
+                  file=sys.stderr)
+            return 2
         r = bench_e2e_streaming(filt, args.frames, batch, h, w,
                                 collect_mode=args.collect_mode,
                                 transport=args.transport, wire=args.wire,
                                 mesh=_parse_mesh(args.mesh),
                                 ingest=args.ingest,
                                 ingest_depth=args.ingest_depth,
-                                egress=args.egress)
+                                egress=args.egress,
+                                motion=args.motion)
         out = {
             "metric": f"{args.config}_e2e_fps",
             "value": round(r["fps"], 1),
@@ -842,6 +866,11 @@ def cmd_bench(args) -> int:
             "collect_mode": args.collect_mode,
             "transport": args.transport,
             "wire": args.wire,
+            "motion": args.motion,
+            # Delta accounting + codec provenance when a codec wire ran
+            # (dirty ratio, keyframes, resyncs — the A/B evidence a BENCH
+            # round compares full vs delta wire with).
+            **({"wire_stats": r["wire"]} if "wire" in r else {}),
             # Effective transfer path + hidden-H2D fraction (None when
             # the backend exposes no overlap or monolithic ran).
             "ingest": r["ingest"],
@@ -874,7 +903,8 @@ def cmd_bench(args) -> int:
                                    mesh=_parse_mesh(args.mesh),
                                    ingest=args.ingest,
                                    ingest_depth=args.ingest_depth,
-                                   egress=args.egress)
+                                   egress=args.egress,
+                                   motion=args.motion)
             out.update(
                 p50_ms=round(rl["p50_ms"], 3),
                 p99_ms=round(rl["p99_ms"], 3),
@@ -1314,10 +1344,18 @@ def main(argv=None) -> int:
     sp.add_argument("--sr-checkpoint", default=None, metavar="DIR",
                     help="load trained super-resolution weights from a "
                          "train-sr checkpoint dir (overrides --filter)")
-    sp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
+    sp.add_argument("--wire", choices=("raw", "jpeg", "delta"), default="raw",
                     help="with --transport ring: payload format on the ring "
                          "(jpeg = encode at capture, decode into the device "
-                         "staging buffer — the reference's use_jpeg path)")
+                         "staging buffer — the reference's use_jpeg path; "
+                         "delta = temporal-delta wire, only changed tiles "
+                         "cross with keyframes every N — host codec cost "
+                         "scales with the stream's dirty ratio)")
+    sp.add_argument("--delta-keyframe-interval", type=int, default=16,
+                    help="--wire delta: full keyframe cadence (also the "
+                         "resync bound after dropped delta frames)")
+    sp.add_argument("--delta-tile", type=int, default=32,
+                    help="--wire delta: change-detection tile size")
     sp.add_argument("--sessions", type=int, default=1,
                     help=">1: run the multi-stream serving demo — N "
                          "synthetic client streams at different frame "
@@ -1399,6 +1437,18 @@ def main(argv=None) -> int:
     wp.add_argument("--collect-port", type=int, default=5556)
     wp.add_argument("--batch", type=int, default=8)
     wp.add_argument("--no-jpeg", action="store_true")
+    wp.add_argument("--wire", choices=("raw", "jpeg", "delta"), default=None,
+                    help="wire mode override (default: jpeg, or raw with "
+                         "--no-jpeg). 'delta': temporal-delta wire both "
+                         "directions — composite incoming delta frames, "
+                         "delta-encode results (host codec cost scales "
+                         "with the stream's dirty ratio)")
+    wp.add_argument("--delta-keyframe-interval", type=int, default=16)
+    wp.add_argument("--delta-tile", type=int, default=32)
+    wp.add_argument("--delta-device", action="store_true",
+                    help="--wire delta: compute dirty-tile bitmaps on "
+                         "DEVICE (runtime.codec_assist.DeviceDeltaProbe) "
+                         "instead of the host reduction")
     wp.add_argument("--codec-threads", type=int, default=4,
                     help="JPEG codec thread-pool size (encode/decode "
                          "parallelism; also the asynchronous egress "
@@ -1468,9 +1518,17 @@ def main(argv=None) -> int:
                     help="--e2e ingest transport (ring = native C++ ring)")
     bp.add_argument("--mesh", default=None,
                     help="device mesh, same forms as serve --mesh")
-    bp.add_argument("--wire", choices=("raw", "jpeg"), default="raw",
+    bp.add_argument("--wire", choices=("raw", "jpeg", "delta"), default="raw",
                     help="--e2e ring payload format (jpeg measures the "
-                         "codec-on-the-hot-path cost)")
+                         "codec-on-the-hot-path cost; delta measures the "
+                         "temporal-delta wire, whose codec cost scales "
+                         "with --motion's dirty ratio)")
+    bp.add_argument("--motion", choices=("roll", "block", "none"),
+                    default="roll",
+                    help="--e2e synthetic stream motion: 'roll' = every "
+                         "pixel changes per frame (full-motion worst "
+                         "case), 'block' = webcam-like low motion (the "
+                         "delta wire's target regime), 'none' = static")
 
     args = ap.parse_args(argv)
     prior = os.environ.get("DVF_FORCE_PLATFORM")

@@ -42,6 +42,19 @@ class SyntheticSource:
     ``rate``: target frames/sec; 0 = unthrottled (benchmark mode, the
     analog of measuring pure pipeline capacity rather than the reference's
     30fps camera ceiling, webcam_app.py:14).
+
+    ``motion`` selects the temporal structure, which is what the
+    temporal-delta wire's dirty ratio is a function of:
+
+    - ``True`` / ``"roll"`` — every pixel changes every frame (cyclic
+      roll); the full-motion worst case (dirty ratio ≈ 1).
+    - ``"block"`` — a small moving block over a STATIC background, the
+      webcam-like low-motion workload (a subject moving against a fixed
+      scene): per-frame change is ~2 block footprints, a few % of the
+      frame, which is the regime the delta wire's order-of-magnitude
+      codec saving is claimed for (benchmarks/DELTA_BENCH.json).
+    - ``False`` / ``"none"`` — a fully static stream (dirty ratio 0;
+      the bit-identity equivalence tests).
     """
 
     def __init__(
@@ -90,10 +103,38 @@ class SyntheticSource:
             self._base = (base // 2 + ramp // 2).astype(np.uint8)
         else:
             raise ValueError(f"texture must be 'noise' or 'structured', got {texture!r}")
-        n_cycle = min(16, n_frames) if motion else 1
-        self._cycle = [
-            np.roll(self._base, (i * 2) % self.width, axis=1) for i in range(n_cycle)
-        ]
+        if motion is True:
+            motion = "roll"
+        elif motion is False:
+            motion = "none"
+        if motion not in ("roll", "block", "none"):
+            raise ValueError(
+                f"motion must be 'roll', 'block', 'none' (or a bool), "
+                f"got {motion!r}")
+        self.motion = motion
+        n_cycle = min(16, n_frames) if motion != "none" else 1
+        if motion == "block":
+            # Low-motion: invert a block (~1/6 of each linear dim → ~3%
+            # of the area) walking a precomputed cycle of positions over
+            # the static base. Same read-only-view serving discipline as
+            # the roll cycle — the source must never become the
+            # bottleneck it exists to measure around.
+            bh, bw = max(8, height // 6), max(8, width // 6)
+            self._cycle = []
+            for i in range(n_cycle):
+                f = self._base.copy()
+                y0 = (i * max(1, (height - bh) // max(1, n_cycle - 1))
+                      ) % max(1, height - bh + 1)
+                x0 = (i * max(1, (width - bw) // max(1, n_cycle - 1))
+                      ) % max(1, width - bw + 1)
+                f[y0: y0 + bh, x0: x0 + bw] = 255 - f[y0: y0 + bh,
+                                                      x0: x0 + bw]
+                self._cycle.append(f)
+        else:
+            self._cycle = [
+                np.roll(self._base, (i * 2) % self.width, axis=1)
+                for i in range(n_cycle)
+            ]
         for f in self._cycle:
             f.setflags(write=False)  # served as shared views — keep them immutable
 

@@ -570,3 +570,94 @@ def bilateral_pallas(
         fn,
         halo=d // 2,
     )
+
+
+# ---------------------------------------------------------------------------
+# Temporal-delta change detection (PR 7): per-tile max-abs-diff reduction
+# ---------------------------------------------------------------------------
+
+
+def tile_maxdiff_ref(a: jnp.ndarray, b: jnp.ndarray,
+                     tile: int = 32) -> jnp.ndarray:
+    """jnp golden: per-tile max |a − b| of two uint8 NHWC batches.
+
+    ``(B, H, W, C) × (B, H, W, C) → (B, ⌈H/tile⌉, ⌈W/tile⌉) uint8`` —
+    the device half of the temporal-delta wire (transport.codec
+    .DeltaCodec): a tile whose reduction exceeds ``delta_threshold`` is
+    re-encoded, the rest composite from the decoder's cache. Pure VPU
+    arithmetic (max − min keeps everything uint8; no float cast), cheap
+    enough to ride as an appended stage after any filter program.
+    Unaligned H/W are zero-padded — a zero diff can never mark a tile
+    dirty, so padding is semantically invisible.
+    """
+    if a.ndim == 3:
+        return tile_maxdiff_ref(a[None], b[None], tile)[0]
+    bsz, h, w, c = a.shape
+    d = jnp.maximum(a, b) - jnp.minimum(a, b)
+    nty, ntx = -(-h // tile), -(-w // tile)
+    ph, pw = nty * tile - h, ntx * tile - w
+    if ph or pw:
+        d = jnp.pad(d, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    return d.reshape(bsz, nty, tile, ntx, tile, c).max(axis=(2, 4, 5))
+
+
+def _tile_maxdiff_kernel(tile: int, row_px: int, ntx: int):
+    """One grid step reduces a (tile, W·C) slab pair to its (ntx,) tile
+    row. W·C rides the lane axis (channel-fastest NHWC layout means tile
+    j's pixels are the CONTIGUOUS lane range [j·tile·C, (j+1)·tile·C) —
+    no transpose needed, unlike the stencil kernels above). The per-tile
+    segmentation is a static unroll over ntx: ~tens of segments, each a
+    single VPU max-reduce."""
+
+    def kernel(a_ref, b_ref, out_ref):
+        a = a_ref[0].astype(jnp.int32)
+        b = b_ref[0].astype(jnp.int32)
+        d = jnp.maximum(a, b) - jnp.minimum(a, b)   # (tile, row_px)
+        cols = jnp.max(d, axis=0)                   # (row_px,)
+        seg = row_px // ntx
+        vals = [jnp.max(cols[j * seg: (j + 1) * seg]) for j in range(ntx)]
+        out_ref[0, 0, :] = jnp.stack(vals).astype(jnp.uint8)
+
+    return kernel
+
+
+def tile_maxdiff_pallas(a: jnp.ndarray, b: jnp.ndarray, tile: int = 32,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Pallas tile_maxdiff: one HBM pass per (batch row, tile row) pair,
+    the whole reduction held in VMEM/registers. Falls back to the jnp
+    golden when the geometry doesn't tile exactly (edge tiles) — the
+    kernel exists for the aligned common case (512², 1080p at tile 8/27…),
+    where it wins by never materializing the (B, H, W, C) diff array the
+    jnp version round-trips through HBM.
+    """
+    interpret = _auto_interpret(interpret)
+    squeeze = a.ndim == 3
+    if squeeze:
+        a, b = a[None], b[None]
+    bsz, h, w, c = a.shape
+    if h % tile or w % tile or h % _SUBLANE:
+        out = tile_maxdiff_ref(a, b, tile)
+        return out[0] if squeeze else out
+    nty, ntx = h // tile, w // tile
+    a3 = a.reshape(bsz, h, w * c)
+    b3 = b.reshape(bsz, h, w * c)
+    out = pl.pallas_call(
+        _tile_maxdiff_kernel(tile, w * c, ntx),
+        grid=(bsz, nty),
+        in_specs=[pl.BlockSpec((1, tile, w * c), lambda bb, ii: (bb, ii, 0)),
+                  pl.BlockSpec((1, tile, w * c), lambda bb, ii: (bb, ii, 0))],
+        out_specs=pl.BlockSpec((1, 1, ntx), lambda bb, ii: (bb, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((bsz, nty, ntx), jnp.uint8),
+        interpret=interpret,
+    )(a3, b3)
+    return out[0] if squeeze else out
+
+
+def tile_maxdiff(a: jnp.ndarray, b: jnp.ndarray, tile: int = 32,
+                 interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Dispatch: the Pallas kernel on aligned geometries (compiled on
+    TPU, interpret elsewhere), the jnp golden otherwise."""
+    h, w = a.shape[-3], a.shape[-2]
+    if h % tile == 0 and w % tile == 0 and h % _SUBLANE == 0:
+        return tile_maxdiff_pallas(a, b, tile, interpret=interpret)
+    return tile_maxdiff_ref(a, b, tile)

@@ -1,0 +1,174 @@
+"""On-device codec assist: the transform half of the host JPEG cycle,
+moved onto the accelerator.
+
+Two device stages, both appended AFTER the filter program on the result
+batch (they consume the engine's output exactly where the egress plane
+fetches it, so their cost hides under the next batch's staging the same
+way the per-shard D2H does — the GPUOS operation-fusion discipline,
+PAPERS.md arXiv:2604.17861, applied at the codec boundary):
+
+- :class:`DeviceDeltaProbe` — the temporal-delta wire's change
+  detection: per-tile max-abs-diff of each output frame against the
+  previously delivered one (``ops.pallas_kernels.tile_maxdiff`` — a
+  Pallas kernel on aligned geometries, the jnp golden elsewhere).
+  Within a batch, frame *i*'s predecessor is row *i−1*; across batches
+  the probe keeps the last delivered row as device-resident state. The
+  host fetches a few-hundred-byte bitmap instead of running its own
+  frame-sized reduction pass (``transport.codec.host_tile_maxdiff``).
+- :class:`DeviceCodecAssist` — RGB→YCbCr (BT.601 full range, libjpeg's
+  matrix) plus the 2×2 chroma subsample on device, so the host codec
+  starts from HALF the bytes and skips its color-convert and
+  downsample passes entirely: ``NativeJpegCodec.encode_ycbcr420`` runs
+  DCT + quantization + entropy coding only (jpeg_write_raw_data).
+
+Both are separate tiny jitted programs rather than a re-trace of the
+filter step: jax's async dispatch queues them back-to-back with the
+filter program (no host sync in between), the engine's compiled
+signature and every egress consumer stay untouched, and a path that
+doesn't want the stage never pays for it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dvf_tpu.ops.pallas_kernels import tile_maxdiff
+
+
+class DeviceDeltaProbe:
+    """Device-side dirty-tile bitmaps for a SEQUENTIAL frame stream.
+
+    ``bitmaps(batch)`` returns a host ``(B, ⌈H/tile⌉, ⌈W/tile⌉)`` uint8
+    array of per-tile max-abs-diffs vs each frame's predecessor. Only
+    valid for streams whose batch rows are consecutive frames of ONE
+    stream (pipeline, ZMQ worker) — a cross-session serve batch
+    interleaves tenants, whose codecs fall back to the host reduction.
+
+    Reference semantics: the probe diffs each frame against its
+    PREDECESSOR, not against the encoder's last-shipped state. At
+    ``delta_threshold=0`` (the default) the two are exactly equivalent —
+    every change ships the moment it happens, so "changed since the
+    previous frame" and "changed since last shipped" select the same
+    tiles. At thresholds > 0 they differ: sub-threshold drift that the
+    closed-loop host reduction re-sends once cumulative divergence
+    crosses the threshold stays invisible to a per-frame diff, so drift
+    is bounded only by the keyframe cadence — use the host path (no
+    bitmap) for lossy thresholds.
+
+    The first call's row 0 has no predecessor and is marked all-dirty
+    (the delta codec encodes a keyframe there anyway — no encoder
+    reference — so the conservative answer costs nothing). If a batch is
+    dropped AFTER the probe ran (downstream containment), the next
+    batch diffs against the dropped batch's tail — under-reporting
+    changes until the next keyframe bounds the staleness, exactly like
+    any lost delta frame.
+    """
+
+    def __init__(self, tile: int = 32):
+        import jax
+
+        self.tile = int(tile)
+        self._prev = None  # (1, H, W, C) device array — last delivered row
+        self._shape: Optional[Tuple[int, ...]] = None
+
+        def probe(batch, prev):
+            chain = jax.numpy.concatenate([prev, batch[:-1]], axis=0)
+            return tile_maxdiff(batch, chain, self.tile), batch[-1:]
+
+        self._fn = jax.jit(probe)
+
+    def bitmaps(self, batch) -> np.ndarray:
+        """One device reduction + a tiny host fetch; ``batch`` is the
+        engine's (possibly sharded) result array."""
+        shape = tuple(batch.shape)
+        if self._prev is None or self._shape != shape:
+            # First batch: rows 1.. still have in-batch predecessors —
+            # only row 0 lacks one and is marked all-dirty (the delta
+            # encoder keyframes it anyway, having no reference). Marking
+            # the WHOLE batch dirty would make the device path ship
+            # every tile raw for rows 1.., silently diverging from the
+            # host-detection path's output.
+            self._shape = shape
+            tiles, self._prev = self._fn(batch, batch[:1])
+            out = np.array(tiles)  # own the buffer: jax arrays view
+            #   read-only and row 0 is overwritten below
+            out[0] = 255
+            return out
+        tiles, self._prev = self._fn(batch, self._prev)
+        return np.asarray(tiles)
+
+    def reset(self) -> None:
+        """Drop the device state (geometry change, engine rebuild)."""
+        self._prev = None
+        self._shape = None
+
+
+# -- YCbCr 4:2:0 device stages ------------------------------------------
+
+# BT.601 full-range (JFIF) — the same matrix libjpeg applies on the host
+# path this stage replaces, so assist output decodes indistinguishably.
+_RGB2Y = (0.299, 0.587, 0.114)
+_RGB2CB = (-0.168735892, -0.331264108, 0.5)
+_RGB2CR = (0.5, -0.418687589, -0.081312411)
+
+
+def rgb_to_ycbcr420(batch):
+    """Device stage: (B, H, W, 3) uint8 RGB → (y, cb, cr) uint8 planes
+    ((B, H, W), (B, H/2, W/2), (B, H/2, W/2)). Odd H/W are edge-padded
+    to even first (mirrors libjpeg's own edge replication). The chroma
+    subsample is the 2×2 mean — what libjpeg's default h2v2 downsampler
+    computes."""
+    import jax.numpy as jnp
+
+    b, h, w, _ = batch.shape
+    if h % 2 or w % 2:
+        batch = jnp.pad(batch, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)),
+                        mode="edge")
+        h, w = h + h % 2, w + w % 2
+    x = batch.astype(jnp.float32)
+    r, g, bl = x[..., 0], x[..., 1], x[..., 2]
+    y = _RGB2Y[0] * r + _RGB2Y[1] * g + _RGB2Y[2] * bl
+    cb = 128.0 + _RGB2CB[0] * r + _RGB2CB[1] * g + _RGB2CB[2] * bl
+    cr = 128.0 + _RGB2CR[0] * r + _RGB2CR[1] * g + _RGB2CR[2] * bl
+    cb = cb.reshape(b, h // 2, 2, w // 2, 2).mean(axis=(2, 4))
+    cr = cr.reshape(b, h // 2, 2, w // 2, 2).mean(axis=(2, 4))
+    to_u8 = lambda p: jnp.clip(jnp.round(p), 0, 255).astype(jnp.uint8)  # noqa: E731
+    return to_u8(y), to_u8(cb), to_u8(cr)
+
+
+def ycbcr420_to_rgb_host(y: np.ndarray, cb: np.ndarray,
+                         cr: np.ndarray) -> np.ndarray:
+    """Host inverse (tests + any raw-assist wire consumer): nearest
+    chroma upsample + BT.601 inverse, back to (…, H, W, 3) uint8."""
+    yf = y.astype(np.float32)
+    cbf = np.repeat(np.repeat(cb.astype(np.float32) - 128.0, 2, axis=-2),
+                    2, axis=-1)
+    crf = np.repeat(np.repeat(cr.astype(np.float32) - 128.0, 2, axis=-2),
+                    2, axis=-1)
+    r = yf + 1.402 * crf
+    g = yf - 0.344136286 * cbf - 0.714136286 * crf
+    b = yf + 1.772 * cbf
+    return np.clip(np.round(np.stack([r, g, b], axis=-1)), 0,
+                   255).astype(np.uint8)
+
+
+class DeviceCodecAssist:
+    """jit-compiled RGB→YCbCr420 stage + host plane fetch.
+
+    ``planes(batch)`` runs the conversion on device (queued behind the
+    filter program by async dispatch) and materializes the three planes
+    on the host — 1.5 bytes/px instead of 3, which is both the D2H and
+    the host-codec input saving. Feed the per-frame planes to
+    ``NativeJpegCodec.encode_ycbcr420`` for the entropy-only encode.
+    """
+
+    def __init__(self):
+        import jax
+
+        self._fn = jax.jit(rgb_to_ycbcr420)
+
+    def planes(self, batch):
+        y, cb, cr = self._fn(batch)
+        return np.asarray(y), np.asarray(cb), np.asarray(cr)

@@ -326,10 +326,19 @@ class AsyncCodecPlane:
     def __len__(self) -> int:
         return len(self._pending)
 
-    def submit(self, rows: Sequence[np.ndarray], metas: Sequence[Any]) -> None:
+    def submit(self, rows: Sequence[np.ndarray], metas: Sequence[Any],
+               bitmaps: Optional[Sequence[np.ndarray]] = None) -> None:
+        """``bitmaps`` (delta wire only): per-row device-computed dirty-
+        tile reductions (runtime.codec_assist.DeviceDeltaProbe), handed
+        through to ``DeltaCodec.encode_batch_async`` so the host skips
+        its own change-detection pass. Ignored by full-frame codecs."""
         t0 = time.perf_counter()
         if self.jpeg:
-            futures = self.codec.encode_batch_async(rows)
+            if bitmaps is not None:
+                futures = self.codec.encode_batch_async(rows,
+                                                        bitmaps=bitmaps)
+            else:
+                futures = self.codec.encode_batch_async(rows)
             entry = _EncodeEntry(list(metas), futures, None, t0)
             for f in futures:
                 f.add_done_callback(lambda _f, e=entry: e.mark_done())

@@ -906,24 +906,56 @@ class ZmqStreamBridge:
         encode_depth: int = 2,
         poll_ms: int = 10,
         slo_ms: Optional[float] = None,
+        wire: Optional[str] = None,
+        delta_tile: int = 32,
+        delta_keyframe_interval: int = 16,
+        delta_threshold: int = 0,
+        delta_degrade_after: int = 8,
     ):
         import zmq
 
-        from dvf_tpu.transport.codec import make_codec
+        from dvf_tpu.transport.codec import WIRE_MODES, make_wire_codec
         from dvf_tpu.transport.zmq_ingress import READY
 
+        if wire is None:
+            wire = "jpeg" if use_jpeg else "raw"
+        if wire not in WIRE_MODES:
+            raise ValueError(f"wire must be one of {WIRE_MODES}, "
+                             f"got {wire!r}")
         self._zmq = zmq
         self._ready = READY
         self.frontend = frontend
         self.session_id = frontend.open_stream(slo_ms=slo_ms)
-        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
+        self.wire = wire
+        if wire == "delta":
+            # Temporal-delta wire, both directions of this bridge: one
+            # DeltaCodec instance carries independent encoder (result
+            # deliveries — a single SESSION's frames, so they are
+            # sequential even though the engine batch under them is
+            # cross-tenant) and decoder (incoming app frames) state.
+            self.codec = make_wire_codec(
+                "delta", quality=jpeg_quality, threads=codec_threads,
+                tile=delta_tile,
+                keyframe_interval=delta_keyframe_interval,
+                delta_threshold=delta_threshold,
+                on_gap="raise")
+        else:
+            self.codec = make_wire_codec("jpeg", quality=jpeg_quality,
+                                         threads=codec_threads)
+        # Bounded delta degradation (the bridge has no fault-budget
+        # ladder of its own): this many contained wire errors flip the
+        # encoder to full-frame keyframes — the peer decodes those
+        # unchanged, at full-frame JPEG cost.
+        self._delta_degrade_after = delta_degrade_after
+        self._delta_errors = 0
+        self.wire_degraded = False
         # Asynchronous codec plane (runtime/egress.py): deliveries polled
         # from the session are batch-encoded on the codec pool while the
         # loop keeps pumping credits/frames; completed batches drain in
         # order. Raw mode rides the same plane as zero-copy memoryviews.
-        self.plane = AsyncCodecPlane(self.codec, jpeg=use_jpeg,
+        self.plane = AsyncCodecPlane(self.codec, jpeg=(wire != "raw"),
                                      depth=encode_depth)
-        self.use_jpeg = use_jpeg
+        self.use_jpeg = wire != "raw"
         self.raw_size = raw_size
         self.poll_ms = poll_ms
         self.errors = 0
@@ -937,6 +969,20 @@ class ZmqStreamBridge:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def _delta_fault(self) -> None:
+        """Count one contained delta-wire fault; past the bound, degrade
+        the encoder to full-frame keyframes (stays decodable by the same
+        peer — the wire is framed either way)."""
+        if self.wire != "delta" or self.wire_degraded:
+            return
+        self._delta_errors += 1
+        if self._delta_errors >= self._delta_degrade_after:
+            self.codec.full_frames = True
+            self.wire_degraded = True
+            print("[ZmqStreamBridge] repeated delta wire faults: "
+                  "degrading to full-frame JPEG (keyframe-only)",
+                  file=sys.stderr, flush=True)
 
     def _decode(self, payload: bytes) -> np.ndarray:
         if self.use_jpeg:
@@ -1001,6 +1047,7 @@ class ZmqStreamBridge:
                     for d, payload, err in batch:
                         if err is not None:
                             self.errors += 1  # one bad frame: dropped
+                            self._delta_fault()
                             print(f"[ZmqStreamBridge] encode failed "
                                   f"(dropping frame): {err!r}",
                                   file=sys.stderr)
@@ -1022,6 +1069,10 @@ class ZmqStreamBridge:
                     break
             except Exception as e:  # noqa: BLE001 — per-iteration containment
                 self.errors += 1
+                from dvf_tpu.transport.codec import DeltaWireError
+
+                if isinstance(e, DeltaWireError):
+                    self._delta_fault()
                 if in_send and out_pending:
                     # The head delivery's OWN send raised (never zmq.Again
                     # — that breaks out above): drop that one frame so

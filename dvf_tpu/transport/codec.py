@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
@@ -105,8 +106,10 @@ class JpegCodec:
 
     def config(self) -> dict:
         """Codec provenance for bench JSON: which backend/quality/threads
-        actually produced the encode numbers beside it."""
-        return {"backend": "cv2", "quality": self.quality,
+        actually produced the encode numbers beside it. ``wire`` is the
+        wire mode this codec implements — full-frame JPEG here; the
+        temporal-delta wrapper reports ``"delta"`` plus its knobs."""
+        return {"backend": "cv2", "wire": "jpeg", "quality": self.quality,
                 "threads": self.pool._max_workers}
 
     def close(self) -> None:
@@ -166,6 +169,19 @@ def _load_shim() -> ctypes.CDLL:
             _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, _u8p,
             ctypes.c_ulong,
         ]
+        try:
+            # Codec-assist entry (entropy path from device-converted
+            # YCbCr 4:2:0 planes). The content-hash build cache rebuilds
+            # the .so whenever jpeg_shim.cpp changes, so the symbol is
+            # present on any current build; the guard only covers a
+            # hand-copied stale library.
+            lib.dvf_jpeg_encode_ycbcr420.restype = ctypes.c_long
+            lib.dvf_jpeg_encode_ycbcr420.argtypes = [
+                _u8p, _u8p, _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                _u8p, ctypes.c_ulong,
+            ]
+        except AttributeError:  # pragma: no cover — stale external .so
+            pass
         _shim = lib
     return _shim
 
@@ -263,9 +279,47 @@ class NativeJpegCodec:
         return out
 
     def config(self) -> dict:
-        """Codec provenance for bench JSON (backend/quality/threads)."""
-        return {"backend": "native", "quality": self.quality,
+        """Codec provenance for bench JSON (backend/wire/quality/threads)."""
+        return {"backend": "native", "wire": "jpeg", "quality": self.quality,
                 "threads": self.pool._max_workers}
+
+    # -- codec assist (device-converted YCbCr 4:2:0 planes) -------------
+
+    def encode_ycbcr420(self, y: np.ndarray, cb: np.ndarray,
+                        cr: np.ndarray) -> bytes:
+        """Entropy-path encode from PRE-CONVERTED planes: the device
+        already did RGB→YCbCr and the 2×2 chroma subsample
+        (runtime/codec_assist.py), so the host skips libjpeg's color
+        convert + downsample passes and starts from half the bytes —
+        DCT + quantization + Huffman only (jpeg_write_raw_data).
+
+        ``y`` is (H, W) uint8, ``cb``/``cr`` are (H//2, W//2) uint8 (H
+        and W even — the device stage pads). Decodes with the ordinary
+        JPEG decoder on any peer.
+        """
+        if not hasattr(self._lib, "dvf_jpeg_encode_ycbcr420"):
+            raise RuntimeError("jpeg shim predates ycbcr420 assist")
+        y = np.ascontiguousarray(y, dtype=np.uint8)
+        cb = np.ascontiguousarray(cb, dtype=np.uint8)
+        cr = np.ascontiguousarray(cr, dtype=np.uint8)
+        h, w = y.shape
+        if h % 2 or w % 2 or cb.shape != (h // 2, w // 2) \
+                or cr.shape != (h // 2, w // 2):
+            raise ValueError(
+                f"ycbcr420 planes inconsistent: y {y.shape}, cb {cb.shape}, "
+                f"cr {cr.shape} (H and W must be even)")
+        cap = h * w * 3 + 4096
+        scratch = getattr(self._tls, "scratch", None)
+        if scratch is None or len(scratch) < cap:
+            scratch = (ctypes.c_uint8 * cap)()
+            self._tls.scratch = scratch
+        n = self._lib.dvf_jpeg_encode_ycbcr420(
+            y.ctypes.data_as(_u8p), cb.ctypes.data_as(_u8p),
+            cr.ctypes.data_as(_u8p), h, w, self.quality, scratch,
+            len(scratch))
+        if n <= 0:
+            raise ValueError(f"JPEG ycbcr420 encode failed (rc={n})")
+        return bytes(memoryview(scratch)[: int(n)])
 
     def close(self) -> None:
         # Join the pool (see JpegCodec.close): bounded by cancel_futures.
@@ -273,23 +327,57 @@ class NativeJpegCodec:
 
 
 def measure_codec_fps(height: int, width: int, samples: int = 8,
-                      quality: int = 90):
-    """Quick per-core codec throughput at this geometry (~0.1–0.3 s).
+                      quality: int = 90, mode: str = "cycle",
+                      threads: int = 4):
+    """Quick host codec throughput at this geometry (~0.1–0.3 s).
 
-    Returns ``(encode_fps, decode_fps)`` measured single-threaded on a
-    realistic (noise, worst-case-entropy) frame. This is the measurement
-    behind serve's wire-mode budget warning — the decision must use THIS
-    host's numbers, not the committed CODEC_BENCH table from another
-    machine (SURVEY §7 hard part 3: host JPEG throughput is the first
-    bottleneck at high rates).
+    Returns ``(encode_fps, decode_fps)`` on a realistic (noise,
+    worst-case-entropy) frame, in one of two explicitly-named modes —
+    the two quantities were previously conflated (the latency model in
+    ``benchmarks.bench_stage_decomposition`` wants the serialized cycle,
+    a pool-sizing decision wants aggregate throughput):
+
+    - ``mode="cycle"`` (default): single-thread per-frame CYCLE time —
+      one encode (or decode) start-to-finish on one core. This is what a
+      latency model adds to a frame's critical path, and what the serve
+      wire-budget warning divides cores by.
+    - ``mode="pool"``: aggregate throughput of a ``threads``-wide codec
+      pool driven with a full batch (``encode_batch``/``decode_batch``)
+      — the number a pool-sizing decision (codec_threads knob) compares
+      across thread counts. On a 1-core host this converges to cycle
+      rate; on real cores it exceeds it.
+
+    This is the measurement behind serve's wire-mode budget warning — the
+    decision must use THIS host's numbers, not the committed CODEC_BENCH
+    table from another machine (SURVEY §7 hard part 3: host JPEG
+    throughput is the first bottleneck at high rates).
     """
     import time
 
-    codec = make_codec(quality=quality, threads=1)
+    if mode not in ("cycle", "pool"):
+        raise ValueError(f"mode must be 'cycle' or 'pool', got {mode!r}")
+    codec = make_codec(quality=quality,
+                       threads=1 if mode == "cycle" else threads)
     try:
         rng = np.random.default_rng(0)
         frame = rng.integers(0, 255, size=(height, width, 3), dtype=np.uint8)
         blob = codec.encode(frame)  # warm
+        if mode == "pool":
+            nb = max(2, threads)
+            frames = [frame] * nb
+            blobs = [blob] * nb
+            staging = np.empty((nb, height, width, 3), np.uint8)
+            codec.encode_batch(frames)
+            codec.decode_batch(blobs, out=staging)
+            t0 = time.perf_counter()
+            for _ in range(samples):
+                codec.encode_batch(frames)
+            enc_s = (time.perf_counter() - t0) / (samples * nb)
+            t0 = time.perf_counter()
+            for _ in range(samples):
+                codec.decode_batch(blobs, out=staging)
+            dec_s = (time.perf_counter() - t0) / (samples * nb)
+            return 1.0 / max(enc_s, 1e-9), 1.0 / max(dec_s, 1e-9)
         out = np.empty((height, width, 3), np.uint8)
         if hasattr(codec, "decode_into"):
             codec.decode_into(blob, out)
@@ -315,8 +403,11 @@ def measure_codec_fps(height: int, width: int, samples: int = 8,
 
 
 def jpeg_wire_budget(height: int, width: int, quality: int = 90,
-                     threads: Optional[int] = None) -> dict:
-    """Host-codec budget for the JPEG wire at one frame geometry.
+                     threads: Optional[int] = None,
+                     overlap_depth: int = 1,
+                     expected_dirty_ratio: Optional[float] = None,
+                     keyframe_interval: int = 16) -> dict:
+    """Host-codec budget for the wire at one frame geometry.
 
     In a single-process serve, BOTH legs run on this host (capture thread
     encodes, dispatch decodes into staging), so the sustainable rate is
@@ -326,21 +417,74 @@ def jpeg_wire_budget(height: int, width: int, quality: int = 90,
     caps at 4× per-core speed, and a 32-thread pool on this 1-core bench
     host still caps at 1×. ``capacity_fps`` is that ceiling;
     ``decode_only_capacity_fps`` is the ceiling when only decode is local
-    (remote camera encodes on its own host). The full break-even analysis
-    lives in benchmarks/TPU_RESULTS.md.
+    (remote camera encodes on its own host).
+
+    Per-core rates come from :func:`measure_codec_fps` in ``"cycle"``
+    mode explicitly: the budget model multiplies a SINGLE-THREAD cycle
+    time by usable workers, so feeding it pool throughput would count the
+    pool twice (the bug this parameterization fixes).
+
+    Two extensions size the post-PR-5/PR-7 wire modes:
+
+    - ``overlap_depth`` (the asynchronous codec plane's in-flight encode
+      window, ``runtime.egress.AsyncCodecPlane``): with a window ≥ 1 the
+      encode leg runs on pool threads UNDER the next batch's
+      decode/compute, so on a multi-core host the pipeline's exposed
+      codec cost per frame drops from (enc + dec) to max(enc, dec) —
+      ``overlapped_capacity_fps``. On a 1-core host overlap changes
+      scheduling, not arithmetic throughput, so the overlapped ceiling
+      is clamped to never exceed ``capacity_fps`` × usable cores / 1.
+    - ``expected_dirty_ratio`` (temporal-delta wire, ``DeltaCodec``):
+      the expected fraction of tiles that change per frame. A delta
+      frame pays ~dirty_ratio of a full codec cycle plus the cheap
+      change-detection reduction, and one full cycle every
+      ``keyframe_interval`` frames — ``delta_capacity_fps``.
+
+    ``wire_mode`` is the recommendation given the numbers: ``"delta"``
+    when an expected dirty ratio was supplied and its ceiling clearly
+    beats full-frame JPEG (>1.2×), else ``"jpeg"``. The full break-even
+    analysis lives in benchmarks/TPU_RESULTS.md.
     """
-    enc_fps, dec_fps = measure_codec_fps(height, width, quality=quality)
+    enc_fps, dec_fps = measure_codec_fps(height, width, quality=quality,
+                                         mode="cycle")
     cores = os.cpu_count() or 1
     workers = min(cores, threads) if threads else cores
-    per_frame_s = 1.0 / enc_fps + 1.0 / dec_fps
-    return {
+    enc_s, dec_s = 1.0 / enc_fps, 1.0 / dec_fps
+    per_frame_s = enc_s + dec_s
+    capacity = workers / per_frame_s
+    out = {
         "per_core_encode_fps": round(enc_fps, 1),
         "per_core_decode_fps": round(dec_fps, 1),
         "cores": cores,
         "codec_workers": workers,
-        "capacity_fps": round(workers / per_frame_s, 1),
+        "capacity_fps": round(capacity, 1),
         "decode_only_capacity_fps": round(workers * dec_fps, 1),
+        "overlap_depth": overlap_depth,
     }
+    # Async-plane overlap: encode hides under compute/decode only when a
+    # second core can actually run it — the cores >= 2 guard expresses
+    # that a 1-core host gains nothing (same arithmetic, different
+    # interleaving).
+    if overlap_depth >= 1 and cores >= 2:
+        out["overlapped_capacity_fps"] = round(
+            workers / max(enc_s, dec_s), 1)
+    else:
+        out["overlapped_capacity_fps"] = out["capacity_fps"]
+    wire_mode = "jpeg"
+    if expected_dirty_ratio is not None:
+        r = min(1.0, max(0.0, float(expected_dirty_ratio)))
+        # Delta frame ≈ dirty_ratio of a full cycle (both legs scale with
+        # encoded area) + the change-detection reduction (~one memory
+        # pass, modeled as 10% of a decode); keyframes amortize one full
+        # cycle over the interval.
+        delta_s = (r * per_frame_s + 0.1 * dec_s
+                   + per_frame_s / max(1, keyframe_interval))
+        out["expected_dirty_ratio"] = r
+        out["delta_capacity_fps"] = round(workers / delta_s, 1)
+        if out["delta_capacity_fps"] > 1.2 * out["capacity_fps"]:
+            wire_mode = "delta"
+    out["wire_mode"] = wire_mode
+    return out
 
 
 def make_codec(quality: int = 90, threads: int = 4):
@@ -354,3 +498,659 @@ def make_codec(quality: int = 90, threads: int = 4):
         print(f"[dvf] native jpeg shim unavailable ({e}); using cv2 codec",
               file=sys.stderr)
         return JpegCodec(quality=quality, threads=threads)
+
+
+# -- temporal-delta wire ------------------------------------------------
+#
+# The head-to-head gap is codec-bound, not compute-bound: every delivery
+# path pays the FULL host JPEG cycle per frame even when almost nothing
+# in the frame changed (raw-wire 8.3× the reference vs ~1.3-1.5×
+# same-codec, ROADMAP open item 3). DeltaCodec shrinks the work the host
+# codec does instead of overlapping it harder: encode only the tiles
+# whose pixels changed since the last shipped state, composite on the
+# decoder's cached previous frame. For webcam-like streams (a moving
+# subject on a static scene) this cuts encode bytes and host-codec CPU
+# by roughly the dirty ratio — an order of magnitude at typical motion.
+
+WIRE_MODES = ("raw", "jpeg", "delta")
+
+DELTA_MAGIC = b"\xd6W"
+DELTA_VERSION = 1
+_DELTA_FLAG_KEY = 0x01
+_DELTA_FLAG_LOSSLESS = 0x02
+# <magic(2) ver(1) flags(1) seq(u32) h(u16) w(u16) tile(u16) pad(2)>
+_DELTA_HEADER = struct.Struct("<2sBBIHHHxx")
+
+
+class DeltaWireError(ValueError):
+    """Framing violation on the delta wire (truncated tile payload, bad
+    header, inconsistent lengths) — a WIRE fault, not a pixel-decode
+    fault, so transports classify it under the ``transport`` kind and
+    the error budget degrades the delta path back to full-frame mode."""
+
+
+class DeltaResyncError(DeltaWireError):
+    """The decoder cannot reconstruct this delta frame (reference lost:
+    sequence gap from a dropped frame, or no keyframe seen yet). The
+    caller's recovery is a keyframe: in-process pairs call the encoder's
+    :meth:`DeltaCodec.force_keyframe`; one-way wires drop until the next
+    scheduled keyframe lands (bounded by ``keyframe_interval``)."""
+
+
+def tile_grid(height: int, width: int, tile: int):
+    """((n_tiles_y, n_tiles_x), bitmap_bytes) for one geometry."""
+    nty = -(-height // tile)
+    ntx = -(-width // tile)
+    return (nty, ntx), (nty * ntx + 7) // 8
+
+
+def host_tile_maxdiff(a: np.ndarray, b: np.ndarray, tile: int,
+                      scratch: Optional[tuple] = None) -> np.ndarray:
+    """Per-tile max-abs-diff of two (H, W, 3) uint8 frames — the host
+    mirror of the device-side reduction (ops.pallas_kernels.tile_maxdiff
+    / runtime.codec_assist.DeviceDeltaProbe). Pure uint8 arithmetic
+    (max − min), no float casts; ``scratch`` is an optional pair of
+    preallocated (H, W, 3) uint8 buffers so the steady-state encode loop
+    allocates nothing frame-sized."""
+    h, w = a.shape[:2]
+    (nty, ntx), _ = tile_grid(h, w, tile)
+    if scratch is None:
+        s1 = np.empty_like(a)
+        s2 = np.empty_like(a)
+    else:
+        s1, s2 = scratch
+    np.maximum(a, b, out=s1)
+    np.minimum(a, b, out=s2)
+    np.subtract(s1, s2, out=s1)  # |a - b| without leaving uint8
+    out = np.zeros((nty, ntx), np.uint8)
+    ha, wa = (h // tile) * tile, (w // tile) * tile
+    if ha and wa:  # aligned interior: one vectorized reshape-reduce
+        # (tile·3) folded into one axis: same reduction, one fewer numpy
+        # reduce axis — measurably faster at 1080p.
+        out[: h // tile, : w // tile] = (
+            s1[:ha, :wa].reshape(h // tile, tile, w // tile, tile * 3)
+            .max(axis=(1, 3)))
+    if wa < w:  # right edge strip
+        out[: h // tile, -1] = np.maximum(
+            out[: h // tile, -1],
+            s1[:ha, wa:].reshape(h // tile, tile, -1).max(axis=(1, 2)))
+    if ha < h:  # bottom edge strip (includes the corner tile)
+        rows = s1[ha:]
+        for j in range(ntx):
+            out[-1, j] = rows[:, j * tile: (j + 1) * tile].max(initial=0)
+    return out
+
+
+def host_tile_changed(a: np.ndarray, b: np.ndarray, tile: int,
+                      scratch: Optional[tuple] = None) -> np.ndarray:
+    """Per-tile CHANGED bitmap (bool) for the ``delta_threshold=0`` case:
+    pure equality, so the bytes can be compared eight at a time as
+    uint64 words — 2× the max-abs-diff reduction, and the common
+    (lossless) path pays it every frame. Falls back to the magnitude
+    reduction when the geometry doesn't word-align; ``scratch`` (the
+    encoder's preallocated frame-sized pair) keeps that fallback — e.g.
+    1080p at tile 32, where H doesn't tile — off the allocator on the
+    per-frame hot path."""
+    h, w = a.shape[:2]
+    if (h % tile == 0 and w % tile == 0 and (tile * 3) % 8 == 0
+            and a.flags["C_CONTIGUOUS"] and b.flags["C_CONTIGUOUS"]):
+        nty, ntx, k = h // tile, w // tile, tile * 3 // 8
+        av = a.reshape(h, w * 3).view(np.uint64).reshape(nty, tile, ntx, k)
+        bv = b.reshape(h, w * 3).view(np.uint64).reshape(nty, tile, ntx, k)
+        return (av != bv).any(axis=(1, 3))
+    return host_tile_maxdiff(a, b, tile, scratch=scratch) > 0
+
+
+class DeltaCodec:
+    """Temporal-delta wire over an inner full-frame codec.
+
+    Frame format (little-endian header, see ``_DELTA_HEADER``)::
+
+        magic "\\xd6W" | ver | flags | seq | h | w | tile
+        keyframe (flags & KEY):   inner-codec payload (full frame)
+        delta frame:              packed tile bitmap, then dirty tiles in
+                                  bitmap (row-major) order — raw pixel
+                                  bytes when LOSSLESS, else u32-length-
+                                  prefixed inner-codec payloads per tile
+
+    Closed-loop reference semantics: the encoder's reference is the last
+    state it SHIPPED per tile — the keyframe's input pixels, then each
+    dirty tile's input pixels as it is sent — so sub-threshold drift can
+    never accumulate (a tile is re-sent the moment its pixels diverge
+    more than ``delta_threshold`` from what the decoder composites).
+    Equivalence guarantees, in decreasing strength:
+
+    - keyframes are always bit-identical to the full-frame wire (same
+      inner payload);
+    - ``delta_threshold=0`` with a raw inner wire is bit-identical to
+      the full-frame raw wire for ARBITRARY motion (lossless tiles);
+    - ``delta_threshold=0`` over JPEG: every delivered tile is either
+      bit-identical to the most recent keyframe's full-frame-JPEG
+      delivery (tile unchanged since it) or bit-identical to the SOURCE
+      pixels (tile re-sent losslessly — strictly closer to the truth
+      than the JPEG wire); on a static stream this collapses to
+      bit-identity with the full-frame JPEG wire.
+
+    Keyframe cadence: every ``keyframe_interval`` frames, plus forced
+    keyframes on scene cut (dirty ratio ≥ ``scene_cut_ratio`` — cheaper
+    AND resets any drift), geometry change, and :meth:`force_keyframe`
+    (decoder resync request / ring eviction). ``full_frames=True`` (the
+    fault-budget degradation target) forces EVERY frame to be a keyframe
+    — the wire stays framed and decodable by the same peer while the
+    codec does exactly the full-frame JPEG work.
+
+    Encoder and decoder state are independent, so one instance can serve
+    both directions of a bridge. ``encode_batch_async`` preserves the
+    inter-frame encode order on a dedicated single worker (delta frames
+    are cheap by construction; the inner pool still parallelizes nothing
+    it shouldn't).
+    """
+
+    def __init__(self, inner=None, tile: int = 32,
+                 keyframe_interval: int = 16,
+                 delta_threshold: int = 0,
+                 lossless_tiles: Optional[bool] = None,
+                 scene_cut_ratio: float = 0.5,
+                 on_gap: str = "raise",
+                 quality: int = 90, threads: int = 4):
+        if tile < 8:
+            raise ValueError("tile must be >= 8")
+        if keyframe_interval < 1:
+            raise ValueError("keyframe_interval must be >= 1")
+        if on_gap not in ("raise", "composite"):
+            raise ValueError("on_gap must be 'raise' or 'composite'")
+        self.inner = inner if inner is not None else make_codec(
+            quality=quality, threads=threads)
+        self.tile = int(tile)
+        self.keyframe_interval = int(keyframe_interval)
+        self.delta_threshold = int(delta_threshold)
+        self.lossless = (delta_threshold == 0 if lossless_tiles is None
+                         else bool(lossless_tiles))
+        self.scene_cut_ratio = float(scene_cut_ratio)
+        self.on_gap = on_gap
+        self.full_frames = False  # degradation target: every frame a key
+        # Ordered async encode: delta encoding is stateful (each frame's
+        # reference is the previous shipped state), so batches must run
+        # in submission order — one dedicated worker, not the inner pool.
+        self._seq_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dvf-jpeg-delta")
+        self._async_pending: list = []  # unresolved per-row futures
+        self._enc_lock = threading.Lock()
+        self._dec_lock = threading.Lock()
+        # encoder state (geometry-pinned at first encode)
+        self._enc_ref: Optional[np.ndarray] = None
+        self._enc_scratch: Optional[tuple] = None
+        self._enc_seq = 0
+        self._since_key = 0
+        self._force_key = True
+        # decoder state
+        self._dec_ref: Optional[np.ndarray] = None
+        self._dec_seq: Optional[int] = None
+        self._dec_valid = False
+        # counters (stats())
+        self.frames = 0
+        self.keyframes = 0
+        self.forced_keyframes = 0
+        self.scene_cuts = 0
+        self.dirty_tiles = 0
+        self.total_tiles = 0
+        self.payload_bytes = 0
+        self.decode_frames = 0
+        self.resyncs = 0
+
+    # -- encoder --------------------------------------------------------
+
+    def force_keyframe(self) -> None:
+        """Make the next encode a keyframe — the decoder's resync
+        request (in-process pairs), and the ring transport's recovery
+        after drop-oldest evicted frames the decoder never saw."""
+        with self._enc_lock:
+            self._force_key = True
+            self.forced_keyframes += 1
+
+    def _tiles(self, h: int, w: int):
+        (nty, ntx), nbytes = tile_grid(h, w, self.tile)
+        return nty, ntx, nbytes
+
+    def _encode_keyframe(self, frame: np.ndarray, h: int, w: int) -> bytes:
+        payload = (self.inner.encode(frame) if self._inner_is_jpeg()
+                   else frame.tobytes())
+        header = _DELTA_HEADER.pack(
+            DELTA_MAGIC, DELTA_VERSION,
+            _DELTA_FLAG_KEY | (_DELTA_FLAG_LOSSLESS if self.lossless else 0),
+            self._enc_seq & 0xFFFFFFFF, h, w, self.tile)
+        if self._enc_ref is None or self._enc_ref.shape != frame.shape:
+            self._enc_ref = np.empty_like(frame)
+            self._enc_scratch = (np.empty_like(frame), np.empty_like(frame))
+        np.copyto(self._enc_ref, frame)
+        self._since_key = 0
+        self._force_key = False
+        self.keyframes += 1
+        return header + payload
+
+    def _inner_is_jpeg(self) -> bool:
+        return hasattr(self.inner, "encode_batch_async") and not isinstance(
+            self.inner, RawCodec)
+
+    def encode(self, frame: np.ndarray,
+               bitmap: Optional[np.ndarray] = None) -> bytes:
+        """One frame → one framed wire payload. ``bitmap`` is an optional
+        device-computed (n_tiles_y, n_tiles_x) max-abs-diff reduction
+        (runtime.codec_assist.DeviceDeltaProbe) — when given, the host
+        skips its own change-detection pass entirely."""
+        frame = np.ascontiguousarray(frame, dtype=np.uint8)
+        if frame.ndim != 3 or frame.shape[2] != 3:
+            raise ValueError(f"delta wire carries (H, W, 3) uint8 frames, "
+                             f"got {frame.shape}")
+        h, w = frame.shape[:2]
+        with self._enc_lock:
+            self.frames += 1
+            geometry_changed = (self._enc_ref is None
+                                or self._enc_ref.shape != frame.shape)
+            if (self.full_frames or self._force_key or geometry_changed
+                    or self._since_key >= self.keyframe_interval):
+                blob = self._encode_keyframe(frame, h, w)
+                self._enc_seq += 1
+                self.payload_bytes += len(blob)
+                return blob
+            nty, ntx, nbytes = self._tiles(h, w)
+            if bitmap is not None:
+                diff = np.asarray(bitmap, dtype=np.uint8)
+                if diff.shape != (nty, ntx):
+                    raise ValueError(
+                        f"bitmap is {diff.shape}, geometry wants "
+                        f"({nty}, {ntx}) at tile {self.tile}")
+                dirty = diff > self.delta_threshold
+            elif self.delta_threshold == 0:
+                dirty = host_tile_changed(frame, self._enc_ref, self.tile,
+                                          scratch=self._enc_scratch)
+            else:
+                diff = host_tile_maxdiff(frame, self._enc_ref, self.tile,
+                                         scratch=self._enc_scratch)
+                dirty = diff > self.delta_threshold
+            n_dirty = int(dirty.sum())
+            if n_dirty >= self.scene_cut_ratio * nty * ntx:
+                # Scene cut: a full re-encode is cheaper than shipping
+                # most tiles individually, and it resets any drift.
+                # Counted as a keyframe, NOT in the dirty ratio — the
+                # ratio describes DELTA frames only, so a full-motion
+                # stream (every frame a scene cut) must not read as
+                # dirty_ratio ≈ 0 when its true per-frame change is ≈ 1
+                # (the keyframes/scene_cuts counters carry that story).
+                self.scene_cuts += 1
+                blob = self._encode_keyframe(frame, h, w)
+                self._enc_seq += 1
+                self.payload_bytes += len(blob)
+                return blob
+            self.total_tiles += nty * ntx
+            self.dirty_tiles += n_dirty
+            parts = [
+                _DELTA_HEADER.pack(
+                    DELTA_MAGIC, DELTA_VERSION,
+                    _DELTA_FLAG_LOSSLESS if self.lossless else 0,
+                    self._enc_seq & 0xFFFFFFFF, h, w, self.tile),
+                np.packbits(dirty).tobytes(),
+            ]
+            t = self.tile
+            if self.lossless and h % t == 0 and w % t == 0:
+                # Aligned lossless fast path: gather every dirty tile in
+                # ONE fancy-index over a strided (nty, ntx, t, t, 3)
+                # view, and scatter the same selection into the encoder
+                # reference — 20-30× the per-tile python loop (closed
+                # loop: the reference tracks what was SHIPPED).
+                fview = frame.reshape(nty, t, ntx, t, 3).swapaxes(1, 2)
+                rview = self._enc_ref.reshape(
+                    nty, t, ntx, t, 3).swapaxes(1, 2)
+                tiles = fview[dirty]
+                parts.append(tiles.tobytes())
+                rview[dirty] = tiles
+            else:
+                for i, j in zip(*np.nonzero(dirty)):
+                    tile_px = frame[i * t: (i + 1) * t, j * t: (j + 1) * t]
+                    if self.lossless:
+                        parts.append(tile_px.tobytes())
+                    else:
+                        enc = self.inner.encode(np.ascontiguousarray(tile_px))
+                        parts.append(struct.pack("<I", len(enc)))
+                        parts.append(enc)
+                    # Closed loop: the reference tracks what was SHIPPED.
+                    self._enc_ref[i * t: (i + 1) * t,
+                                  j * t: (j + 1) * t] = tile_px
+            self._since_key += 1
+            self._enc_seq += 1
+            blob = b"".join(parts)
+            self.payload_bytes += len(blob)
+            return blob
+
+    # -- decoder --------------------------------------------------------
+
+    def probe(self, data: bytes):
+        """(height, width) — from the delta header, or the inner codec's
+        probe for an unframed (plain full-frame) payload."""
+        if data[:2] == DELTA_MAGIC and len(data) >= _DELTA_HEADER.size:
+            _m, _v, _f, _s, h, w, _t = _DELTA_HEADER.unpack_from(data)
+            return h, w
+        return self.inner.probe(data)
+
+    def _inner_decode_into(self, payload: bytes, out: np.ndarray) -> None:
+        if self._inner_is_jpeg():
+            if hasattr(self.inner, "decode_into"):
+                self.inner.decode_into(payload, out)
+            else:
+                decoded = self.inner.decode(payload)
+                if decoded.shape != out.shape:
+                    raise JpegGeometryError(
+                        f"payload is {decoded.shape[0]}x{decoded.shape[1]}, "
+                        f"staging row is {out.shape[0]}x{out.shape[1]}")
+                out[:] = decoded
+        else:
+            expect = out.shape[0] * out.shape[1] * 3
+            if len(payload) != expect:
+                raise DeltaWireError(
+                    f"raw keyframe payload is {len(payload)} B, geometry "
+                    f"wants {expect}")
+            out[:] = np.frombuffer(payload, np.uint8).reshape(out.shape)
+
+    def decode_into(self, data: bytes, out: np.ndarray) -> None:
+        """Decode one wire payload into ``out`` (H, W, 3) uint8 —
+        keyframes through the inner codec, delta frames composited onto
+        the cached previous frame. Plain (unframed) JPEG payloads fall
+        through to the inner decoder, so a peer that degraded to
+        full-frame mode — or never spoke delta — stays decodable."""
+        if data[:2] != DELTA_MAGIC:
+            self._inner_decode_into(data, out)
+            with self._dec_lock:
+                # An unframed full frame is a complete state: adopt it
+                # (a delta peer that degraded mid-stream keeps working),
+                # but it carries no seq — treat like a keyframe.
+                self._adopt_ref(out)
+                self._dec_seq = None
+            return
+        if len(data) < _DELTA_HEADER.size:
+            raise DeltaWireError(f"delta frame shorter than its header "
+                                 f"({len(data)} B)")
+        magic, ver, flags, seq, h, w, tile = _DELTA_HEADER.unpack_from(data)
+        if ver != DELTA_VERSION:
+            raise DeltaWireError(f"unknown delta wire version {ver}")
+        if (h, w) != out.shape[:2]:
+            raise JpegGeometryError(
+                f"delta frame is {h}x{w}, staging row is "
+                f"{out.shape[0]}x{out.shape[1]}")
+        body = memoryview(data)[_DELTA_HEADER.size:]
+        with self._dec_lock:
+            self.decode_frames += 1
+            if flags & _DELTA_FLAG_KEY:
+                self._inner_decode_into(bytes(body), out)
+                self._adopt_ref(out)
+                self._dec_seq = seq
+                return
+            if tile != self.tile:
+                raise DeltaWireError(
+                    f"delta frame tile {tile} != codec tile {self.tile}")
+            have_ref = (self._dec_valid and self._dec_ref is not None
+                        and self._dec_ref.shape == out.shape)
+            contiguous = (have_ref and self._dec_seq is not None
+                          and seq == self._dec_seq + 1)
+            if not contiguous:
+                if self.on_gap == "raise":
+                    self._dec_valid = False
+                    raise DeltaResyncError(
+                        f"delta frame seq {seq} without reference "
+                        f"(last decoded: {self._dec_seq}) — keyframe needed")
+                # Tolerant mode (ring transport): compositing absolute
+                # tiles onto the stale reference keeps the stream moving
+                # with bounded staleness; the encode side already forced
+                # a keyframe when it observed the eviction. With no
+                # reference at all (the keyframe itself was evicted),
+                # composite onto zeros — visibly wrong for at most one
+                # keyframe interval, which is the drop-oldest contract
+                # (freshness over completeness), not a stream death.
+                if not have_ref:
+                    if (self._dec_ref is None
+                            or self._dec_ref.shape != out.shape):
+                        self._dec_ref = np.zeros_like(out)
+                    else:
+                        self._dec_ref.fill(0)
+                    self._dec_valid = True
+                self.resyncs += 1
+            # The header says how this frame's tiles are encoded — the
+            # wire is self-describing so a lossless-tiles encoder pairs
+            # with any decoder configuration (the decoder's own
+            # `lossless` only governs what IT would encode).
+            self._composite(body, out, h, w,
+                            lossless=bool(flags & _DELTA_FLAG_LOSSLESS))
+            self._dec_seq = seq
+
+    def _adopt_ref(self, out: np.ndarray) -> None:
+        if self._dec_ref is None or self._dec_ref.shape != out.shape:
+            self._dec_ref = np.empty_like(out)
+        np.copyto(self._dec_ref, out)
+        self._dec_valid = True
+
+    def _composite(self, body: memoryview, out: np.ndarray,
+                   h: int, w: int, lossless: bool) -> None:
+        nty, ntx, nbytes = self._tiles(h, w)
+        if len(body) < nbytes:
+            raise DeltaWireError(
+                f"delta frame bitmap truncated ({len(body)} < {nbytes} B)")
+        bits = np.unpackbits(
+            np.frombuffer(body[:nbytes], np.uint8))[: nty * ntx]
+        dirty = bits.reshape(nty, ntx).astype(bool)
+        off = nbytes
+        t = self.tile
+        ref = self._dec_ref
+        if lossless and h % t == 0 and w % t == 0:
+            # Aligned lossless fast path: one fancy-index scatter of the
+            # contiguous tile block (mirror of the encoder's gather).
+            n_dirty = int(dirty.sum())
+            need = n_dirty * t * t * 3
+            if off + need != len(body):
+                raise DeltaWireError(
+                    f"delta frame carries {len(body) - off} tile bytes, "
+                    f"bitmap wants {need}")
+            if n_dirty:
+                ref.reshape(nty, t, ntx, t, 3).swapaxes(1, 2)[dirty] = (
+                    np.frombuffer(body[off:], np.uint8)
+                    .reshape(n_dirty, t, t, 3))
+            np.copyto(out, ref)
+            return
+        for i, j in zip(*np.nonzero(dirty)):
+            y0, x0 = i * t, j * t
+            th, tw = min(t, h - y0), min(t, w - x0)
+            if lossless:
+                n = th * tw * 3
+                if off + n > len(body):
+                    raise DeltaWireError(
+                        f"delta tile ({i},{j}) truncated at byte {off}")
+                ref[y0: y0 + th, x0: x0 + tw] = np.frombuffer(
+                    body[off: off + n], np.uint8).reshape(th, tw, 3)
+                off += n
+            else:
+                if off + 4 > len(body):
+                    raise DeltaWireError(
+                        f"delta tile ({i},{j}) length prefix truncated")
+                (n,) = struct.unpack_from("<I", body, off)
+                off += 4
+                if off + n > len(body):
+                    raise DeltaWireError(
+                        f"delta tile ({i},{j}) payload truncated "
+                        f"({len(body) - off} < {n} B)")
+                tile_out = np.empty((th, tw, 3), np.uint8)
+                self._inner_decode_into(bytes(body[off: off + n]), tile_out)
+                ref[y0: y0 + th, x0: x0 + tw] = tile_out
+                off += n
+        if off != len(body):
+            raise DeltaWireError(
+                f"delta frame has {len(body) - off} trailing bytes")
+        np.copyto(out, ref)
+
+    def decode(self, data: bytes) -> np.ndarray:
+        h, w = self.probe(data)
+        out = np.empty((h, w, 3), np.uint8)
+        self.decode_into(data, out)
+        return out
+
+    @staticmethod
+    def seek_keyframe(blobs: Sequence[bytes]) -> Optional[int]:
+        """Index of the first payload a reference-less decoder can start
+        from — a framed keyframe or a plain (unframed) full-frame JPEG —
+        or None. The ZMQ worker's resync recovery: after a wire fault
+        poisons a batch's delta prefix, drop exactly up to the next
+        keyframe instead of the whole batch (and instead of cascading
+        gap errors across every following batch until a keyframe happens
+        to land first)."""
+        for k, b in enumerate(blobs):
+            if b[:2] == DELTA_MAGIC:
+                if (len(b) >= _DELTA_HEADER.size
+                        and _DELTA_HEADER.unpack_from(b)[2]
+                        & _DELTA_FLAG_KEY):
+                    return k
+            elif b[:2] == b"\xff\xd8":  # plain JPEG: a complete state
+                return k
+        return None
+
+    # -- batched (order-preserving) -------------------------------------
+
+    def encode_batch(self, frames: Sequence[np.ndarray],
+                     bitmaps: Optional[Sequence[np.ndarray]] = None
+                     ) -> List[bytes]:
+        return [self.encode(f, None if bitmaps is None else bitmaps[i])
+                for i, f in enumerate(frames)]
+
+    def encode_batch_async(self, frames: Sequence[np.ndarray],
+                           bitmaps: Optional[Sequence[np.ndarray]] = None
+                           ) -> list:
+        """Per-frame futures in frame order (the AsyncCodecPlane entry
+        point), resolved by ONE ordered worker: delta encoding is
+        stateful, so two batches must never interleave — the plane's
+        submission order IS the wire order."""
+        from concurrent.futures import Future
+
+        futs = [Future() for _ in frames]
+        rows = list(frames)
+
+        def work():
+            for i, f in enumerate(rows):
+                fut = futs[i]
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(self.encode(
+                        f, None if bitmaps is None else bitmaps[i]))
+                except BaseException as e:  # noqa: BLE001 — per-row error
+                    fut.set_exception(e)
+
+        self._async_pending = [f for f in self._async_pending
+                               if not f.done()] + futs
+        self._seq_pool.submit(work)
+        return futs
+
+    def decode_batch(self, blobs: Sequence[bytes],
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+        if out is None:
+            h, w = self.probe(blobs[0])
+            out = np.empty((len(blobs), h, w, 3), np.uint8)
+        for i, b in enumerate(blobs):
+            try:
+                self.decode_into(b, out[i])
+            except DeltaWireError as e:
+                # Which row failed matters to the transport's recovery
+                # (drop exactly through the fault to the next keyframe,
+                # not from the batch head) — decode_into can't know it.
+                e.row = i
+                raise
+        return out
+
+    # -- provenance / lifecycle -----------------------------------------
+
+    def config(self) -> dict:
+        cfg = dict(self.inner.config())
+        cfg.update(
+            wire="delta" if not self.full_frames else "delta(full-frame)",
+            tile=self.tile,
+            keyframe_interval=self.keyframe_interval,
+            delta_threshold=self.delta_threshold,
+            lossless_tiles=self.lossless,
+            scene_cut_ratio=self.scene_cut_ratio,
+        )
+        return cfg
+
+    def stats(self) -> dict:
+        """Wire-side accounting: the dirty ratio is the fraction of tiles
+        actually re-encoded across delta frames (keyframes excluded) —
+        the number LATENCY.md's delta reading guide starts from."""
+        return {
+            "frames": self.frames,
+            "keyframes": self.keyframes,
+            "forced_keyframes": self.forced_keyframes,
+            "scene_cuts": self.scene_cuts,
+            "dirty_ratio": (round(self.dirty_tiles / self.total_tiles, 4)
+                            if self.total_tiles else None),
+            "payload_bytes": self.payload_bytes,
+            "decode_frames": self.decode_frames,
+            "resyncs": self.resyncs,
+            "full_frames": self.full_frames,
+        }
+
+    def close(self) -> None:
+        self._seq_pool.shutdown(wait=True, cancel_futures=True)
+        # cancel_futures can stop a queued ordered-worker task from ever
+        # running; resolve its per-row futures so a draining codec plane
+        # blocked on them unwinds instead of hanging forever.
+        for f in self._async_pending:
+            if not f.done():
+                try:
+                    f.set_exception(RuntimeError("delta codec closed"))
+                except Exception:  # noqa: BLE001 — racing completion
+                    pass
+        self._async_pending = []
+        self.inner.close()
+
+
+class RawCodec:
+    """Raw full-frame 'codec' — the no-op inner for a delta wire whose
+    keyframes should carry raw bytes (the shm/raw wire's delta mode).
+    Geometry is pinned at construction: raw payloads carry no header."""
+
+    def __init__(self, height: int, width: int):
+        self.shape = (int(height), int(width), 3)
+
+    def encode(self, frame_rgb: np.ndarray) -> bytes:
+        return np.ascontiguousarray(frame_rgb, dtype=np.uint8).tobytes()
+
+    def decode(self, data: bytes) -> np.ndarray:
+        return np.frombuffer(data, np.uint8).reshape(self.shape).copy()
+
+    def probe(self, data: bytes):
+        return self.shape[0], self.shape[1]
+
+    def decode_into(self, data: bytes, out: np.ndarray) -> None:
+        expect = out.shape[0] * out.shape[1] * 3
+        if len(data) != expect:
+            raise DeltaWireError(
+                f"raw payload is {len(data)} B, staging row wants {expect}")
+        out[:] = np.frombuffer(data, np.uint8).reshape(out.shape)
+
+    def config(self) -> dict:
+        return {"backend": "raw", "wire": "raw", "quality": None,
+                "threads": 0}
+
+    def close(self) -> None:
+        pass
+
+
+def make_wire_codec(wire: str, quality: int = 90, threads: int = 4,
+                    raw_shape=None, **delta_kw):
+    """One constructor for every wire mode: ``"jpeg"`` → the plain
+    full-frame codec, ``"delta"`` → :class:`DeltaCodec` over it,
+    ``"raw"`` → :class:`RawCodec` (needs ``raw_shape``)."""
+    if wire == "jpeg":
+        return make_codec(quality=quality, threads=threads)
+    if wire == "delta":
+        return DeltaCodec(make_codec(quality=quality, threads=threads),
+                          **delta_kw)
+    if wire == "raw":
+        if raw_shape is None:
+            raise ValueError("raw wire codec needs raw_shape=(H, W, ...)")
+        return RawCodec(raw_shape[0], raw_shape[1])
+    raise ValueError(f"wire must be 'raw', 'jpeg', or 'delta', got {wire!r}")

@@ -168,4 +168,85 @@ long dvf_jpeg_encode(const unsigned char* rgb, int h, int w, int quality,
   return written;
 }
 
+// Codec-assist entry: encode from PRE-CONVERTED YCbCr 4:2:0 planes
+// (device-side RGB->YCbCr + 2x2 chroma subsample, runtime/codec_assist.py)
+// via jpeg_write_raw_data — the host skips libjpeg's color-convert and
+// downsample passes and runs DCT + quantization + entropy coding only,
+// starting from half the bytes of the RGB path. y is h*w, cb/cr are
+// (h/2)*(w/2); h and w must be even (the device stage pads). Bottom
+// partial iMCU rows are fed by replicating the last row pointer, which
+// matches libjpeg's own edge replication. Returns bytes written (>0),
+// -needed if out_cap was too small, 0 on error, -1 on odd dims.
+long dvf_jpeg_encode_ycbcr420(const unsigned char* y,
+                              const unsigned char* cb,
+                              const unsigned char* cr, int h, int w,
+                              int quality, unsigned char* out,
+                              unsigned long out_cap) {
+  if (h % 2 || w % 2 || h <= 0 || w <= 0) return -1;
+  jpeg_compress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  unsigned char* buf = out;
+  unsigned long sz = out_cap;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    return 0;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &buf, &sz);
+  cinfo.image_width = static_cast<JDIMENSION>(w);
+  cinfo.image_height = static_cast<JDIMENSION>(h);
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_YCbCr;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  cinfo.raw_data_in = TRUE;
+  // 4:2:0 — the same sampling jpeg_set_defaults picks for the RGB path,
+  // so the output decodes identically shaped on any peer.
+  cinfo.comp_info[0].h_samp_factor = 2;
+  cinfo.comp_info[0].v_samp_factor = 2;
+  cinfo.comp_info[1].h_samp_factor = 1;
+  cinfo.comp_info[1].v_samp_factor = 1;
+  cinfo.comp_info[2].h_samp_factor = 1;
+  cinfo.comp_info[2].v_samp_factor = 1;
+  jpeg_start_compress(&cinfo, TRUE);
+  const int cw = w / 2, ch = h / 2;
+  JSAMPROW y_rows[2 * DCTSIZE];
+  JSAMPROW cb_rows[DCTSIZE];
+  JSAMPROW cr_rows[DCTSIZE];
+  JSAMPARRAY planes[3] = {y_rows, cb_rows, cr_rows};
+  while (cinfo.next_scanline < cinfo.image_height) {
+    const int base = static_cast<int>(cinfo.next_scanline);
+    for (int r = 0; r < 2 * DCTSIZE; ++r) {
+      const int yr = base + r < h ? base + r : h - 1;
+      y_rows[r] = const_cast<unsigned char*>(y) +
+                  static_cast<size_t>(yr) * w;
+    }
+    for (int r = 0; r < DCTSIZE; ++r) {
+      const int crow = base / 2 + r < ch ? base / 2 + r : ch - 1;
+      cb_rows[r] = const_cast<unsigned char*>(cb) +
+                   static_cast<size_t>(crow) * cw;
+      cr_rows[r] = const_cast<unsigned char*>(cr) +
+                   static_cast<size_t>(crow) * cw;
+    }
+    jpeg_write_raw_data(&cinfo, planes, 2 * DCTSIZE);
+  }
+  jpeg_finish_compress(&cinfo);
+  unsigned char* fin = buf;
+  unsigned long fsz = sz;
+  long written;
+  if (fin == out) {
+    written = static_cast<long>(fsz);
+  } else if (fsz <= out_cap) {
+    memcpy(out, fin, fsz);
+    free(fin);
+    written = static_cast<long>(fsz);
+  } else {
+    free(fin);
+    written = -static_cast<long>(fsz);
+  }
+  jpeg_destroy_compress(&cinfo);
+  return written;
+}
+
 }  // extern "C"

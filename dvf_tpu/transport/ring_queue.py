@@ -8,16 +8,30 @@ SPSC ring (ring.cpp) on it, drop-in compatible with the Python
 ``DropOldestQueue`` surface the :class:`~dvf_tpu.runtime.pipeline.Pipeline`
 uses (``put`` / ``pop_up_to`` / ``__len__`` / ``dropped`` / ``put_total``).
 
-Two wire formats, mirroring the reference's ``use_jpeg`` switch
-(webcam_app.py:109-113):
+Three wire formats — the reference's ``use_jpeg`` switch
+(webcam_app.py:109-113) plus the temporal-delta wire:
 
 - **raw** — ``frame.tobytes()``; zero codec cost, ring capacity sized in
   whole frames.
 - **jpeg** — encoded on ``put`` (the capture side, like webcam_app.py:110)
-  through :class:`~dvf_tpu.transport.codec.JpegCodec`, decoded on the
-  assembler side by ``decode_batch(out=staging)`` straight into the
-  dispatch staging buffer that feeds ``device_put`` — no intermediate
-  stack/copy.
+  through the full-frame codec, decoded on the assembler side by
+  ``decode_batch(out=staging)`` straight into the dispatch staging buffer
+  that feeds ``device_put`` — no intermediate stack/copy.
+- **delta** — :class:`~dvf_tpu.transport.codec.DeltaCodec` over the JPEG
+  codec: ``put`` encodes only the tiles that changed since the last
+  shipped state (keyframe every N / scene cut), the assembler side
+  composites onto its cached previous frame. For low-motion streams this
+  removes almost the entire host codec cycle from the hot path — the
+  same-codec head-to-head attack (ROADMAP open item 3).
+
+Delta resync under drop-oldest: evicting ring records loses delta frames
+the decoder never saw. The PRODUCER observes every eviction (``push``
+returns the count) and forces the next encode to be a keyframe; the
+consumer side runs the decoder in tolerant (``on_gap="composite"``) mode
+— absolute tiles composite onto the stale reference with bounded
+staleness (counted in ``resyncs``) until that keyframe lands, preserving
+drop-oldest's freshness-over-completeness contract instead of killing
+the stream.
 
 When to use which (measured, 1080p invert e2e on CPU, inline collect):
 in-process Python queue 139 fps (frames pass as zero-copy views);
@@ -26,8 +40,8 @@ cross-process shm capability and byte-bounded freshness); ring/jpeg
 16 fps (the ~60 ms/frame 1080p encode in the capture thread dominates —
 the codec-throughput wall SURVEY §7 hard part 3 predicts; JPEG pays off
 when the wire is a network, not shm, or at the reference's 512² geometry
-where encode is ~5-10 ms). `dvf_tpu bench --e2e --transport/--wire`
-reproduces these numbers on any backend.
+where encode is ~5-10 ms); ring/delta scales those codec costs by the
+stream's dirty ratio (benchmarks/DELTA_BENCH.json).
 
 Differences from the Python queue, by design:
 
@@ -46,7 +60,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from dvf_tpu.transport.codec import make_codec
+from dvf_tpu.transport.codec import WIRE_MODES, make_wire_codec
 from dvf_tpu.transport.ring import FrameRing
 
 # Native per-record overhead: RecordHeader (24 B) rounded up to 8-byte
@@ -66,26 +80,60 @@ class RingFrameQueue:
         codec_threads: int = 4,
         shm_name: Optional[str] = None,
         create: bool = True,
+        wire: Optional[str] = None,
+        delta_tile: int = 32,
+        delta_keyframe_interval: int = 48,
+        delta_threshold: int = 0,
     ):
+        if wire is None:
+            wire = "jpeg" if jpeg else "raw"
+        if wire not in WIRE_MODES:
+            raise ValueError(f"wire must be one of {WIRE_MODES}, got {wire!r}")
         self.frame_shape = tuple(frame_shape)
         self.frame_dtype = np.dtype(np.uint8)
         self._frame_bytes = int(np.prod(self.frame_shape))
-        self.jpeg = jpeg
+        self.wire = wire
+        self.jpeg = wire != "raw"  # legacy flag: "payloads need a codec"
         # Exposed so serve's wire-budget check budgets against the pool
         # the pipeline actually runs, not the host's total core count.
         self.codec_pool_threads = codec_threads
-        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads) if jpeg else None
+        self.codec = None
+        self._dec_codec = None
+        if wire == "jpeg":
+            self.codec = make_wire_codec("jpeg", quality=jpeg_quality,
+                                         threads=codec_threads)
+            self._dec_codec = self.codec  # stateless: one instance, both ends
+        elif wire == "delta":
+            # Distinct encoder/decoder instances — DeltaCodec keeps
+            # independent state per direction anyway, but producer and
+            # consumer run on different threads and the ring is the
+            # process boundary this queue may one day straddle (shm).
+            def _delta():
+                return make_wire_codec(
+                    "delta", quality=jpeg_quality, threads=codec_threads,
+                    tile=delta_tile,
+                    keyframe_interval=delta_keyframe_interval,
+                    delta_threshold=delta_threshold,
+                    on_gap="composite")
+
+            self.codec = _delta()
+            self._dec_codec = _delta()
         # Sized for capacity_frames RAW frames (a JPEG ring then holds more
         # — the bound is freshness in bytes, the stronger guarantee). The
         # per-record cap leaves 2× slack: JPEG is *larger* than raw for
         # noise-like content (worst case ~1.5×), and an oversized record
-        # must fail loudly at push, never at pop.
+        # must fail loudly at push, never at pop. The delta header +
+        # bitmap add at most a few KB on top of a raw-sized payload.
+        # First eviction re-keys immediately; the cooldown only
+        # rate-limits re-keying under SUSTAINED overload.
+        self._force_cooldown = max(4, delta_keyframe_interval // 2)
+        self._puts_since_forced = self._force_cooldown
         cap = max(1, capacity_frames) * (self._frame_bytes + _RECORD_OVERHEAD)
         self.ring = FrameRing(
             capacity_bytes=cap,
             shm_name=shm_name,
             create=create,
-            max_frame_bytes=2 * self._frame_bytes + _RECORD_OVERHEAD,
+            max_frame_bytes=2 * self._frame_bytes + _RECORD_OVERHEAD + 8192,
         )
 
     # -- producer side (pipeline._ingest) -------------------------------
@@ -100,11 +148,27 @@ class RingFrameQueue:
                 f"source yielded {frame.shape} (pass the source's real "
                 f"geometry when constructing RingFrameQueue)"
             )
-        if self.jpeg:
-            payload = self.codec.encode(frame)
-        else:
+        if self.wire == "raw":
             payload = frame.tobytes() if isinstance(frame, np.ndarray) else frame
+        else:
+            payload = self.codec.encode(frame)
         evicted = self.ring.push(payload, idx, ts)
+        self._puts_since_forced += 1
+        if (evicted > 0 and self.wire == "delta"
+                and self._puts_since_forced >= self._force_cooldown):
+            # Evicted records are delta frames the consumer will never
+            # composite — its reference is now stale. The producer is the
+            # only side that SEES the eviction, so the keyframe request
+            # lives here: the next put re-keys the stream. COOLDOWN: an
+            # unthrottled source under drop-oldest evicts on nearly every
+            # put, and re-keying every time turns sustained overload into
+            # a keyframe storm (keyframes are the big payloads, which
+            # fills the ring faster — a vicious cycle). One forced key
+            # per half keyframe-interval bounds clean-tile staleness at
+            # interval/2 frames (the dirty tiles are absolute and always
+            # current), which is the drop-oldest freshness contract.
+            self.codec.force_keyframe()
+            self._puts_since_forced = 0
         return evicted if evicted > 0 else None
 
     # -- consumer side (pipeline._assemble/_dispatch) --------------------
@@ -117,14 +181,17 @@ class RingFrameQueue:
                     staging: np.ndarray) -> None:
         """Decode popped payloads into rows [0, len(items)) of the dispatch
         staging buffer (the §2b 'decode into staging feeding device_put'
-        path — JPEG batches go through the threaded codec)."""
+        path — JPEG batches go through the threaded codec; delta batches
+        composite sequentially, their per-frame cost scaled by the dirty
+        ratio)."""
         k = len(items)
-        if self.jpeg:
-            self.codec.decode_batch([p for _, p, _ in items], out=staging[:k])
-        else:
+        if self.wire == "raw":
             for row, (_, payload, _) in enumerate(items):
                 staging[row] = np.frombuffer(
                     payload, np.uint8).reshape(self.frame_shape)
+        else:
+            self._dec_codec.decode_batch([p for _, p, _ in items],
+                                         out=staging[:k])
 
     # -- stats / lifecycle ----------------------------------------------
 
@@ -140,6 +207,18 @@ class RingFrameQueue:
             return self._closed_counts[1]
         return self.ring.pushed
 
+    def wire_stats(self) -> dict:
+        """Wire provenance + delta accounting for bench JSON (dirty
+        ratio, keyframes, resyncs — ``DeltaCodec.stats``)."""
+        out = {"wire": self.wire}
+        if self.wire == "delta":
+            out["encode"] = self.codec.stats()
+            out["decode"] = self._dec_codec.stats()
+            out["codec"] = self.codec.config()
+        elif self.codec is not None:
+            out["codec"] = self.codec.config()
+        return out
+
     def __len__(self) -> int:
         return 0 if self._closed_counts is not None else len(self.ring)
 
@@ -154,4 +233,6 @@ class RingFrameQueue:
         self._closed_counts = (self.ring.dropped, self.ring.pushed)
         if self.codec is not None:
             self.codec.close()
+        if self._dec_codec is not None and self._dec_codec is not self.codec:
+            self._dec_codec.close()
         self.ring.close()

@@ -41,7 +41,13 @@ from dvf_tpu.runtime.egress import (
 )
 from dvf_tpu.runtime.engine import Engine
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
-from dvf_tpu.transport.codec import JpegGeometryError, make_codec
+from dvf_tpu.transport.codec import (
+    WIRE_MODES,
+    DeltaCodec,
+    DeltaWireError,
+    JpegGeometryError,
+    make_wire_codec,
+)
 
 # ---------------------------------------------------------------------------
 # Wire framing, shared with the multi-stream serving frontend
@@ -103,6 +109,11 @@ class TpuZmqWorker:
         fault_window_s: float = 30.0,
         chaos=None,
         tracer=None,
+        wire: Optional[str] = None,
+        delta_tile: int = 32,
+        delta_keyframe_interval: int = 16,
+        delta_threshold: int = 0,
+        delta_device: bool = False,
     ):
         import zmq
 
@@ -114,6 +125,12 @@ class TpuZmqWorker:
                              f"got {egress!r}")
         if egress_depth < 1:
             raise ValueError("egress depth must be >= 1")
+        if wire is None:
+            wire = "jpeg" if use_jpeg else "raw"  # legacy flag spelling
+        if wire not in WIRE_MODES:
+            raise ValueError(f"wire must be one of {WIRE_MODES}, "
+                             f"got {wire!r}")
+        use_jpeg = wire != "raw"
 
         if filt.stateful and not filt.pad_safe:
             # Short batches are padded by repeating the last frame; a
@@ -140,7 +157,39 @@ class TpuZmqWorker:
         self.engine = engine or Engine(filt, chaos=chaos)
         if chaos is not None and self.engine.chaos is None:
             self.engine.chaos = chaos
-        self.codec = make_codec(quality=jpeg_quality, threads=codec_threads)
+        self.wire = wire
+        self._wire_degrade_reason: Optional[str] = None
+        if wire == "delta":
+            # Temporal-delta wire, both directions: incoming delta frames
+            # composite onto the cached previous frame (a sequence gap —
+            # the app dropped an encoded frame — raises DeltaResyncError
+            # into run()'s containment: at-most-once, recovered at the
+            # peer's next keyframe); results are delta-encoded on the
+            # egress plane, dirty bitmaps computed on DEVICE when
+            # delta_device is set (runtime.codec_assist.DeviceDeltaProbe).
+            self.codec = make_wire_codec(
+                "delta", quality=jpeg_quality, threads=codec_threads,
+                tile=delta_tile,
+                keyframe_interval=delta_keyframe_interval,
+                delta_threshold=delta_threshold,
+                on_gap="raise")
+        else:
+            self.codec = make_wire_codec("jpeg", quality=jpeg_quality,
+                                         threads=codec_threads)
+        self._probe = None
+        if wire == "delta" and delta_device:
+            from dvf_tpu.runtime.codec_assist import DeviceDeltaProbe
+
+            if delta_threshold > 0:
+                # The device probe diffs consecutive frames, not the
+                # shipped reference — exact at threshold 0, but lossy
+                # thresholds lose the closed-loop drift bound (see
+                # DeviceDeltaProbe docstring).
+                print("[TpuZmqWorker] --delta-device with "
+                      f"delta_threshold={delta_threshold}: sub-threshold "
+                      "drift is bounded by the keyframe cadence only",
+                      file=sys.stderr)
+            self._probe = DeviceDeltaProbe(tile=delta_tile)
         self.ingest = ingest
         self.ingest_depth = ingest_depth
         self.egress = egress
@@ -340,6 +389,70 @@ class TpuZmqWorker:
             builder.commit_window(start, stop)
         return builder.finish(valid)
 
+    def _decode_wire(self, blobs, indices, valid):
+        """Decode one codec-wire batch with DELTA resync recovery.
+
+        Delta WIRE faults (truncated tile payload, sequence gap needing
+        resync) are framing violations, not pixel decode errors: each is
+        classified under the ``transport`` kind, bounded by the error
+        budget (whose first overflow degrades the delta path back to
+        full-frame JPEG via ``_degrade_delta``), and recovered by
+        restarting from the batch's next KEYFRAME after the failing row
+        — a gap can only heal at a keyframe, so retrying the same deltas
+        (or dropping whole batches until a keyframe happens to lead one)
+        would cascade the fault across the stream. The prefix before the
+        fault is dropped with it (at-most-once: its staging was
+        abandoned with the assembler, and its sequence numbers are
+        already consumed so it cannot be replayed). Loops because the
+        recovered suffix can itself contain another fault; every
+        iteration strictly shrinks the batch. Returns
+        ``(batch, resident, indices, valid)`` — batch None when the
+        faults consumed everything (drop, counted, not fatal)."""
+        while True:
+            try:
+                batch, resident = self._decode_jpeg(blobs, valid)
+                return batch, resident, indices, valid
+            except DeltaWireError as de:
+                self.faults.record(FaultKind.TRANSPORT, de)
+                if (escalate(self._budget, FaultKind.TRANSPORT,
+                             self._degrade_delta) == ErrorBudget.FAIL):
+                    raise FaultError(
+                        FaultKind.TRANSPORT,
+                        f"transport fault budget exhausted "
+                        f"(> {self.fault_budget} delta wire faults in "
+                        f"{self.fault_window_s:g}s); last: {de!r}",
+                        fatal=True) from de
+                self.errors += 1
+                # Release the abandoned half-staged assembler eagerly
+                # (same rationale as the geometry re-probe: the failed
+                # attempt may hold in-flight shard transfers against the
+                # slot's slabs).
+                old, self._asm = self._asm, None
+                if old is not None:
+                    old.release()
+                # A gap can only heal at a keyframe AFTER the failing
+                # row: the decoder already consumed the sequence numbers
+                # before it (replaying those deltas would just raise a
+                # regression gap), and re-seeking from the batch head
+                # would misattribute a mid-batch fault to a perfectly
+                # decodable head keyframe. decode_batch annotates the
+                # failing row; without it (defensive), skip at least the
+                # first blob so the loop can never retry the same
+                # failure forever.
+                r = getattr(de, "row", None)
+                search_from = (r + 1) if r is not None else 1
+                nxt = DeltaCodec.seek_keyframe(blobs[search_from:])
+                start = search_from + nxt if nxt is not None else 0
+                if start == 0:
+                    print(f"[TpuZmqWorker] delta wire fault (dropping "
+                          f"batch): {de!r}", file=sys.stderr)
+                    return None, None, indices, 0
+                print(f"[TpuZmqWorker] delta wire fault: dropping {start} "
+                      f"frame(s) to the next keyframe: {de!r}",
+                      file=sys.stderr)
+                indices, blobs, valid = (indices[start:], blobs[start:],
+                                         valid - start)
+
     def _process_batch(self, pending, pid) -> None:
         """Decode → engine → encode → push for one assembled batch.
 
@@ -362,7 +475,10 @@ class TpuZmqWorker:
                 # rule mangles that blob so the codec rejects it.
                 blobs = [self.chaos.corrupt("decode", b) for b in blobs]
             try:
-                batch, resident = self._decode_jpeg(blobs, valid)
+                batch, resident, indices, valid = self._decode_wire(
+                    blobs, indices, valid)
+                if batch is None:
+                    return  # delta wire faults consumed the whole batch
             except JpegGeometryError as ge:
                 # Stream geometry changed (the app restarted with a new
                 # target_size): re-probe, rebuild the assembler, retry
@@ -419,6 +535,20 @@ class TpuZmqWorker:
             time.sleep(self.delay_s)
         result = (self.engine.submit_resident(batch) if resident
                   else self.engine.submit(batch))
+        # Device-side change detection (delta wire): the per-tile
+        # max-abs-diff reduction is queued right behind the filter
+        # program by async dispatch; only the few-hundred-byte bitmap
+        # crosses to the host, and the delta encoder skips its own
+        # frame-sized reduction pass.
+        bitmaps = None
+        if self._probe is not None:
+            try:
+                bitmaps = self._probe.bitmaps(result)
+            except Exception as e:  # noqa: BLE001 — assist is optional:
+                # fall back to the host reduction rather than drop frames
+                print(f"[TpuZmqWorker] device delta probe failed "
+                      f"(host fallback): {e!r}", file=sys.stderr)
+                self._probe = None
         # Streamed egress: issue the per-shard D2H immediately, fetch into
         # the preallocated slab, and hand the rows to the asynchronous
         # codec plane — encode/send of THIS batch overlap the decode/H2D/
@@ -433,7 +563,9 @@ class TpuZmqWorker:
         t1 = time.time()
         plane = self._plane_for()
         plane.submit([out[i] for i in range(valid)],
-                     [(idx, t0, t1) for idx in indices])
+                     [(idx, t0, t1) for idx in indices],
+                     bitmaps=None if bitmaps is None else
+                     [bitmaps[i] for i in range(valid)])
         self.frames_processed += valid
         self.batches += 1
         self._pump_egress(pid, block=len(plane) > plane.depth)
@@ -583,11 +715,36 @@ class TpuZmqWorker:
             self.errors += 1
             self.faults.record(e.kind, e)
 
+    def _degrade_delta(self, kind: str) -> bool:
+        """Delta-WIRE degradation, reachable only from delta wire faults
+        (``_decode_wire``): fall back to full-frame JPEG on the EGRESS
+        side — every frame a keyframe, framed identically, so the peer
+        decodes it unchanged at exactly the full-frame codec cost. The
+        worker holds no lever over what the PEER sends, so ingest-side
+        faults keep being contained per batch inside the fresh budget
+        window this degradation buys; a peer that stays corrupt through
+        a second window still fails hard — the PR 4 ladder semantics
+        (degrade = shrink OUR delta surface, not cure the peer).
+        Deliberately NOT part of ``_degrade``: the generic transport
+        ladder also counts send and encode failures (dead collector),
+        whose overflow must keep FAILING loudly — pessimizing a healthy
+        delta wire would be the wrong remedy and would absorb that
+        overflow silently."""
+        if self.wire == "delta" and not self.codec.full_frames:
+            self.codec.full_frames = True
+            self._wire_degrade_reason = "delta_fault_budget"
+            print("[TpuZmqWorker] repeated delta wire faults: degrading "
+                  "to full-frame JPEG (keyframe-only)",
+                  file=sys.stderr, flush=True)
+            return True
+        return False
+
     def _degrade(self, kind: str) -> bool:
         """First-overflow degradation: repeated h2d faults fall back from
         streamed to monolithic ingest (reason recorded in the ingest
         stats), mirroring the pipeline/serve ladder. Other kinds have no
-        degraded mode here — the budget fails them."""
+        degraded mode here — the budget fails them (delta wire faults
+        degrade through ``_degrade_delta``, not this ladder)."""
         if kind == FaultKind.H2D and self.ingest == "streamed":
             self.ingest = "monolithic"
             self._degrade_reason = "h2d_fault_budget"
@@ -615,6 +772,11 @@ class TpuZmqWorker:
             "frames_processed": self.frames_processed,
             "batches": self.batches,
             "errors": self.errors,
+            "wire": self.wire,
+            **({"delta": {**self.codec.stats(),
+                          "fallback_reason": self._wire_degrade_reason,
+                          "device_probe": self._probe is not None}}
+               if self.wire == "delta" else {}),
             "faults": self.faults.summary(),
             **({"ingest": self._ingest_stats.summary()}
                if self._ingest_stats is not None else {}),

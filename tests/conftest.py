@@ -72,6 +72,10 @@ def pytest_configure(config):
                    "FaultPlans, CPU backend, bounded wall time — run in "
                    "tier-1; select with -m chaos)")
     config.addinivalue_line(
+        "markers", "delta: temporal-delta wire + on-device codec assist "
+                   "tests (CPU backend, seeded streams, bounded wall time "
+                   "— run in tier-1; select with -m delta)")
+    config.addinivalue_line(
         "markers", "fleet: multi-replica serving tier tests (CPU backend, "
                    "bounded timeouts; some spawn replica worker "
                    "subprocesses — run in tier-1, select with -m fleet; "

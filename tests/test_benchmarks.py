@@ -78,7 +78,10 @@ def test_stage_decomposition_fields():
     # provenance for the encode leg (r06: quality/threads/backend must
     # travel with the encode_ms they produced).
     assert set(d) == {"batch_1", "batch_2", "codec"}
-    assert set(d["codec"]) == {"backend", "quality", "threads"}
+    # r08 adds "wire": bench rows must say WHICH wire mode (full-frame
+    # jpeg vs temporal-delta) produced the encode numbers beside them.
+    assert set(d["codec"]) == {"backend", "wire", "quality", "threads"}
+    assert d["codec"]["wire"] == "jpeg"
     assert d["codec"]["threads"] == 1  # per-frame serialized cost
     for b in ("batch_1", "batch_2"):
         legs = d[b]
