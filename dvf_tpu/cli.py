@@ -249,6 +249,23 @@ def _resolve_source(args, allow_shm: bool = True):
     )
 
 
+def _start_exporter(args, registry, health_fn=None, ring=None):
+    """--metrics-port: start the pull-based scrape endpoint (obs.export)
+    over this invocation's registry. Returns the started exporter (None
+    when the flag is absent). Port 0 binds an ephemeral port; the bound
+    port is announced on stderr either way."""
+    port = getattr(args, "metrics_port", None)
+    if port is None:
+        return None
+    from dvf_tpu.obs.export import MetricsExporter
+
+    ex = MetricsExporter(registry, port=port, health_fn=health_fn,
+                         ring=ring).start()
+    print(f"[metrics] /metrics /healthz /timeseries on {ex.url}",
+          file=sys.stderr, flush=True)
+    return ex
+
+
 def _parse_chaos(args):
     """``--chaos`` spec → resilience.chaos.FaultPlan (None when unset)."""
     if not getattr(args, "chaos", None):
@@ -303,8 +320,17 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         stall_timeout_s=(args.stall_timeout if args.stall_timeout is not None
                          else 30.0),
         chaos=_parse_chaos(args),
+        trace=args.trace,
+        flight_dir=args.flight_dir,
+        # The sliding signal window costs a per-second percentile merge;
+        # pay it only when something reads it (scrape endpoint here,
+        # or the burn trigger via flight_dir inside the frontend).
+        telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
     )
     frontend = ServeFrontend(filt, config, engine=engine)
+    exporter = _start_exporter(args, frontend.registry,
+                               health_fn=frontend.health,
+                               ring=frontend.telemetry)
 
     # Spread the streams across ~0.4×..1.6× the base rate: genuinely
     # different per-tenant cadences, so batches interleave sessions
@@ -323,30 +349,34 @@ def _cmd_serve_multi(args, filt, engine) -> int:
             # without copying (StreamSession.submit references them).
             frontend.submit(sid, frame, ts=ts)
 
-    with frontend:
-        sids = [frontend.open_stream(slo_ms=args.slo_ms) for _ in range(n)]
-        drivers = [
-            threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
-            for i, (sid, rate) in enumerate(zip(sids, rates))
-        ]
-        for t in drivers:
-            t.start()
-        while any(t.is_alive() for t in drivers):
+    try:
+        with frontend:
+            sids = [frontend.open_stream(slo_ms=args.slo_ms) for _ in range(n)]
+            drivers = [
+                threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
+                for i, (sid, rate) in enumerate(zip(sids, rates))
+            ]
+            for t in drivers:
+                t.start()
+            while any(t.is_alive() for t in drivers):
+                for sid in sids:
+                    delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
+                time.sleep(0.01)
+            for sid in sids:
+                frontend.close(sid, drain=True)  # graceful: serve the tail
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                for sid in sids:
+                    delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
+                if frontend.open_count() == 0:  # not stats(): the full
+                    break                      # percentile merge is per-report
+                time.sleep(0.01)
             for sid in sids:
                 delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
-            time.sleep(0.01)
-        for sid in sids:
-            frontend.close(sid, drain=True)  # graceful: serve the tail
-        deadline = time.time() + 30.0
-        while time.time() < deadline:
-            for sid in sids:
-                delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
-            if frontend.open_count() == 0:  # not stats(): the full
-                break                      # percentile merge is per-report
-            time.sleep(0.01)
-        for sid in sids:
-            delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
-        stats = frontend.stats()
+            stats = frontend.stats()
+    finally:
+        if exporter is not None:
+            exporter.stop()
 
     out = {
         "sessions": {
@@ -529,6 +559,19 @@ def cmd_serve(args) -> int:
         sink = NullSink()
         pipe = Pipeline(source, filt, sink, config, engine=engine, queue=queue)
 
+    # --metrics-port: scrape endpoint over the pipeline's registry (the
+    # RateLogger gauges + the signals() provider), with a 1 Hz telemetry
+    # ring behind /timeseries.
+    ring = None
+    exporter = None
+    if args.metrics_port is not None:
+        from dvf_tpu.obs.registry import TimeSeriesRing
+
+        ring = TimeSeriesRing(pipe.signals, interval_s=1.0,
+                              name="dvf-pipeline-telemetry").start()
+        exporter = _start_exporter(args, pipe.registry,
+                                   health_fn=pipe.health, ring=ring)
+
     # SIGINT/SIGTERM → graceful stop; repeat → hard abort (the reference
     # installs the same pair, webcam_app.py:46-48 / inverter.py:16-17).
     def _graceful(signum, frame):
@@ -549,6 +592,10 @@ def cmd_serve(args) -> int:
     finally:
         for sig, handler in old.items():
             signal.signal(sig, handler)
+        if exporter is not None:
+            exporter.stop()
+        if ring is not None:
+            ring.stop()
     print(json.dumps({k: v for k, v in stats.items() if not isinstance(v, dict)}, default=float))
     return 0
 
@@ -626,6 +673,7 @@ def cmd_fleet(args) -> int:
         fault_window_s=args.fault_window,
         stall_timeout_s=(args.stall_timeout
                          if args.stall_timeout is not None else 30.0),
+        trace=args.trace,
     )
     config = FleetConfig(
         replicas=args.replicas,
@@ -637,6 +685,8 @@ def cmd_fleet(args) -> int:
         chaos_spec=serve_chaos_spec,
         chaos_seed=args.chaos_seed,
         devices_per_replica=args.devices_per_replica,
+        flight_dir=args.flight_dir,
+        telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
     )
 
     n = args.sessions
@@ -645,6 +695,13 @@ def cmd_fleet(args) -> int:
     polled: dict = {}
 
     fleet = FleetFrontend(config=config)
+    def fleet_health():
+        s = fleet.signals()
+        return dict(s, ok=s["healthy_replicas"] > 0)
+
+    exporter = _start_exporter(args, fleet.registry,
+                               health_fn=fleet_health,
+                               ring=fleet.telemetry)
 
     def drive(sid: str, rate: float, seed: int) -> None:
         src = SyntheticSource(height=args.height, width=args.width,
@@ -657,45 +714,49 @@ def cmd_fleet(args) -> int:
             except Exception:  # noqa: BLE001 — a session orphaned by
                 return         # replica loss just ends its stream
 
-    with fleet:
-        sids = []
-        for _ in range(n):
-            try:
-                sids.append(fleet.open_stream(
-                    slo_ms=args.slo_ms,
-                    frame_shape=(args.height, args.width, 3)))
-            except AdmissionError as e:
-                print(f"error: admission refused: {e}", file=sys.stderr)
-                return 2
-        drivers = [
-            threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
-            for i, (sid, rate) in enumerate(zip(sids, rates))
-        ]
-        for t in drivers:
-            t.start()
-        while any(t.is_alive() for t in drivers):
+    try:
+        with fleet:
+            sids = []
+            for _ in range(n):
+                try:
+                    sids.append(fleet.open_stream(
+                        slo_ms=args.slo_ms,
+                        frame_shape=(args.height, args.width, 3)))
+                except AdmissionError as e:
+                    print(f"error: admission refused: {e}", file=sys.stderr)
+                    return 2
+            drivers = [
+                threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
+                for i, (sid, rate) in enumerate(zip(sids, rates))
+            ]
+            for t in drivers:
+                t.start()
+            while any(t.is_alive() for t in drivers):
+                for sid in sids:
+                    polled[sid] = polled.get(sid, 0) + len(
+                        fleet.poll(sid, meta_only=True))
+                time.sleep(0.01)
             for sid in sids:
-                polled[sid] = polled.get(sid, 0) + len(
-                    fleet.poll(sid, meta_only=True))
-            time.sleep(0.01)
-        for sid in sids:
-            fleet.close(sid, drain=True)  # graceful: the tail serves
-        # Poll the tails until the fleet goes quiescent (no delivery for
-        # a grace window — sheds/drops mean polled < submitted is a
-        # legitimate end state, so "nothing moved" is the signal, with a
-        # first-compile-sized grace).
-        deadline = time.time() + 60.0
-        last_move = time.time()
-        while time.time() < deadline and time.time() - last_move < 3.0:
-            moved = 0
-            for sid in sids:
-                got = len(fleet.poll(sid, meta_only=True))
-                polled[sid] = polled.get(sid, 0) + got
-                moved += got
-            if moved:
-                last_move = time.time()
-            time.sleep(0.01)
-        stats = fleet.stats()
+                fleet.close(sid, drain=True)  # graceful: the tail serves
+            # Poll the tails until the fleet goes quiescent (no delivery for
+            # a grace window — sheds/drops mean polled < submitted is a
+            # legitimate end state, so "nothing moved" is the signal, with a
+            # first-compile-sized grace).
+            deadline = time.time() + 60.0
+            last_move = time.time()
+            while time.time() < deadline and time.time() - last_move < 3.0:
+                moved = 0
+                for sid in sids:
+                    got = len(fleet.poll(sid, meta_only=True))
+                    polled[sid] = polled.get(sid, 0) + got
+                    moved += got
+                if moved:
+                    last_move = time.time()
+                time.sleep(0.01)
+            stats = fleet.stats()
+    finally:
+        if exporter is not None:
+            exporter.stop()
 
     out = {
         "replicas": {
@@ -756,7 +817,20 @@ def cmd_worker(args) -> int:
         fault_budget=args.fault_budget,
         fault_window_s=args.fault_window,
         chaos=_parse_chaos(args),
+        trace=args.trace,
     )
+    # /timeseries is part of every tier's endpoint surface: give the
+    # worker its 1 Hz signal window when the exporter is requested.
+    ring = None
+    if args.metrics_port is not None:
+        from dvf_tpu.obs.registry import TimeSeriesRing
+
+        ring = TimeSeriesRing(worker.signals, interval_s=1.0,
+                              name="dvf-worker-telemetry").start()
+    exporter = _start_exporter(args, worker.registry,
+                               health_fn=lambda: {"ok": True,
+                                                  **worker.signals()},
+                               ring=ring)
     print(
         f"TPU worker serving {filt.name} on "
         f"tcp://{args.host}:{args.distribute_port} → :{args.collect_port}",
@@ -767,6 +841,12 @@ def cmd_worker(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if exporter is not None:
+            exporter.stop()
+        if ring is not None:
+            ring.stop()
+        if worker.tracer.enabled:
+            worker.tracer.export("dvf_worker_timing.pftrace")
         worker.close()
     return 0
 
@@ -1278,6 +1358,16 @@ def main(argv=None) -> int:
                           "stream pipeline; rejected by the worker (its "
                           "batch loop is synchronous — nothing to watch)")
 
+    # Shared by the serving subcommands (serve, fleet, worker): the
+    # telemetry plane's scrape endpoint (obs.export).
+    obsp = argparse.ArgumentParser(add_help=False)
+    obsp.add_argument("--metrics-port", type=int, default=None,
+                      metavar="PORT",
+                      help="serve /metrics (Prometheus text exposition; "
+                           "?format=json for JSON), /healthz, and "
+                           "/timeseries on 127.0.0.1:PORT (0 = ephemeral; "
+                           "the bound port is announced on stderr)")
+
     fp = sub.add_parser("filters", help="list registered filters")
     fp.add_argument("-v", "--verbose", action="store_true",
                     help="include each filter's one-line description")
@@ -1287,8 +1377,13 @@ def main(argv=None) -> int:
     dp_.add_argument("--probe-timeout", type=float, default=60.0,
                      help="seconds before declaring the backend unreachable")
 
-    sp = sub.add_parser("serve", parents=[plat, ing, res],
+    sp = sub.add_parser("serve", parents=[plat, ing, res, obsp],
                         help="run the pipeline")
+    sp.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the SLO flight recorder (--sessions mode): "
+                         "watchdog trips, budget-exhaustion failures, and "
+                         "SLO burn-rate breaches dump a post-mortem "
+                         "(merged trace + stats + telemetry window) here")
     sp.add_argument("--filter", default="invert")
     sp.add_argument("--filter-config", default=None, help="JSON kwargs for the filter")
     sp.add_argument("--source", default="synthetic",
@@ -1371,8 +1466,16 @@ def main(argv=None) -> int:
                          "(0 = max(16, --sessions))")
 
     fl = sub.add_parser(
-        "fleet", parents=[plat, ing, res],
+        "fleet", parents=[plat, ing, res, obsp],
         help="multi-replica serving: N engines behind one front door")
+    fl.add_argument("--trace", action="store_true",
+                    help="arm per-replica tracers (bounded event rings); "
+                         "replica traces merge into one Perfetto session "
+                         "in flight-recorder dumps")
+    fl.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the fleet flight recorder: replica losses "
+                         "and replica-side watchdog trips dump a merged "
+                         "multi-replica trace + fleet stats here")
     fl.add_argument("--replicas", type=int, default=2,
                     help="engine replica count behind the front door")
     fl.add_argument("--mode", choices=("local", "process"), default="process",
@@ -1428,8 +1531,11 @@ def main(argv=None) -> int:
                          "consumer to attach and drain before unlinking "
                          "the shm ring (serve cold-start can take ~10 s)")
 
-    wp = sub.add_parser("worker", parents=[plat, ing, res],
+    wp = sub.add_parser("worker", parents=[plat, ing, res, obsp],
                         help="ZMQ worker for the reference app")
+    wp.add_argument("--trace", action="store_true",
+                    help="arm the worker's tracer (bounded ring; exported "
+                         "to dvf_worker_timing.pftrace at exit)")
     wp.add_argument("--filter", default="invert")
     wp.add_argument("--filter-config", default=None)
     wp.add_argument("--host", default="localhost")

@@ -130,8 +130,19 @@ def main(argv=None) -> int:
                 elif kind == "stats":
                     out = {"stats": frontend.stats(),
                            "latency": frontend.latency_snapshot(),
+                           "signals": frontend.signals(),
                            "health": dict(frontend.health(),
                                           submit_errors=submit_errors)}
+                elif kind == "trace":
+                    # The frontend tracer's bounded event window + epoch
+                    # (plain values): the fleet's cross-process trace
+                    # aggregation rides the same RPC as every other
+                    # export. Capped to the most recent 20k events: the
+                    # reply is pickled while the parent holds the serial
+                    # channel lock, and a full 100k-event ring (tens of
+                    # MB) would stall that replica's submit hot path for
+                    # the whole transfer — mid-incident, when dumps fire.
+                    out = frontend.tracer.snapshot(max_events=20_000)
                 else:
                     raise ValueError(f"unknown replica op {kind!r}")
             except Exception as e:  # noqa: BLE001 — op errors cross the

@@ -166,6 +166,14 @@ class ReplicaHandle:
         "health": health()} — one RPC for the whole export."""
         raise NotImplementedError
 
+    def trace_snapshot(self) -> dict:
+        """The replica frontend's ``Tracer.snapshot()`` — its bounded
+        event window plus wall-clock epoch, the unit the fleet merges
+        into ONE Perfetto session (``obs.trace.merge_tracer_snapshots``).
+        Plain pickle-safe values, so the same export crosses the process
+        RPC unchanged."""
+        raise NotImplementedError
+
 
 class LocalReplica(ReplicaHandle):
     """In-process replica: a ServeFrontend over a device slice."""
@@ -250,7 +258,10 @@ class LocalReplica(ReplicaHandle):
     def stats_full(self) -> dict:
         fe = self._fe()
         return {"stats": fe.stats(), "latency": fe.latency_snapshot(),
-                "health": fe.health()}
+                "signals": fe.signals(), "health": fe.health()}
+
+    def trace_snapshot(self) -> dict:
+        return self._fe().tracer.snapshot()
 
 
 class ProcessReplica(ReplicaHandle):
@@ -490,7 +501,23 @@ class ProcessReplica(ReplicaHandle):
         return self._rpc(("health",), timeout=5.0, lock_timeout=5.0)
 
     def stats_full(self) -> dict:
-        return self._rpc(("stats",))
+        # Bounded on the CHANNEL LOCK only: a stats pull queued behind a
+        # busy submit degrades to TimeoutError — "no export this tick"
+        # at the caller — without touching the socket. The socket keeps
+        # the default rpc_timeout_s deliberately: a mid-flight socket
+        # timeout desynchronizes the serial channel (the late reply
+        # would answer the NEXT request), so it must keep meaning
+        # replica loss — and a scrape must not be able to declare a
+        # merely-slow replica dead.
+        return self._rpc(("stats",), lock_timeout=5.0)
+
+    def trace_snapshot(self) -> dict:
+        # Same bound discipline as stats_full: busy channel → benign
+        # TimeoutError (one skipped lane); socket-level death → loss.
+        # Dump pulls run off the monitor/loss paths (router dumps are
+        # off-thread), so the worst case blocks a dump thread, not
+        # supervision.
+        return self._rpc(("trace",), lock_timeout=5.0)
 
 
 def live_worker_processes() -> List[subprocess.Popen]:

@@ -63,6 +63,9 @@ from dvf_tpu.fleet.stats import (
     merge_latency_snapshots,
     replica_row,
 )
+from dvf_tpu.obs.export import FlightRecorder, attach_fleet_provider
+from dvf_tpu.obs.registry import MetricsRegistry, TimeSeriesRing
+from dvf_tpu.obs.trace import Tracer, merge_tracer_snapshots
 from dvf_tpu.resilience.faults import FaultKind, FaultStats
 from dvf_tpu.serve import ServeConfig
 from dvf_tpu.serve.session import (
@@ -114,6 +117,14 @@ class FleetConfig:
     #   each replica owns a deterministic plan of its own
     chaos_spec: Optional[str] = None
     chaos_seed: int = 0
+    telemetry_sample_s: float = 0.0  # >0: fleet-level TimeSeriesRing of
+    #   RPC-free front-door signals (placements, losses, healthy count)
+    #   behind the /timeseries endpoint; per-replica signal windows live
+    #   in each replica's own ring (serve.telemetry_sample_s)
+    flight_dir: Optional[str] = None  # fleet flight recorder: a replica
+    #   loss or a replica-side watchdog trip (stalls delta in health())
+    #   dumps merged per-replica traces + fleet stats here. None = off.
+    flight_min_interval_s: float = 10.0
 
 
 class _FleetSession:
@@ -189,6 +200,40 @@ class FleetFrontend:
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._started = False
+        # -- telemetry plane: front-door tracer (lifecycle instants — the
+        # replica lanes come from the replicas' own tracers via
+        # trace_snapshots), metrics registry, signal window, flight
+        # recorder, and the per-replica stall watermark the monitor uses
+        # to turn a replica-side watchdog trip into a fleet-level dump.
+        self.tracer = Tracer(enabled=self.config.serve.trace,
+                             process_name="fleet")
+        self.registry = MetricsRegistry()
+        attach_fleet_provider(self.registry, self)
+        self.telemetry: Optional[TimeSeriesRing] = None
+        sample_s = self.config.telemetry_sample_s or (
+            1.0 if self.config.flight_dir else 0.0)  # serve's rule: an
+        #   armed flight recorder implies the window it dumps
+        if sample_s > 0:
+            self.telemetry = TimeSeriesRing(
+                self.signals,
+                interval_s=sample_s,
+                name="dvf-fleet-telemetry")
+        self.flight: Optional[FlightRecorder] = None
+        if self.config.flight_dir:
+            self.flight = FlightRecorder(
+                self.config.flight_dir, label="fleet",
+                min_interval_s=self.config.flight_min_interval_s,
+                trace_fn=self.trace_snapshots,
+                stats_fn=self.stats,
+                ring=self.telemetry)
+        self._stalls_seen: Dict[str, int] = {}
+        # Last-seen per-replica delivered_total: a transiently missing
+        # export (busy channel → stats lock_timeout, replica mid-drain)
+        # must not dip the fleet's delivered counter for one scrape —
+        # rate() would read the dip+recovery as a reset+spike. A replica
+        # RESTART still resets its share: that is the idiomatic counter
+        # reset consumers already handle.
+        self._delivered_seen: Dict[str, float] = {}
         for i in range(self.config.replicas):
             rid = f"r{i}"
             self._replicas[rid] = self._make_replica(rid, i)
@@ -286,11 +331,15 @@ class FleetFrontend:
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="dvf-fleet-health", daemon=True)
         self._monitor.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         return self
 
     def stop(self, timeout: float = 15.0) -> None:
         self._stop.set()
         self._wake.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self._monitor is not None:
             self._monitor.join(timeout=timeout)
             self._monitor = None
@@ -565,6 +614,19 @@ class FleetFrontend:
                         r, ServeError(f"replica {r.id} unhealthy: "
                                       f"{h.get('error')}"),
                         reachable=True)
+                    continue
+                # Replica-side watchdog trips surface in the health
+                # export's stalls counter; a rising watermark is the
+                # fleet-level flight trigger — the replica recovered on
+                # its own (PR-4 supervision), but "p99 was blown at
+                # 14:02" now has a merged-trace artifact.
+                stalls = int(h.get("stalls") or 0)
+                if stalls > self._stalls_seen.get(r.id, 0):
+                    self._stalls_seen[r.id] = stalls
+                    self.tracer.instant("replica_stall", track=0,
+                                        replica=r.id, stalls=stalls)
+                    self._dump_async(f"replica {r.id} watchdog stall "
+                                     f"(stalls={stalls})")
 
     def _handle_loss(self, r: ReplicaHandle, exc: BaseException,
                      reachable: bool = False) -> None:
@@ -578,6 +640,9 @@ class FleetFrontend:
             r.state = DRAINING
             self.replica_losses += 1
             self.faults.record(FaultKind.REPLICA, exc, replica=r.id)
+            self.tracer.instant("replica_lost", track=0, replica=r.id,
+                                error=repr(exc))
+            self._dump_async(f"replica {r.id} lost: {exc!r}")
             bound = [s for s in self._snapshot_sessions()
                      if s.replica_id == r.id and not s.orphaned]
             for s in bound:
@@ -599,6 +664,15 @@ class FleetFrontend:
                         r.restart()  # start() flips state to HEALTHY
                         with self._lock:
                             self._load[r.id] = 0
+                        # Fresh frontend, fresh counters: both
+                        # watermarks must reset with it — or the first
+                        # post-restart watchdog trips go unnoticed and
+                        # the delivered floor pins the dead counter's
+                        # high-water mark forever (an idiomatic counter
+                        # reset, which consumers handle).
+                        self._stalls_seen.pop(r.id, None)
+                        with self._lock:
+                            self._delivered_seen.pop(r.id, None)
                         last = None
                         break
                     except Exception as e:  # noqa: BLE001 — judged below
@@ -613,6 +687,15 @@ class FleetFrontend:
                           file=sys.stderr, flush=True)
             else:
                 r.state = DEAD
+
+    def _dump_async(self, reason: str) -> None:
+        """Flight dump OFF the monitor thread (FlightRecorder.
+        trigger_async): the dump pulls per-replica stats/trace RPCs, and
+        both trigger paths run in the thread that owns loss detection /
+        migration / restart — supervision must never wait behind a dump
+        mid-incident."""
+        if self.flight is not None:
+            self.flight.trigger_async(reason)
 
     def _snapshot_sessions(self) -> List[_FleetSession]:
         with self._lock:
@@ -680,6 +763,46 @@ class FleetFrontend:
 
     # -- observability ---------------------------------------------------
 
+    def trace_snapshots(self) -> List[dict]:
+        """Every reachable tracer's bounded event window: the front
+        door's own plus one per replica (in-process read or the
+        ``trace`` RPC) — the input to ONE merged Perfetto session. A
+        dead or wedged replica costs its lane, nothing else."""
+        snaps: List[dict] = []
+        if len(self.tracer):
+            snaps.append(self.tracer.snapshot())
+        for r in list(self._replicas.values()):
+            try:
+                snap = r.trace_snapshot()
+            except Exception:  # noqa: BLE001 — lane lost, merge lives
+                continue
+            if snap and snap.get("events"):
+                snaps.append(snap)
+        return snaps
+
+    def export_trace(self, out_path: str) -> Optional[dict]:
+        """Merge every replica's trace into one Perfetto file on one
+        aligned clock (``obs.trace.merge_tracer_snapshots``)."""
+        return merge_tracer_snapshots(self.trace_snapshots(), out_path)
+
+    def signals(self) -> dict:
+        """RPC-free front-door signal row (the fleet telemetry ring's
+        sample: never blocks on a replica channel)."""
+        with self._lock:
+            open_sessions = sum(1 for s in self._sessions.values()
+                                if not s.closed)
+        return {
+            "open_sessions": float(open_sessions),
+            "healthy_replicas": float(sum(
+                1 for r in self._replicas.values() if r.state == HEALTHY)),
+            "replica_losses_total": float(self.replica_losses),
+            "migrated_sessions_total": float(self.migrated_sessions),
+            "orphaned_sessions_total": float(self.orphaned_sessions),
+            "order_violations_total": float(self.order_violations),
+            "replica_restarts_total": float(sum(
+                r.restarts for r in self._replicas.values())),
+        }
+
     def stats(self) -> dict:
         """The fleet view: per-replica rows + merged latency/faults."""
         exports: Dict[str, Optional[dict]] = {}
@@ -694,6 +817,25 @@ class FleetFrontend:
         with self._lock:
             sessions = {**self._retired, **self._sessions}
             load = dict(self._load)
+        replica_rows = {}
+        for rid, r in self._replicas.items():
+            row = replica_row(r, exports.get(rid), load.get(rid, 0))
+            d = row.get("delivered_total")
+            with self._lock:
+                # Max semantics make concurrent stats() calls (scrape
+                # provider + off-thread dump) interleaving-safe: a stale
+                # reader can never LOWER the watermark. Restarts reset
+                # it explicitly in _handle_loss (fresh counter).
+                prev = self._delivered_seen.get(rid)
+                if d is not None and (prev is None or d > prev):
+                    self._delivered_seen[rid] = d
+                elif d is None:
+                    # Transiently unreadable export (busy channel, mid-
+                    # drain): hold the last-seen value so the summed
+                    # fleet counter never dips-and-recovers (a fake
+                    # rate() spike).
+                    row["delivered_total"] = prev
+            replica_rows[rid] = row
         session_rows = {}
         for sid, s in sessions.items():
             session_rows[sid] = {
@@ -706,10 +848,7 @@ class FleetFrontend:
                           else "closed" if s.closed else "open"),
             }
         return {
-            "replicas": {
-                rid: replica_row(r, exports.get(rid), load.get(rid, 0))
-                for rid, r in self._replicas.items()
-            },
+            "replicas": replica_rows,
             "sessions": session_rows,
             "open_sessions": sum(1 for s in sessions.values()
                                  if not s.closed),
@@ -733,4 +872,6 @@ class FleetFrontend:
                  for rid, e in exports.items()}),
             **({"chaos": self.config.chaos.summary()}
                if self.config.chaos is not None else {}),
+            **({"flight": self.flight.stats()}
+               if self.flight is not None else {}),
         }

@@ -55,6 +55,12 @@ def replica_row(handle, export: Optional[dict], sessions: int) -> dict:
             engine_batches=st.get("engine_batches"),
             engine_frames=st.get("engine_frames"),
             open_sessions=st.get("open_sessions"),
+            queue_depth=st.get("queue_depth"),
+            # The replica's MONOTONE lifetime counter (signals() carries
+            # the evicted-session floor) — the scrape's counter source;
+            # the windowed aggregate.count beside it is NOT monotone.
+            delivered_total=(export.get("signals") or {}).get(
+                "delivered_total"),
             errors=st.get("errors"),
             recoveries=st.get("recoveries"),
             faults=st.get("faults", {}).get("by_kind", {}),

@@ -1,2 +1,14 @@
-from dvf_tpu.obs.trace import Tracer  # noqa: F401
+from dvf_tpu.obs.trace import Tracer, merge_tracer_snapshots  # noqa: F401
 from dvf_tpu.obs.metrics import LatencyStats  # noqa: F401
+from dvf_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    TimeSeriesRing,
+    check_metric_name,
+    walk_export,
+)
+from dvf_tpu.obs.export import (  # noqa: F401
+    FlightRecorder,
+    MetricsExporter,
+    attach_signal_provider,
+    samples_from_signals,
+)

@@ -1,0 +1,521 @@
+"""Scrape endpoints + SLO flight recorder.
+
+The push half of the telemetry plane: `obs.registry` holds the samples,
+this module gets them out of the process.
+
+:class:`MetricsExporter`
+    A tiny stdlib HTTP server (no new dependencies) exposing
+
+    - ``/metrics``     Prometheus text exposition (``?format=json`` for
+      the same samples as a JSON document),
+    - ``/healthz``     the owner's cheap health export (200 ``ok: true``
+      / 503 otherwise) — what a load balancer or the fleet monitor's
+      out-of-process twin polls,
+    - ``/timeseries``  the bounded sliding window of load-control
+      signals (`obs.registry.TimeSeriesRing`).
+
+    Attachable to any tier via ``--metrics-port`` (serve, fleet, worker,
+    single-stream pipeline). Port 0 binds an ephemeral port (tests);
+    the bound port is exported as ``.port``.
+
+:func:`samples_from_signals`
+    The one adapter between the runtime's flat ``signals()`` dicts and
+    registry samples: ``*_total`` keys become counters, everything else
+    gauges, and ``fault_<kind>_total`` keys pivot into the labeled
+    ``faults_total{kind=…}`` family. Names are conformance-checked by
+    the registry at collect, so a renamed signal fails loudly.
+
+:class:`FlightRecorder`
+    The post-mortem black box: on a trigger — PR-4 watchdog trip, error
+    budget overflow, SLO burn-rate breach, replica loss — it writes one
+    bounded dump directory: the merged Perfetto trace from every
+    registered tracer snapshot (cross-process clock alignment via
+    `obs.trace.merge_tracer_snapshots`), the owner's full ``stats()``,
+    the telemetry ring window, and a ``meta.json`` naming the trigger.
+    Rate-limited and dump-capped so a flapping trigger cannot fill a
+    disk; optionally opens a short ``jax.profiler`` capture window so
+    the dump carries device lanes too. "Why was p99 blown at 14:02"
+    gets an artifact instead of a shrug.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from dvf_tpu.obs.registry import (
+    COUNTER,
+    GAUGE,
+    MetricSample,
+    MetricsRegistry,
+    TimeSeriesRing,
+    finite_or_none,
+)
+from dvf_tpu.obs.trace import merge_tracer_snapshots
+
+_FAULT_KEY_RE = re.compile(r"^fault_([a-z][a-z0-9_]*)_total$")
+
+
+def jsonable(doc: Any) -> Any:
+    """Strict-JSON form of an export: non-finite floats → None (the
+    literal ``NaN`` json.dumps would otherwise emit is rejected by
+    RFC-8259 parsers — JS, Go, most dashboards), unknown objects →
+    ``repr``. Applied to every document this module serves or dumps."""
+    if isinstance(doc, dict):
+        return {str(k): jsonable(v) for k, v in doc.items()}
+    if isinstance(doc, (list, tuple)):
+        return [jsonable(v) for v in doc]
+    if isinstance(doc, float):
+        return finite_or_none(doc)
+    if doc is None or isinstance(doc, (bool, int, str)):
+        return doc
+    return repr(doc)
+
+
+def samples_from_signals(
+    signals: Dict[str, Any],
+    prefix: str = "",
+    labels: Optional[Dict[str, str]] = None,
+) -> List[MetricSample]:
+    """Flat ``signals()`` dict → registry samples.
+
+    ``*_total`` → counter, else gauge; ``fault_<kind>_total`` pivots to
+    ``faults_total{kind=<kind>}`` so fault kinds are a label dimension,
+    not a metric-name explosion. ``None`` values are skipped (an
+    unavailable signal is a gap, not a zero)."""
+    base = tuple(sorted((str(k), str(v))
+                        for k, v in (labels or {}).items()))
+    out: List[MetricSample] = []
+    for key, value in signals.items():
+        if value is None:
+            continue
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            continue  # non-numeric signals don't scrape
+        m = _FAULT_KEY_RE.match(key)
+        if m:
+            name = f"{prefix}_faults_total" if prefix else "faults_total"
+            out.append(MetricSample(
+                name, v, tuple(sorted(base + (("kind", m.group(1)),))),
+                COUNTER))
+            continue
+        name = f"{prefix}_{key}" if prefix else key
+        kind = COUNTER if key.endswith("_total") else GAUGE
+        out.append(MetricSample(name, v, base, kind))
+    return out
+
+
+def attach_signal_provider(
+    registry: MetricsRegistry,
+    prefix: str,
+    signals_fn: Callable[[], Dict[str, Any]],
+    labels: Optional[Dict[str, str]] = None,
+) -> None:
+    """Register ``signals_fn`` as a scrape-time provider under
+    ``prefix`` — the standard wiring for serve/pipeline/worker tiers."""
+    registry.register_provider(
+        lambda: samples_from_signals(signals_fn(), prefix, labels))
+
+
+def fleet_samples(fleet) -> List[MetricSample]:
+    """The fleet scrape: merged aggregate + per-replica rows, every
+    per-replica series labeled ``replica=…``. Rides the existing
+    ``stats()`` merge discipline (``LatencyStats.merge_snapshots`` /
+    ``FaultStats.absorb_summary``) — per-replica data already crossed
+    the ``ProcessReplica`` RPC inside ``fleet.stats()``."""
+    st = fleet.stats()
+    agg = st.get("aggregate") or {}
+    rows = st.get("replicas") or {}
+    # delivered_total comes from the replicas' monotone lifetime
+    # counters (signals() — evicted-session floor included), NOT from
+    # the windowed aggregate.count: the latter shrinks when a replica
+    # evicts retired sessions, which a Prometheus counter must never do.
+    # (A replica restart still resets its share — the idiomatic counter
+    # reset rate() handles.)
+    delivered = [row.get("delivered_total") for row in rows.values()]
+    delivered = [d for d in delivered if d is not None]
+    out = samples_from_signals({
+        "p50_ms": agg.get("p50_ms"),
+        "p90_ms": agg.get("p90_ms"),
+        "p99_ms": agg.get("p99_ms"),
+        "fps": agg.get("fps"),
+        "delivered_total": sum(delivered) if delivered else None,
+        "open_sessions": st.get("open_sessions"),
+        "replica_losses_total": st.get("replica_losses"),
+        "migrated_sessions_total": st.get("migrated_sessions"),
+        "orphaned_sessions_total": st.get("orphaned_sessions"),
+        "order_violations_total": st.get("order_violations"),
+        "spillovers_total": st.get("spillovers"),
+        "rejections_total": st.get("rejections"),
+        "replica_restarts_total": st.get("replica_restarts"),
+    }, prefix="fleet")
+    faults = st.get("faults") or {}
+    for kind, n in (faults.get("by_kind") or {}).items():
+        out.append(MetricSample("fleet_faults_total", float(n),
+                                (("kind", str(kind)),), COUNTER))
+    for rid, kinds in (faults.get("by_replica") or {}).items():
+        for kind, n in kinds.items():
+            out.append(MetricSample(
+                "fleet_replica_faults_total", float(n),
+                (("kind", str(kind)), ("replica", str(rid))), COUNTER))
+    for rid, row in rows.items():
+        ragg = row.get("aggregate") or {}
+        out.extend(samples_from_signals({
+            "up": 1.0 if row.get("state") == "healthy" else 0.0,
+            "sessions": row.get("sessions"),
+            "restarts_total": row.get("restarts"),
+            "delivered_total": row.get("delivered_total"),
+            "engine_frames_total": row.get("engine_frames"),
+            "engine_batches_total": row.get("engine_batches"),
+            "errors_total": row.get("errors"),
+            "recoveries_total": row.get("recoveries"),
+            "queue_depth": row.get("queue_depth"),
+            "p50_ms": ragg.get("p50_ms"),
+            "p99_ms": ragg.get("p99_ms"),
+            "fps": ragg.get("fps"),
+        }, prefix="fleet_replica", labels={"replica": rid}))
+    return out
+
+
+def attach_fleet_provider(registry: MetricsRegistry, fleet,
+                          min_interval_s: float = 1.0) -> None:
+    """Register the fleet provider with a freshness cache: one
+    ``fleet.stats()`` costs a stats RPC per replica (each briefly
+    holding that replica's serial channel lock against its submit hot
+    path) plus a full percentile merge — concurrent or tight-loop
+    scrapers must coalesce onto one fan-out per ``min_interval_s``
+    rather than multiplying it."""
+    lock = threading.Lock()
+    cache: Dict[str, Any] = {"t": float("-inf"), "samples": []}
+
+    def provider() -> List[MetricSample]:
+        with lock:  # one fan-out at a time; followers reuse its result
+            now = time.monotonic()
+            if now - cache["t"] >= min_interval_s:
+                cache["samples"] = fleet_samples(fleet)
+                cache["t"] = now
+            return cache["samples"]
+
+    registry.register_provider(provider)
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+class MetricsExporter:
+    """Pull-based scrape endpoint over one registry (module docstring).
+
+    ``health_fn()`` should be the owner's cheap liveness export (e.g.
+    ``ServeFrontend.health`` — no percentile work); ``ring`` the owner's
+    :class:`~dvf_tpu.obs.registry.TimeSeriesRing` (``/timeseries`` 404s
+    without one)."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        health_fn: Optional[Callable[[], dict]] = None,
+        ring: Optional[TimeSeriesRing] = None,
+    ):
+        self.registry = registry
+        self.health_fn = health_fn
+        self.ring = ring
+        self.requests = 0
+        self.request_errors = 0
+        self._stat_lock = threading.Lock()  # handler threads are
+        #   concurrent (ThreadingHTTPServer); unlocked += would let the
+        #   request diagnostics undercount themselves
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
+                pass  # scrape traffic must not spam stderr
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                with exporter._stat_lock:
+                    exporter.requests += 1
+                try:
+                    exporter._route(self)
+                except BrokenPipeError:
+                    pass  # scraper hung up mid-reply
+                except Exception as e:  # noqa: BLE001 — one bad scrape
+                    with exporter._stat_lock:  # must not kill the server
+                        exporter.request_errors += 1
+                    try:
+                        self.send_error(500, explain=repr(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing ---------------------------------------------------------
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        from urllib.parse import parse_qs
+
+        path, _, query = req.path.partition("?")
+        if path == "/metrics":
+            if parse_qs(query).get("format") == ["json"]:
+                self._reply(req, 200, "application/json",
+                            json.dumps(self.registry.to_json(),
+                                       default=repr))
+            else:
+                self._reply(req, 200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            self.registry.to_prometheus())
+        elif path == "/healthz":
+            health = {"ok": True}
+            if self.health_fn is not None:
+                health = self.health_fn()
+            code = 200 if health.get("ok", False) else 503
+            self._reply(req, code, "application/json",
+                        json.dumps(jsonable(health)))
+        elif path == "/timeseries":
+            if self.ring is None:
+                req.send_error(404, explain="no telemetry ring attached")
+                return
+            self._reply(req, 200, "application/json",
+                        json.dumps(jsonable(self.ring.series())))
+        else:
+            req.send_error(404)
+
+    @staticmethod
+    def _reply(req: BaseHTTPRequestHandler, code: int, ctype: str,
+               body: str) -> None:
+        payload = body.encode()
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsExporter":
+        if self._thread is not None:
+            raise RuntimeError("exporter already started")
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            name="dvf-metrics-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _slug(reason: str, limit: int = 48) -> str:
+    s = re.sub(r"[^a-z0-9]+", "-", reason.lower()).strip("-")
+    return (s[:limit].rstrip("-")) or "trip"
+
+
+class FlightRecorder:
+    """Bounded post-mortem dumper (module docstring).
+
+    ``trace_fn()`` returns a list of :meth:`Tracer.snapshot` dicts (one
+    per lane source — the always-on bounded rings the tracers already
+    keep); ``stats_fn()`` the owner's full stats export; ``ring`` the
+    telemetry window. All three are optional and best-effort: a dump
+    writes whatever it can reach — a post-mortem with a missing artifact
+    beats no post-mortem, and a dump must never take down the serving
+    path that triggered it.
+    """
+
+    # One jax.profiler session may exist per process; a second trigger
+    # during a capture window skips its own.
+    _profiling = threading.Lock()
+
+    def __init__(
+        self,
+        out_dir: str,
+        label: str = "dvf",
+        min_interval_s: float = 10.0,
+        max_dumps: int = 16,
+        trace_fn: Optional[Callable[[], List[dict]]] = None,
+        stats_fn: Optional[Callable[[], dict]] = None,
+        ring: Optional[TimeSeriesRing] = None,
+        jax_profile_s: float = 0.0,
+    ):
+        self.out_dir = out_dir
+        self.label = label
+        self.min_interval_s = min_interval_s
+        self.max_dumps = max_dumps
+        self.trace_fn = trace_fn
+        self.stats_fn = stats_fn
+        self.ring = ring
+        self.jax_profile_s = jax_profile_s
+        self.dumps: List[str] = []
+        self.suppressed = 0
+        self.dump_errors = 0
+        self.last_reason: Optional[str] = None
+        self._last_ts: float = float("-inf")
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def trigger_async(self, reason: str) -> None:
+        """One dump on a short-lived daemon thread — for callers on
+        supervision-critical paths (watchdog trips, loss handling, the
+        monitor loop), where serializing a trace window to disk must not
+        extend the incident it records. The rate limit inside
+        :meth:`trigger` claims the slot, so a trigger storm spawns
+        bounded no-op threads, not dumps."""
+        threading.Thread(target=self.trigger, args=(reason,),
+                         name="dvf-flight-dump", daemon=True).start()
+
+    def trigger(self, reason: str) -> Optional[str]:
+        """Attempt one dump; returns its directory, or None when
+        rate-limited / capped / nothing could be written. Runs inline in
+        the triggering thread (watchdog, monitor, sampler) — the write
+        is a few JSON files, bounded by the rings feeding it."""
+        with self._lock:
+            now = time.monotonic()
+            if (now - self._last_ts < self.min_interval_s
+                    or len(self.dumps) >= self.max_dumps):
+                self.suppressed += 1
+                return None
+            self._last_ts = now
+            self._seq += 1
+            seq = self._seq
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        dump_dir = os.path.join(
+            self.out_dir,
+            f"{self.label}-{seq:03d}-{stamp}-{_slug(reason)}")
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+        except OSError:
+            with self._lock:
+                self.dump_errors += 1
+                # Give the slot back: nothing was written, so the NEXT
+                # trigger (disk recovered, ENOSPC cleared) must not be
+                # rate-limited into producing no post-mortem at all.
+                self._last_ts = float("-inf")
+                self._seq -= 1
+            return None
+        self.last_reason = reason
+        wrote = self._write_artifacts(dump_dir, reason)
+        import sys
+
+        if not wrote:
+            # Every artifact write failed (ENOSPC after makedirs
+            # succeeded): an empty directory is not a dump — give the
+            # rate-limit AND max_dumps slots back, like the makedirs
+            # failure path, so the recorder revives when the disk does.
+            with self._lock:
+                self._last_ts = float("-inf")
+                self._seq -= 1
+            print(f"[flight] {reason!r}: dump failed entirely "
+                  f"(nothing written under {dump_dir})",
+                  file=sys.stderr, flush=True)
+            return None
+        with self._lock:
+            self.dumps.append(dump_dir)
+        if self.jax_profile_s > 0:
+            self._profile_window(dump_dir)
+        print(f"[flight] {reason!r} → {dump_dir} ({', '.join(wrote)})",
+              file=sys.stderr, flush=True)
+        return dump_dir
+
+    def _write_artifacts(self, dump_dir: str, reason: str) -> List[str]:
+        wrote: List[str] = []
+
+        def best_effort(name: str, fn) -> None:
+            try:
+                fn()
+                wrote.append(name)
+            except Exception:  # noqa: BLE001 — partial dumps are fine
+                with self._lock:
+                    self.dump_errors += 1
+
+        best_effort("meta", lambda: self._json(
+            dump_dir, "meta.json",
+            {"reason": reason, "label": self.label,
+             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "ts": time.time(), "pid": os.getpid()}))
+        if self.trace_fn is not None:
+            def _trace():
+                snaps = self.trace_fn()
+                if not merge_tracer_snapshots(
+                        snaps, os.path.join(dump_dir, "trace.pftrace")):
+                    raise ValueError("no trace events to dump")
+            best_effort("trace", _trace)
+        if self.stats_fn is not None:
+            best_effort("stats", lambda: self._json(
+                dump_dir, "stats.json", self.stats_fn()))
+        if self.ring is not None:
+            best_effort("timeseries", lambda: self._json(
+                dump_dir, "timeseries.json", self.ring.series()))
+        return wrote
+
+    @staticmethod
+    def _json(dump_dir: str, name: str, doc: Any) -> None:
+        with open(os.path.join(dump_dir, name), "w") as f:
+            json.dump(jsonable(doc), f)
+
+    def _profile_window(self, dump_dir: str) -> None:
+        """On-demand device capture: a short ``jax.profiler`` window into
+        the dump dir, on a daemon thread (the profiler blocks). At most
+        one window per process at a time — a trigger landing inside an
+        open window skips, it does not queue."""
+        if not FlightRecorder._profiling.acquire(blocking=False):
+            return
+
+        def capture():
+            try:
+                import jax
+
+                trace_dir = os.path.join(dump_dir, "device_trace")
+                jax.profiler.start_trace(trace_dir)
+                try:
+                    time.sleep(self.jax_profile_s)
+                finally:
+                    jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — device capture is garnish
+                with self._lock:
+                    self.dump_errors += 1
+            finally:
+                FlightRecorder._profiling.release()
+
+        threading.Thread(target=capture, name="dvf-flight-profile",
+                         daemon=True).start()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "dumps": len(self.dumps),
+                "suppressed": self.suppressed,
+                "dump_errors": self.dump_errors,
+                "last_reason": self.last_reason,
+                "dir": self.out_dir,
+            }

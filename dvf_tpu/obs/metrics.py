@@ -351,12 +351,24 @@ class EgressStats:
 
 class RateLogger:
     """Periodic printer, like the reference's every-5s FPS prints
-    (webcam_app.py:88-95)."""
+    (webcam_app.py:88-95).
 
-    def __init__(self, name: str, interval_s: float = 5.0, quiet: bool = False):
+    When a ``registry`` (obs.registry.MetricsRegistry) is attached, every
+    computed rate ALSO lands as the ``rate_fps`` gauge labeled
+    ``{stage: name}`` — the every-5s stderr number and the ``/metrics``
+    scrape are then the same arithmetic on the same ticks and can never
+    disagree. ``quiet`` silences the print only; the gauge keeps
+    updating (a quiet server is still scrapeable).
+    """
+
+    def __init__(self, name: str, interval_s: float = 5.0,
+                 quiet: bool = False, registry=None):
         self.name = name
         self.interval_s = interval_s
         self.quiet = quiet
+        self.last_rate: Optional[float] = None
+        self._gauge = (registry.gauge("rate_fps")
+                       if registry is not None else None)
         self._count = 0
         self._last = time.perf_counter()
 
@@ -366,6 +378,9 @@ class RateLogger:
         dt = now - self._last
         if dt >= self.interval_s:
             rate = self._count / dt
+            self.last_rate = rate
+            if self._gauge is not None:
+                self._gauge.set(rate, labels={"stage": self.name})
             if not self.quiet:
                 print(f"[{self.name}] {rate:.1f} fps")
             self._count = 0

@@ -35,6 +35,7 @@ side:
 
 from __future__ import annotations
 
+import collections
 import json
 import sys
 import threading
@@ -57,11 +58,31 @@ EGRESS_SEND = "egress_send"
 
 
 class Tracer:
-    def __init__(self, enabled: bool = False, process_name: str = "dvf_tpu"):
+    """Frame-lifecycle tracer with a BOUNDED event ring.
+
+    ``max_events`` caps the buffer: an enabled tracer on an
+    indefinitely-running serve process keeps the most recent window and
+    counts what it sheds (``dropped``) — the same leak guard
+    ``LatencyStats`` decimation applies to samples. The retained window
+    doubles as the flight recorder's always-on black box: at the default
+    bound it covers the last ~10⁵ events, minutes of serving at frame
+    rates, for a few tens of MB worst case.
+
+    ``start_time`` is a WALL-CLOCK epoch (``time.time()``): event
+    timestamps are µs relative to it, so snapshots from different
+    processes merge onto one clock by offsetting each tracer's events by
+    its epoch delta (:func:`merge_tracer_snapshots`).
+    """
+
+    def __init__(self, enabled: bool = False, process_name: str = "dvf_tpu",
+                 max_events: int = 100_000):
         self.enabled = enabled
         self.process_name = process_name
         self.start_time = time.time()
-        self._events: List[Dict[str, Any]] = []
+        self.max_events = max_events
+        self.dropped = 0
+        self._events: "collections.deque[Dict[str, Any]]" = (
+            collections.deque(maxlen=max_events))
         self._lock = threading.Lock()
 
     def _us(self, t: float) -> int:
@@ -81,8 +102,7 @@ class Tracer:
         }
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
 
     def complete(self, name: str, t0: float, t1: float, track: int = 0, **args) -> None:
         """'X' event spanning [t0, t1] (distributor.py:75-88)."""
@@ -98,10 +118,47 @@ class Tracer:
         }
         if args:
             ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]) -> None:
         with self._lock:
+            if len(self._events) == self._events.maxlen:
+                # The deque sheds the oldest on append; count the loss so
+                # a bounded export says "window, not whole run" honestly.
+                self.dropped += 1
             self._events.append(ev)
 
     # ------------------------------------------------------------------
+
+    def snapshot(self, max_events: Optional[int] = None) -> Dict[str, Any]:
+        """This tracer's mergeable export: the retained event window plus
+        the wall-clock epoch and identity needed to place it on a shared
+        timeline — plain JSON/pickle-safe values, the form that crosses a
+        fleet replica's RPC boundary (``merge_tracer_snapshots`` on the
+        other side). The event list is copied under the lock; emitters
+        keep appending concurrently.
+
+        ``max_events`` keeps only the most RECENT k events (the extra
+        shed counts as ``dropped``): the cap a transfer-cost-sensitive
+        exporter applies — the fleet's ``trace`` RPC serializes the
+        snapshot while holding the replica's serial channel lock, where
+        a full 100k-event ring would stall the submit hot path for the
+        whole transfer."""
+        import os
+
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        if max_events is not None and len(events) > max_events:
+            dropped += len(events) - max_events
+            events = events[-max_events:]
+        return {
+            "process_name": self.process_name,
+            "start_time": self.start_time,
+            "pid": os.getpid(),
+            "dropped": dropped,
+            "events": events,
+        }
 
     def export(self, path: str = "dvf_frame_timing.pftrace") -> Optional[str]:
         """Write Chrome-trace JSON (the reference hand-serializes the same
@@ -228,3 +285,98 @@ def merge_with_device_trace(
     print(f"[trace] merged host+device trace → {out_path} "
           f"({len(events)} device events kept)", file=sys.stderr)
     return out_path
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace merging (fleet tier: one Perfetto session, N tracers)
+# ---------------------------------------------------------------------------
+
+# Each snapshot's tracks are offset into their own pid block so lanes from
+# different processes can never collide — the same trick
+# merge_with_device_trace uses (+10000) for the jax.profiler lanes, which
+# therefore stay clear of any realistic fleet (100 lanes × 100 replicas).
+LANE_STRIDE = 100
+
+
+def merge_tracer_snapshots(
+    snaps: "List[dict]",
+    out_path: Optional[str] = None,
+    max_events: int = 100_000,
+) -> Optional[dict]:
+    """Fuse N :meth:`Tracer.snapshot` exports — serve frontends, fleet
+    replicas (in-process or across the RPC boundary), the ZMQ worker —
+    into ONE Chrome-trace document that opens as a single Perfetto
+    session, every lane on one aligned clock.
+
+    Clock alignment: each tracer's timestamps are µs relative to its own
+    wall-clock ``start_time``; the merge re-bases every event onto the
+    EARLIEST epoch among the snapshots (``ts += (start_time_i − epoch0)
+    in µs``), which is exact up to wall-clock skew between processes —
+    on one host (the fleet's process replicas) that is NTP-free and
+    effectively zero.
+
+    Lanes: snapshot *i*'s tracks land in pid block ``i * LANE_STRIDE``,
+    named ``{process_name}/{track}`` so the Perfetto UI groups one
+    process per replica. If the union exceeds ``max_events`` the
+    longest-duration events win, mirroring the device-trace merge's cut.
+
+    Returns the document (and writes it to ``out_path`` when given);
+    None when no snapshot carried any events.
+    """
+    snaps = [s for s in snaps if s and s.get("events")]
+    if not snaps:
+        return None
+    epoch0 = min(float(s["start_time"]) for s in snaps)
+    meta: List[dict] = []
+    events: List[dict] = []
+    lanes: List[dict] = []
+    for i, s in enumerate(snaps):
+        base = i * LANE_STRIDE
+        off_us = int((float(s["start_time"]) - epoch0) * 1e6)
+        name = s.get("process_name") or f"tracer{i}"
+        tracks = set()
+        for e in s["events"]:
+            e = dict(e)
+            track = int(e.get("pid", 0))
+            tracks.add(track)
+            e["pid"] = base + track
+            e["ts"] = int(e.get("ts", 0)) + off_us
+            events.append(e)
+        for track in sorted(tracks):
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": base + track,
+                "args": {"name": f"{name}/{track}" if track else name},
+            })
+        lanes.append({
+            "process_name": name,
+            "pid_base": base,
+            "pid": s.get("pid"),
+            "epoch_offset_us": off_us,
+            "events": len(s["events"]),
+            "dropped": int(s.get("dropped", 0)),
+        })
+    if len(events) > max_events:
+        # Instants survive the cut: they are rare and they are the
+        # incident markers (replica_lost, replica_stall, frame_captured)
+        # a post-mortem reads first — a duration sort alone would cull
+        # every one of them (no ``dur`` ranks as 0) before any span.
+        instants = [e for e in events if e.get("ph") != "X"][:max_events]
+        spans = [e for e in events if e.get("ph") == "X"]
+        spans.sort(key=lambda e: e.get("dur", 0), reverse=True)
+        events = instants + spans[:max(0, max_events - len(instants))]
+    events.sort(key=lambda e: e.get("ts", 0))
+    doc = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        # Provenance for post-mortem readers: which lane is which
+        # process, and how far its clock was re-based (Perfetto ignores
+        # unknown top-level keys).
+        "dvfTraceLanes": lanes,
+        "dvfEpoch": epoch0,
+    }
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+        print(f"[trace] merged {len(snaps)} tracer snapshots "
+              f"({len(events)} events) → {out_path}", file=sys.stderr)
+    return doc

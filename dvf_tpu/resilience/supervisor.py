@@ -87,11 +87,17 @@ class Supervisor:
         poll_s: Optional[float] = None,
         name: str = "dvf-supervisor",
         window: Optional[InflightWindow] = None,
+        on_trip: Optional[Callable[[str], None]] = None,
     ):
         if stall_timeout_s <= 0:
             raise ValueError("stall_timeout_s must be > 0")
         self.stall_timeout_s = stall_timeout_s
         self.on_stall = on_stall
+        # Observability tap, fired BEFORE on_stall so it sees the wedged
+        # state recovery is about to tear down (the serve frontend hangs
+        # its flight-recorder dump here). Best-effort: its failure must
+        # neither block nor abort the recovery itself.
+        self.on_trip = on_trip
         self.poll_s = poll_s if poll_s is not None else min(
             0.25, stall_timeout_s / 4.0)
         self.name = name
@@ -144,6 +150,14 @@ class Supervisor:
 
     def _trip(self, reason: str) -> None:
         self.stalls += 1
+        if self.on_trip is not None:
+            try:
+                self.on_trip(reason)
+            except Exception as e:  # noqa: BLE001 — a broken observer
+                import sys             # must never block recovery
+
+                print(f"[supervisor] on_trip raised (ignored): {e!r}",
+                      file=sys.stderr, flush=True)
         try:
             self.on_stall(reason)
         except Exception as e:  # noqa: BLE001 — a failed recovery must not

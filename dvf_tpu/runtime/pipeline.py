@@ -35,7 +35,9 @@ from typing import Any, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.export import attach_signal_provider
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats, RateLogger
+from dvf_tpu.obs.registry import MetricsRegistry
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
@@ -182,9 +184,20 @@ class Pipeline:
         self._supervisor: Optional[Supervisor] = None
         self._recovering = threading.Event()  # dispatch parks while the
         #   supervisor swaps the engine/assembler (see _on_stall)
+        # Metrics registry (obs.registry): the scrape endpoint's source
+        # for this pipeline. The RateLoggers land their computed rates as
+        # the rate_fps gauge ON THE SAME TICKS they print, so the every-5s
+        # stderr numbers and /metrics can never disagree; the provider
+        # adapts signals() (delivered/dropped/faults/overlap) at scrape.
+        self.registry = MetricsRegistry()
+        attach_signal_provider(self.registry, "pipeline", self.signals)
         _ti = self.config.telemetry_interval_s
-        self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
-        self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0, quiet=_ti <= 0)
+        self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0,
+                                        quiet=_ti <= 0,
+                                        registry=self.registry)
+        self._deliver_rate = RateLogger("deliver", _ti if _ti > 0 else 5.0,
+                                        quiet=_ti <= 0,
+                                        registry=self.registry)
         self._assembler: Optional[ShardedBatchAssembler] = None
         self._ingest_stats: Optional[IngestStats] = None
         self._on_idle = None  # inline collect: drain-ready hook (_assemble)
@@ -747,11 +760,56 @@ class Pipeline:
                           file=sys.stderr)
         return self.stats()
 
+    def health(self) -> dict:
+        """Cheap liveness export (the /healthz surface, mirroring
+        ``ServeFrontend.health``): no percentile work, safe to poll at
+        hertz rates. ``ok`` flips False once the pipeline has failed
+        (fail-fast fault / escaped error)."""
+        err = self._error
+        return {
+            "ok": err is None,
+            "error": repr(err) if err is not None else None,
+            "delivered": self.latency.count,
+            "errors": self.errors,
+            "recoveries": self.recoveries,
+        }
+
+    def signals(self) -> dict:
+        """Flat load-control signal row (registry-conformant keys): the
+        single-stream twin of ``ServeFrontend.signals`` — what the
+        ``/metrics`` provider scrapes and a TimeSeriesRing samples."""
+        agg = self.latency.summary()
+        out = {
+            "fps": agg.get("fps"),
+            "p50_ms": agg.get("p50_ms"),
+            "p90_ms": agg.get("p90_ms"),
+            "p99_ms": agg.get("p99_ms"),
+            "queue_depth": float(len(self.queue)),
+            "inflight_batches": float(len(self._inflight)),
+            "produced_total": float(self.frame_counter),
+            "delivered_total": float(self.latency.count),
+            "dropped_at_ingest_total": float(self.queue.dropped),
+            "errors_total": float(self.errors),
+            "recoveries_total": float(self.recoveries),
+            "engine_batches_total": float(self.engine.stats.batches),
+            "trace_dropped_total": float(self.tracer.dropped),
+        }
+        ing, egr = self._ingest_stats, self._egress_stats
+        if ing is not None:
+            out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
+        if egr is not None:
+            out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        for kind, n in self.faults.summary()["by_kind"].items():
+            out[f"fault_{kind}_total"] = float(n)
+        return out
+
     def stats(self) -> dict:
         """Superset of the reference's get_frame_stats (distributor.py:346-354)."""
         out = {
             **self.reorder.stats(),
-            "total_frames_produced": self.frame_counter,
+            # (was total_frames_produced — renamed to the registry-
+            # conformant counter form when the schema test landed)
+            "frames_produced_total": self.frame_counter,
             "dropped_at_ingest": self.queue.dropped,
             "transport": type(self.queue).__name__,
             "errors": self.errors,

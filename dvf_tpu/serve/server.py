@@ -44,7 +44,10 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
+from dvf_tpu.obs.registry import MetricsRegistry, TimeSeriesRing
+from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.resilience.supervisor import InflightWindow, Supervisor
@@ -64,6 +67,10 @@ from dvf_tpu.serve.session import (
     SessionConfig,
     StreamSession,
 )
+
+# Trace track ids (one lane per stage, the pipeline's convention):
+# dispatch staging, device span, per-shard H2D / D2H transfer lanes.
+TRACK_DISPATCH, TRACK_DEVICE, TRACK_H2D, TRACK_D2H = 0, 1, 3, 4
 
 
 @dataclasses.dataclass
@@ -111,6 +118,28 @@ class ServeConfig:
     #   replica N of a fleet — every fault record it emits carries the
     #   label, so the merged fleet export can attribute per-replica
     #   (resilience.faults.FaultStats). None outside a fleet.
+    trace: bool = False           # arm this frontend's Tracer (bounded
+    #   event ring, obs.trace): dispatch/device/H2D/D2H lanes, mergeable
+    #   fleet-wide via Tracer.snapshot() — also the flight recorder's
+    #   always-on black box
+    telemetry_sample_s: float = 0.0  # TimeSeriesRing cadence: the bounded
+    #   sliding window of load-control signals (fps, p50/p99, queue
+    #   depth, SLO headroom, overlap efficiencies, per-kind fault rates)
+    #   behind /timeseries and the burn-rate trigger. 0 = off (a window
+    #   nothing reads is a per-second percentile merge wasted — the CLI
+    #   turns it on with --metrics-port, and arming flight_dir enables
+    #   it automatically at 1 Hz since the burn trigger reads it).
+    flight_dir: Optional[str] = None  # SLO flight recorder: post-mortem
+    #   dumps (merged trace + stats + telemetry window) land here when
+    #   the watchdog trips, a fault budget overflows (frontend failure),
+    #   or the SLO burn rate crosses slo_burn_threshold. None = off.
+    flight_min_interval_s: float = 10.0  # dump rate limit
+    slo_burn_threshold: float = 0.5  # fraction of a sampling window's
+    #   deliveries missing their SLO that trips a flight dump (needs
+    #   flight_dir + the telemetry ring); 0 disables the burn trigger
+    flight_profile_s: float = 0.0  # >0: each dump also opens a
+    #   jax.profiler capture window of this length (device lanes in the
+    #   post-mortem); off by default — profiling is not free
 
 
 class ServeFrontend:
@@ -146,11 +175,49 @@ class ServeFrontend:
         self._lock = threading.Lock()
         self._sessions: Dict[str, StreamSession] = {}
         self._retired: Dict[str, StreamSession] = {}   # closed; poll-able
+        # Process-lifetime counter floor: sessions evicted from the
+        # bounded retired map (or release()d) fold their totals in here,
+        # so the *_total series stay MONOTONE — a Prometheus counter
+        # that shrinks when an old tenant ages out reads as a reset and
+        # fakes a rate() spike.
+        self._evicted_totals: Dict[str, int] = {
+            k: 0 for k in ("submitted", "delivered", "shed", "slo_miss",
+                           "failed", "dropped_at_ingress")}
         self._ids = itertools.count()
         self.admission_rejections = 0
         self.errors = 0
         self.faults = FaultStats(replica=self.config.replica_label)
         #   per-kind counters + last errors (replica-attributed in a fleet)
+        # -- telemetry plane (obs/): tracer lanes, metrics registry,
+        # sliding signal window, flight recorder ---------------------------
+        label = self.config.replica_label
+        self.tracer = Tracer(
+            enabled=self.config.trace,
+            process_name=f"serve:{label}" if label else "serve")
+        self.registry = MetricsRegistry()
+        attach_signal_provider(
+            self.registry, "serve", self.signals,
+            labels={"replica": label} if label else None)
+        self.telemetry: Optional[TimeSeriesRing] = None
+        sample_s = self.config.telemetry_sample_s or (
+            1.0 if self.config.flight_dir else 0.0)  # burn trigger +
+        #   post-mortem window need the ring; plain serving doesn't pay
+        if sample_s > 0:
+            self.telemetry = TimeSeriesRing(
+                self.signals,
+                interval_s=sample_s,
+                name="dvf-serve-telemetry",
+                on_sample=self._check_slo_burn)
+        self.flight: Optional[FlightRecorder] = None
+        if self.config.flight_dir:
+            self.flight = FlightRecorder(
+                self.config.flight_dir,
+                label=f"serve-{label}" if label else "serve",
+                min_interval_s=self.config.flight_min_interval_s,
+                trace_fn=lambda: [self.tracer.snapshot()],
+                stats_fn=self.stats,
+                ring=self.telemetry,
+                jax_profile_s=self.config.flight_profile_s)
         self._draining = False       # fleet drain hook: open_stream refuses
         self.recoveries = 0          # supervised engine rebuilds
         self._budget = ErrorBudget(limit=self.config.fault_budget,
@@ -211,8 +278,11 @@ class ServeFrontend:
         if self.config.stall_timeout_s > 0:
             self._supervisor = Supervisor(
                 self.config.stall_timeout_s, on_stall=self._on_stall,
-                name="dvf-serve-supervisor", window=self._window)
+                name="dvf-serve-supervisor", window=self._window,
+                on_trip=self._flight_trip)
             self._supervisor.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -221,6 +291,10 @@ class ServeFrontend:
         self._stop.set()
         if self._supervisor is not None:
             self._supervisor.stop()
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry.sample_once()  # terminal row: a short run still
+            #   leaves a window for the post-mortem/scrape to read
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=timeout)
@@ -300,6 +374,98 @@ class ServeFrontend:
         with self._lock:
             every = {**self._retired, **self._sessions}
         return LatencyStats.combined([s.latency for s in every.values()])
+
+    def signals(self) -> dict:
+        """The flat load-control signal set — one dict, registry-
+        conformant keys, cheap enough to sample at hertz rates: what the
+        TimeSeriesRing windows, the ``/metrics`` provider scrapes
+        (``obs.export.samples_from_signals``), and a load-adaptive
+        controller would read. Counter reads are GIL-atomic ints; the
+        only aggregate math is one weighted percentile merge."""
+        with self._lock:
+            live = list(self._sessions.values())
+            retired = list(self._retired.values())
+            floor = dict(self._evicted_totals)
+        every = retired + live
+        agg = LatencyStats.merged([s.latency for s in every])
+        p99 = agg.get("p99_ms")
+        headroom = (self.config.slo_ms - p99
+                    if p99 is not None and p99 == p99 else None)
+        out = {
+            "fps": agg.get("fps"),
+            "p50_ms": agg.get("p50_ms"),
+            "p90_ms": agg.get("p90_ms"),
+            "p99_ms": agg.get("p99_ms"),
+            "slo_headroom_ms": headroom,
+            # Standing work: frames queued before a device slot plus
+            # batches in flight — the queueing-delay signal a dynamic
+            # batch/tick controller keys off.
+            "queue_depth": float(sum(
+                len(s.ingress) + len(s.pending) for s in live)),
+            "inflight_batches": float(len(self._window)),
+            "open_sessions": float(len(live)),
+            "retired_sessions": float(len(retired)),
+            # Lifetime counters: live + retired sessions PLUS the floor
+            # absorbed from evicted ones — monotone across retirement-
+            # bound churn (a counter must never go backward).
+            "submitted_total": float(floor["submitted"] + sum(
+                s.submitted for s in every)),
+            "delivered_total": float(floor["delivered"] + sum(
+                s.delivered for s in every)),
+            "shed_total": float(floor["shed"] + sum(
+                s.shed for s in every)),
+            "slo_miss_total": float(floor["slo_miss"] + sum(
+                s.slo_miss for s in every)),
+            "failed_total": float(floor["failed"] + sum(
+                s.failed for s in every)),
+            "dropped_at_ingress_total": float(
+                floor["dropped_at_ingress"] + sum(
+                    s.ingress.dropped for s in every)),
+            "admission_rejections_total": float(self.admission_rejections),
+            "errors_total": float(self.errors),
+            "recoveries_total": float(self.recoveries),
+            "engine_batches_total": float(self.engine.stats.batches),
+            "engine_frames_total": float(self.engine.stats.frames),
+            "trace_dropped_total": float(self.tracer.dropped),
+        }
+        if self._supervisor is not None:
+            out["stalls_total"] = float(self._supervisor.stalls)
+        ing, egr = self._ingest_stats, self._egress_stats
+        if ing is not None:
+            out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
+        if egr is not None:
+            out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        for kind, n in self.faults.summary()["by_kind"].items():
+            out[f"fault_{kind}_total"] = float(n)
+        return out
+
+    def _check_slo_burn(self, prev: Optional[dict], cur: dict) -> None:
+        """Telemetry-ring hook: burn rate over one sampling window =
+        fraction of the window's deliveries that missed their SLO; past
+        the threshold, the flight recorder dumps (rate-limited there)."""
+        threshold = self.config.slo_burn_threshold
+        if self.flight is None or threshold <= 0 or prev is None:
+            return
+        delivered = (cur.get("delivered_total", 0)
+                     - prev.get("delivered_total", 0))
+        if delivered <= 0:
+            return
+        missed = cur.get("slo_miss_total", 0) - prev.get("slo_miss_total", 0)
+        burn = missed / delivered
+        if burn >= threshold:
+            self.flight.trigger(
+                f"slo burn rate {burn:.2f} >= {threshold:g} "
+                f"({missed:.0f}/{delivered:.0f} deliveries past "
+                f"{self.config.slo_ms:g}ms in one window)")
+
+    def _flight_trip(self, reason: str) -> None:
+        """Observability tap for failure events (watchdog on_trip,
+        budget-exhaustion _fail): dump the black box OFF-THREAD
+        (FlightRecorder.trigger_async) — the callers are the supervisor
+        and recovery paths, and serializing a trace window to disk must
+        not extend the stall it is recording."""
+        if self.flight is not None:
+            self.flight.trigger_async(reason)
 
     # -- client API ------------------------------------------------------
 
@@ -428,7 +594,9 @@ class ServeFrontend:
             if session_id in self._sessions:
                 raise ServeError(
                     f"session {session_id!r} is still open; close() it first")
-            self._retired.pop(session_id, None)
+            s = self._retired.pop(session_id, None)
+            if s is not None:
+                self._absorb_totals_locked(s)
 
     def _session(self, session_id: str) -> StreamSession:
         with self._lock:
@@ -437,12 +605,24 @@ class ServeFrontend:
             raise KeyError(f"unknown session {session_id!r}")
         return s
 
+    def _absorb_totals_locked(self, s: StreamSession) -> None:
+        """Fold a session leaving the retired map into the lifetime
+        counter floor (see _evicted_totals)."""
+        t = self._evicted_totals
+        t["submitted"] += s.submitted
+        t["delivered"] += s.delivered
+        t["shed"] += s.shed
+        t["slo_miss"] += s.slo_miss
+        t["failed"] += s.failed
+        t["dropped_at_ingress"] += s.ingress.dropped
+
     def _retire_locked(self, sid: str, session: StreamSession) -> None:
         """Move one session to the retired map, evicting oldest beyond
         the retention bound (dicts iterate in insertion order)."""
         self._retired[sid] = session
         while len(self._retired) > self.config.max_retired:
-            self._retired.pop(next(iter(self._retired)))
+            self._absorb_totals_locked(
+                self._retired.pop(next(iter(self._retired))))
 
     # -- service threads -------------------------------------------------
 
@@ -464,7 +644,8 @@ class ServeFrontend:
                 shape, dtype, self.engine.input_sharding,
                 mode=self._ingest_mode, depth=self.config.ingest_depth,
                 slots=self.config.max_inflight + 1,
-                stats=self._ingest_stats, chaos=self.config.chaos)
+                stats=self._ingest_stats, chaos=self.config.chaos,
+                tracer=self.tracer, track=TRACK_H2D)
             if self._degrade_reason is not None:
                 self._ingest_stats.fallback_reason = self._degrade_reason
         return self._assembler.begin(seq)
@@ -488,16 +669,23 @@ class ServeFrontend:
                 shape, self.engine.out_dtype, self.engine.output_sharding,
                 mode=self._egress_mode,
                 slots=self.config.max_inflight + 1,
-                stats=self._egress_stats, chaos=self.config.chaos)
+                stats=self._egress_stats, chaos=self.config.chaos,
+                tracer=self.tracer, track=TRACK_D2H)
             if self._egress_degrade_reason is not None:
                 self._egress_stats.fallback_reason = \
                     self._egress_degrade_reason
         return f
 
     def _fail(self, e: BaseException) -> None:
-        if self._error is None:
+        first = self._error is None
+        if first:
             self._error = e
         self._stop.set()
+        if first:
+            # Hard failure (fault budget exhausted, fail-fast fault,
+            # unrecoverable engine): the exact moment a post-mortem is
+            # worth a dump. Best-effort, rate-limited in the recorder.
+            self._flight_trip(f"frontend failed: {e!r}")
 
     def _contain(self, e: BaseException, where: str) -> bool:
         """Bounded containment (resilience.budget): classify, count,
@@ -751,6 +939,9 @@ class ServeFrontend:
                     fetcher = self._fetcher_for()
                     if fetcher is not None:
                         fetcher.prefetch(result)
+                    self.tracer.complete("serve_dispatch", t0, time.time(),
+                                         TRACK_DISPATCH, seq=seq,
+                                         frames=plan.valid)
                 except Exception as e:  # noqa: BLE001 — drop this batch
                     sem.release()
                     self.router.discard(plan, kind=classify(e, "dispatch"))
@@ -829,6 +1020,9 @@ class ServeFrontend:
                     continue
                 self._window.remove(seq)
                 sem.release()
+                self.tracer.complete("batch_complete", _t0, time.time(),
+                                     TRACK_DEVICE, seq=seq,
+                                     frames=plan.valid)
                 self.router.route(plan, out)
                 # A materialized batch is proof of engine progress: the
                 # consecutive-stall escalation counter starts over.
@@ -849,6 +1043,12 @@ class ServeFrontend:
             "sessions": session_stats,
             "open_sessions": len(live),
             "retired_sessions": len(retired),
+            # Standing work ahead of the device (queued frames) plus
+            # batches in flight — the scrape endpoint's queue-depth
+            # series and the fleet row's per-replica signal.
+            "queue_depth": sum(len(s.ingress) + len(s.pending)
+                               for s in live.values()),
+            "inflight_batches": len(self._window),
             "draining": self._draining,
             "admission_rejections": self.admission_rejections,
             # Sum of the per-session counters (covers deadline sheds AND
@@ -879,6 +1079,11 @@ class ServeFrontend:
                 }} if self._supervisor is not None else {}),
             **({"chaos": self.config.chaos.summary()}
                if self.config.chaos is not None else {}),
+            **({"trace": {"events": len(self.tracer),
+                          "dropped_total": self.tracer.dropped}}
+               if self.tracer.enabled else {}),
+            **({"flight": self.flight.stats()}
+               if self.flight is not None else {}),
         }
 
 

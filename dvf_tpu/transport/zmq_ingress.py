@@ -30,8 +30,10 @@ from typing import Optional
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
+from dvf_tpu.obs.export import attach_signal_provider
 from dvf_tpu.obs.metrics import EgressStats, IngestStats
-from dvf_tpu.obs.trace import EGRESS_SEND
+from dvf_tpu.obs.registry import MetricsRegistry
+from dvf_tpu.obs.trace import EGRESS_SEND, Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.runtime.egress import (
@@ -109,6 +111,7 @@ class TpuZmqWorker:
         fault_window_s: float = 30.0,
         chaos=None,
         tracer=None,
+        trace: bool = False,
         wire: Optional[str] = None,
         delta_tile: int = 32,
         delta_keyframe_interval: int = 16,
@@ -194,8 +197,15 @@ class TpuZmqWorker:
         self.ingest_depth = ingest_depth
         self.egress = egress
         self.egress_depth = egress_depth
-        self.tracer = tracer  # optional obs.trace.Tracer: egress_encode /
-        #   egress_send spans land on track 0 when enabled
+        # The worker's own trace lane (bounded ring, obs.trace): batch
+        # spans + egress_encode/egress_send land on track 0; the
+        # snapshot merges into a fleet-wide Perfetto session like every
+        # other tier's. A caller-built tracer still wins (tests).
+        self.tracer = (tracer if tracer is not None
+                       else Tracer(enabled=trace, process_name="worker"))
+        # Metrics registry for the worker's --metrics-port endpoint.
+        self.registry = MetricsRegistry()
+        attach_signal_provider(self.registry, "worker", self.signals)
         self.faults = FaultStats()
         self.fault_budget = fault_budget
         self.fault_window_s = fault_window_s
@@ -561,6 +571,8 @@ class TpuZmqWorker:
             out = np.asarray(result)
         self._egress_seq += 1
         t1 = time.time()
+        self.tracer.complete("batch_complete", t0, t1, 0,
+                             frames=valid, batch=self.batches)
         plane = self._plane_for()
         plane.submit([out[i] for i in range(valid)],
                      [(idx, t0, t1) for idx in indices],
@@ -764,6 +776,30 @@ class TpuZmqWorker:
                   "streamed → monolithic", file=sys.stderr, flush=True)
             return True
         return False
+
+    def signals(self) -> dict:
+        """Flat load-control signal row (registry-conformant keys) — the
+        worker's half of the telemetry plane, scraped by the
+        ``--metrics-port`` endpoint's provider."""
+        out = {
+            "frames_total": float(self.frames_processed),
+            "batches_total": float(self.batches),
+            "errors_total": float(self.errors),
+            # Ring transport only: the list-mode backlog lives in the
+            # run loop's local `pending`, invisible here — report a GAP
+            # (None, dropped by the adapter), never a fake healthy 0.
+            "queue_depth": (float(len(self._ring))
+                            if self._ring is not None else None),
+            "trace_dropped_total": float(self.tracer.dropped),
+        }
+        ing, egr = self._ingest_stats, self._egress_stats
+        if ing is not None:
+            out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
+        if egr is not None:
+            out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        for kind, n in self.faults.summary()["by_kind"].items():
+            out[f"fault_{kind}_total"] = float(n)
+        return out
 
     def stats(self) -> dict:
         """Counters for tests/operators (the worker's run loop prints

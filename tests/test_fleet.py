@@ -263,6 +263,19 @@ class TestProcessFleet:
             fleet.submit(c, tagged_frame(2, 0))
             deliveries["C"] = []
             drain_fleet(fleet, ["C"], deliveries, 1, grace_s=20.0)
+            if kill_victim:
+                # The respawn is asynchronous supervision (monitor
+                # thread blocks in start() for the worker's ready
+                # handshake, ~2-3 s of fresh jax init): like the
+                # migration wait above, converge before snapshotting —
+                # a fast test body must not race the restart it asserts.
+                deadline = time.time() + 60
+                while (time.time() < deadline
+                       and not any(row["restarts"] >= 1
+                                   and row["state"] == HEALTHY
+                                   for row in fleet.stats()
+                                   ["replicas"].values())):
+                    time.sleep(0.1)
             stats = fleet.stats()
         return deliveries, stats
 

@@ -307,7 +307,7 @@ class TestRingTransportPipeline:
         assert stats["dropped_at_ingest"] > 0
         # Delivery stays ordered even with drops (gaps allowed).
         # (CapturingSink wasn't used here; order is covered above.)
-        assert stats["delivered"] + stats["dropped_at_ingest"] <= stats["total_frames_produced"]
+        assert stats["delivered"] + stats["dropped_at_ingest"] <= stats["frames_produced_total"]
 
 
 class TestInlineCollectMode:
