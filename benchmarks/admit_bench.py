@@ -1,0 +1,344 @@
+"""Admission-cost benchmark: the JIT stall vs the warm-start ladder.
+
+Measures what a tenant pays to ADMIT a signature on the multi-tenant
+frontend, end to end through ``open_stream``:
+
+- **cold**: a signature this process has never compiled — bucket
+  creation runs the full trace + XLA compile + warmup/calibration at
+  admission (the stall that used to land on the serving path at the
+  first frame; here it is at least off the hot path, and bounded below).
+- **bucket join**: a second session of a live signature — a dict route.
+- **pool hit**: a RETURNING signature whose bucket retired but whose
+  compiled program stayed warm in the ``ProgramPool`` LRU — the
+  bucket-churn case a real mixed fleet lives in.
+- **persistent cache** (subprocess leg): the same compile in a fresh
+  process with ``JAX_COMPILATION_CACHE_DIR`` armed — cold populates the
+  cache, the re-run deserializes instead of recompiling. This is the
+  process-restart / replica-respawn / pool-evicted warm-start.
+
+Plus the **mixed-workload ratio**: two signatures driven at a fixed
+offered rate, solo vs together on one frontend — the acceptance bar is
+that the mix sustains ≥ 80% of the sum of the solo throughputs (paced
+below device saturation, so the number isolates multi-bucket scheduling
+overhead: program switching, per-bucket staging, EDF/cost picking —
+not raw capacity).
+
+Writes benchmarks/ADMIT_BENCH.json. CPU-runnable; the same harness
+reports TPU numbers when run inside a TPU window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _median(xs):
+    return statistics.median(xs) if xs else None
+
+
+# ---------------------------------------------------------------------------
+# Admission ladder
+# ---------------------------------------------------------------------------
+
+
+def bench_admission(height=96, width=96, batch=4, cycles=3,
+                    op_chain="gaussian_blur(ksize=9)|invert"):
+    """Cold / bucket-join / pool-hit admission, one frontend.
+
+    Distinct geometries make each cold sample a genuinely fresh
+    compile; the pool-hit samples churn TWO signatures through a
+    2-bucket cap so every re-open is an LRU hit behind a bucket
+    retirement (the returning-tenant path)."""
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    cold_ms = []
+    fe = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=batch, max_sessions=64, max_buckets=8,
+                    pool_capacity=16, slo_ms=60_000.0))
+    with fe:
+        sigs = [(op_chain, (height + 8 * i, width, 3)) for i in range(3)]
+        sids = {}
+        for chain, shape in sigs:
+            t0 = time.perf_counter()
+            sids[shape] = fe.open_stream(op_chain=chain, frame_shape=shape)
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+        # Bucket join: one more session of a live signature.
+        join_ms = []
+        for chain, shape in sigs:
+            t0 = time.perf_counter()
+            sid = fe.open_stream(op_chain=chain, frame_shape=shape)
+            join_ms.append((time.perf_counter() - t0) * 1e3)
+            fe.close(sid, drain=False)
+        pool_stats_mid = fe.stats()["pool"]
+
+    # Pool hit behind bucket churn: cap of 2 buckets, two signatures
+    # alternating — after the first cycle every open retires the idle
+    # other bucket and leases its program back out of the pool.
+    fe2 = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=batch, max_sessions=64, max_buckets=2,
+                    pool_capacity=8, slo_ms=60_000.0))
+    hit_ms = []
+    with fe2:
+        a = (op_chain, (height, width, 3))
+        b = ("grayscale|invert", (height, width, 3))
+        for chain, shape in (a, b):   # populate the pool (cold)
+            sid = fe2.open_stream(op_chain=chain, frame_shape=shape)
+            fe2.close(sid, drain=False)
+            fe2._finalize_drained()
+        for _ in range(cycles):
+            for chain, shape in (a, b):
+                t0 = time.perf_counter()
+                sid = fe2.open_stream(op_chain=chain, frame_shape=shape)
+                hit_ms.append((time.perf_counter() - t0) * 1e3)
+                fe2.close(sid, drain=False)
+                fe2._finalize_drained()
+        pool_stats = fe2.stats()["pool"]
+
+    cold = _median(cold_ms)
+    hit = _median(hit_ms)
+    return {
+        "op_chain": op_chain,
+        "batch": batch,
+        "cold_admit_ms": cold,
+        "cold_samples_ms": [round(x, 3) for x in cold_ms],
+        "bucket_join_ms": _median(join_ms),
+        "pool_hit_admit_ms": hit,
+        "pool_hit_samples_ms": [round(x, 3) for x in hit_ms],
+        "warm_vs_cold_speedup": (cold / hit) if (cold and hit) else None,
+        "pool": {k: pool_stats[k] for k in ("hits", "misses", "evictions")},
+        "first_frontend_pool": pool_stats_mid,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Persistent-cache leg (fresh process per sample)
+# ---------------------------------------------------------------------------
+
+
+def _child_compile_ms(cache_dir, op_chain, shape, batch):
+    """One Engine.compile in a FRESH python process with the persistent
+    cache armed at ``cache_dir``; returns wall ms (None on failure)."""
+    spec = json.dumps({"op_chain": op_chain, "shape": list(shape),
+                       "batch": batch})
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               JAX_COMPILATION_CACHE_DIR=cache_dir,
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-compile", spec],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=os.path.dirname(_HERE))
+        return json.loads(out.stdout.strip().splitlines()[-1])["compile_ms"]
+    except Exception as e:  # noqa: BLE001 — best-effort leg
+        print(f"[admit_bench] persistent-cache child failed: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def bench_persistent_cache(height=96, width=96, batch=4,
+                           op_chain="gaussian_blur(ksize=9)|invert"):
+    """Process-restart warm-start: compile cold into an empty cache dir,
+    then re-compile in a second fresh process against the populated
+    cache (what a replica respawn or pool-evicted re-admission pays)."""
+    with tempfile.TemporaryDirectory(prefix="dvf-admit-cache-") as d:
+        cold = _child_compile_ms(d, op_chain, (height, width, 3), batch)
+        warm = _child_compile_ms(d, op_chain, (height, width, 3), batch)
+    return {
+        "cold_compile_ms": cold,
+        "cache_warm_compile_ms": warm,
+        "cache_vs_cold_speedup": (cold / warm) if (cold and warm) else None,
+    }
+
+
+def _run_child_compile(spec_json):
+    t_import = time.perf_counter()
+    from dvf_tpu.runtime.engine import Engine
+    from dvf_tpu.runtime.signature import build_filter
+
+    spec = json.loads(spec_json)
+    filt = build_filter(spec["op_chain"])
+    engine = Engine(filt, op_chain=spec["op_chain"])
+    t0 = time.perf_counter()
+    engine.compile((spec["batch"], *spec["shape"]), np.uint8)
+    dt = (time.perf_counter() - t0) * 1e3
+    print(json.dumps({"compile_ms": dt,
+                      "import_ms": (t0 - t_import) * 1e3}))
+
+
+# ---------------------------------------------------------------------------
+# Mixed-workload throughput ratio
+# ---------------------------------------------------------------------------
+
+
+def _drive_paced(fe, sid, frame, n_frames, rate_fps):
+    period = 1.0 / rate_fps
+    nxt = time.perf_counter()
+    for _ in range(n_frames):
+        fe.submit(sid, frame)
+        nxt += period
+        dt = nxt - time.perf_counter()
+        if dt > 0:
+            time.sleep(dt)
+
+
+def _run_sessions(filt_default, specs, rate_fps, n_frames, batch):
+    """Run one frontend with ``specs`` sessions paced at ``rate_fps``
+    each; returns achieved fps per spec (delivered / wall)."""
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    fe = ServeFrontend(
+        filt_default,
+        ServeConfig(batch_size=batch, max_sessions=16, max_buckets=4,
+                    queue_size=2000, out_queue_size=4096,
+                    slo_ms=60_000.0))
+    fps = {}
+    with fe:
+        sids = []
+        frames = []
+        for chain, shape in specs:
+            sids.append(fe.open_stream(op_chain=chain, frame_shape=shape))
+            rng = np.random.default_rng(len(sids))
+            frames.append(rng.integers(0, 255, shape, dtype=np.uint8))
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=_drive_paced,
+                                    args=(fe, sid, frm, n_frames, rate_fps))
+                   for sid, frm in zip(sids, frames)]
+        for t in threads:
+            t.start()
+        delivered = {sid: 0 for sid in sids}
+        deadline = time.time() + n_frames / rate_fps + 60.0
+        while time.time() < deadline:
+            moved = 0
+            for sid in sids:
+                got = len(fe.poll(sid))
+                delivered[sid] += got
+                moved += got
+            if all(not t.is_alive() for t in threads) \
+                    and all(delivered[s] >= n_frames or moved == 0
+                            for s in sids):
+                st = fe.stats()["sessions"]
+                if all(st[s]["inflight"] == 0
+                       and st[s]["delivered"] + st[s]["shed"]
+                       + st[s]["failed"] + st[s]["dropped_at_ingress"]
+                       >= st[s]["submitted"] for s in sids):
+                    for sid in sids:
+                        delivered[sid] += len(fe.poll(sid))
+                    break
+            time.sleep(0.002)
+        wall = time.perf_counter() - t_start
+        for (chain, shape), sid in zip(specs, sids):
+            fps[f"{chain}@{shape[0]}x{shape[1]}"] = delivered[sid] / wall
+    return fps
+
+
+def bench_mixed(rate_fps=120.0, n_frames=360, batch=4,
+                size_a=(128, 128, 3), size_b=(96, 96, 3)):
+    """Two signatures at a paced offered rate, solo vs mixed on one
+    frontend/device. Paced well under device capacity, so the ratio
+    isolates the cost of bucket switching (two compiled programs
+    alternating on one device + per-bucket staging), not raw compute."""
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.runtime.signature import build_filter
+
+    sig_a = ("invert", tuple(size_a))
+    sig_b = ("grayscale|invert", tuple(size_b))
+    solo_a = _run_sessions(get_filter("invert"), [sig_a], rate_fps,
+                           n_frames, batch)
+    solo_b = _run_sessions(build_filter(sig_b[0]), [sig_b], rate_fps,
+                           n_frames, batch)
+    mixed = _run_sessions(get_filter("invert"), [sig_a, sig_b], rate_fps,
+                          n_frames, batch)
+    solo_sum = sum(solo_a.values()) + sum(solo_b.values())
+    mixed_sum = sum(mixed.values())
+    return {
+        "offered_fps_per_signature": rate_fps,
+        "frames_per_signature": n_frames,
+        "solo_fps": {"by_signature": {**solo_a, **solo_b}},
+        "mixed_fps": {"by_signature": mixed},
+        "solo_sum_fps": solo_sum,
+        "mixed_sum_fps": mixed_sum,
+        "mixed_over_solo_ratio": (mixed_sum / solo_sum) if solo_sum else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick=False):
+    """The full bench document (ADMIT_BENCH.json). ``quick`` shrinks
+    every leg for the tier-1 schema test (seconds, not minutes)."""
+    import jax
+
+    if quick:
+        admission = bench_admission(height=16, width=24, batch=2, cycles=1,
+                                    op_chain="invert")
+        cache = {"cold_compile_ms": None, "cache_warm_compile_ms": None,
+                 "cache_vs_cold_speedup": None}
+        mixed = bench_mixed(rate_fps=200.0, n_frames=30, batch=2,
+                            size_a=(16, 24, 3), size_b=(16, 16, 3))
+    else:
+        admission = bench_admission()
+        cache = bench_persistent_cache()
+        mixed = bench_mixed()
+    return {
+        "schema": "dvf.admit_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "device_count": jax.device_count(),
+        "admission": admission,
+        "persistent_cache": cache,
+        "mixed": mixed,
+        "acceptance": {
+            "warm_admit_speedup_target": 10.0,
+            "warm_admit_speedup_measured":
+                admission.get("warm_vs_cold_speedup"),
+            "target_mixed_over_solo_ratio": 0.8,
+            "measured_mixed_over_solo_ratio":
+                mixed.get("mixed_over_solo_ratio"),
+        },
+    }
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--child-compile":
+        _run_child_compile(argv[1])
+        return 0
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    out_path = os.path.join(_HERE, "ADMIT_BENCH.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, default=float)
+        f.write("\n")
+    acc = doc["acceptance"]
+    print(f"[admit_bench] cold {doc['admission']['cold_admit_ms']:.1f} ms "
+          f"→ pool-hit {doc['admission']['pool_hit_admit_ms']:.2f} ms "
+          f"({acc['warm_admit_speedup_measured']:.0f}x, target "
+          f"{acc['warm_admit_speedup_target']:.0f}x); mixed/solo "
+          f"{acc['measured_mixed_over_solo_ratio']:.2f} (target "
+          f"{acc['target_mixed_over_solo_ratio']}); wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

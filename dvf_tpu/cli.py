@@ -278,6 +278,30 @@ def _parse_chaos(args):
         raise SystemExit(f"error: bad --chaos spec: {e}")
 
 
+def _arm_compile_cache(args):
+    """``--compile-cache-dir``: arm jax's persistent compilation cache
+    (AOT warm-start across process restarts and pool evictions).
+    Returns the directory armed, or None when the flag was absent."""
+    val = getattr(args, "compile_cache_dir", None)
+    if val is None:
+        return None
+    from dvf_tpu.runtime.engine import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache(val or None)
+    print(f"[serve] persistent compilation cache: {cache_dir}",
+          file=sys.stderr)
+    return cache_dir
+
+
+def _load_manifest(path):
+    """Read a ``--precompile`` manifest (JSON list of signature
+    entries); None when no path was given."""
+    if not path:
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def _cmd_serve_multi(args, filt, engine) -> int:
     """Local multi-stream demo: N synthetic client streams at different
     frame rates multiplexed through ONE shared engine by the serving
@@ -308,6 +332,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
     config = ServeConfig(
         batch_size=args.batch,
         max_sessions=args.max_sessions if args.max_sessions else max(16, n),
+        max_buckets=args.max_buckets,
+        pool_capacity=args.pool_capacity,
         queue_size=args.queue_size,
         slo_ms=args.slo_ms,
         frame_delay=args.frame_delay,
@@ -328,6 +354,11 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
     )
     frontend = ServeFrontend(filt, config, engine=engine)
+    manifest = _load_manifest(args.precompile)
+    if manifest is not None:
+        warmed = frontend.precompile(manifest)
+        print(f"[serve] precompiled {len(warmed)} signature(s): "
+              f"{', '.join(warmed)}", file=sys.stderr)
     exporter = _start_exporter(args, frontend.registry,
                                health_fn=frontend.health,
                                ring=frontend.telemetry)
@@ -402,6 +433,7 @@ def _cmd_serve_multi(args, filt, engine) -> int:
 
 def cmd_serve(args) -> int:
     _force_platform()
+    _arm_compile_cache(args)
 
     import signal
 
@@ -661,9 +693,12 @@ def cmd_fleet(args) -> int:
         filter_spec = (name,
                        json.loads(args.filter_config)
                        if args.filter_config else {})
+    cache_dir = _arm_compile_cache(args)
     serve_cfg = ServeConfig(
         batch_size=args.batch,
         max_sessions=args.max_sessions if args.max_sessions else max(16, args.sessions),
+        max_buckets=args.max_buckets,
+        pool_capacity=args.pool_capacity,
         queue_size=args.queue_size,
         slo_ms=args.slo_ms,
         ingest=args.ingest,
@@ -687,6 +722,13 @@ def cmd_fleet(args) -> int:
         devices_per_replica=args.devices_per_replica,
         flight_dir=args.flight_dir,
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
+        precompile=_load_manifest(args.precompile),
+        # Process-mode replicas share the persistent compilation cache
+        # through the env — a respawned replica's recompiles become
+        # cache deserializes (the fleet half of the AOT warm-start).
+        replica_env=({"JAX_COMPILATION_CACHE_DIR": os.path.abspath(cache_dir),
+                      "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0"}
+                     if cache_dir else {}),
     )
 
     n = args.sessions
@@ -1368,6 +1410,37 @@ def main(argv=None) -> int:
                            "/timeseries on 127.0.0.1:PORT (0 = ephemeral; "
                            "the bound port is announced on stderr)")
 
+    # Shared by serve + fleet: the multi-signature serving plane
+    # (signature buckets, compiled-program pool, AOT warm-start).
+    sig = argparse.ArgumentParser(add_help=False)
+    sig.add_argument("--max-buckets", type=int, default=4,
+                     help="live signature buckets per frontend — how many "
+                          "distinct (op_chain, geometry, dtype) mixes one "
+                          "frontend serves concurrently (beyond it, a new "
+                          "signature first retires an idle bucket, else is "
+                          "refused with the warm-signature list)")
+    sig.add_argument("--pool-capacity", type=int, default=8,
+                     help="compiled-program pool bound (LRU): how many "
+                          "signatures stay warm on device; eviction frees "
+                          "device buffers, re-admission recompiles through "
+                          "the persistent compilation cache")
+    sig.add_argument("--precompile", default=None, metavar="MANIFEST",
+                     help="JSON manifest of signatures to AOT-compile "
+                          "before serving ([{\"op_chain\": \"invert\", "
+                          "\"frame_shape\": [H, W, 3], \"dtype\": "
+                          "\"uint8\"}, ...] — see docs/GUIDE.md 'Serving "
+                          "a mixed workload'): each warms the program "
+                          "pool AND the persistent cache, so its first "
+                          "real admission is milliseconds")
+    sig.add_argument("--compile-cache-dir", default=None, nargs="?",
+                     const="", metavar="DIR",
+                     help="arm jax's persistent compilation cache here "
+                          "(bare flag = the default .jax_compile_cache/, "
+                          "gitignored, size-bounded): recompiles across "
+                          "process restarts / pool evictions become cache "
+                          "deserializes; process-mode fleet replicas "
+                          "inherit it via JAX_COMPILATION_CACHE_DIR")
+
     fp = sub.add_parser("filters", help="list registered filters")
     fp.add_argument("-v", "--verbose", action="store_true",
                     help="include each filter's one-line description")
@@ -1377,7 +1450,7 @@ def main(argv=None) -> int:
     dp_.add_argument("--probe-timeout", type=float, default=60.0,
                      help="seconds before declaring the backend unreachable")
 
-    sp = sub.add_parser("serve", parents=[plat, ing, res, obsp],
+    sp = sub.add_parser("serve", parents=[plat, ing, res, obsp, sig],
                         help="run the pipeline")
     sp.add_argument("--flight-dir", default=None, metavar="DIR",
                     help="arm the SLO flight recorder (--sessions mode): "
@@ -1466,7 +1539,7 @@ def main(argv=None) -> int:
                          "(0 = max(16, --sessions))")
 
     fl = sub.add_parser(
-        "fleet", parents=[plat, ing, res, obsp],
+        "fleet", parents=[plat, ing, res, obsp, sig],
         help="multi-replica serving: N engines behind one front door")
     fl.add_argument("--trace", action="store_true",
                     help="arm per-replica tracers (bounded event rings); "

@@ -75,6 +75,11 @@ def main(argv=None) -> int:
                               cfg.get("chaos_seed", 0),
                               cfg.get("replica_id", args.replica_id)),
             ).start()
+            if cfg.get("precompile"):
+                # AOT warm-start before taking traffic (also runs on a
+                # RESPAWN — wire_config persists, so a replaced replica
+                # comes back warm through the persistent cache).
+                frontend.precompile(cfg["precompile"])
         except Exception as e:  # noqa: BLE001 — startup failure → parent
             send_msg(sock, ("err", type(e).__name__, str(e)))
             return 2
@@ -106,12 +111,19 @@ def main(argv=None) -> int:
                     send_msg(sock, ("ok", None))
                     break
                 elif kind == "open":
-                    _, sid, slo_ms, frame_shape, frame_dtype = op
+                    # 6-tuple since the multi-signature frontend (the
+                    # trailing op_chain); a 5-tuple from an older parent
+                    # still opens on the default bucket.
+                    _, sid, slo_ms, frame_shape, frame_dtype = op[:5]
+                    op_chain = op[5] if len(op) > 5 else None
+                    # The dtype crosses the wire as its original
+                    # SPELLING; the frontend canonicalizes (np.dtype
+                    # here would read "u8" as uint64).
                     out = frontend.open_stream(
                         session_id=sid, slo_ms=slo_ms,
                         frame_shape=frame_shape,
-                        frame_dtype=(np.dtype(frame_dtype)
-                                     if frame_dtype else None))
+                        frame_dtype=frame_dtype or None,
+                        op_chain=op_chain)
                 elif kind == "poll":
                     _, sid, max_items, meta_only = op
                     got = frontend.poll(sid, max_items)

@@ -137,7 +137,7 @@ class ReplicaHandle:
 
     # serving ops (any may raise ReplicaLostError)
     def open_stream(self, session_id, slo_ms=None, frame_shape=None,
-                    frame_dtype=None) -> str:
+                    frame_dtype=None, op_chain=None) -> str:
         raise NotImplementedError
 
     def submit(self, session_id, frame, ts=None, tag=None) -> None:
@@ -229,10 +229,11 @@ class LocalReplica(ReplicaHandle):
         return self.frontend
 
     def open_stream(self, session_id, slo_ms=None, frame_shape=None,
-                    frame_dtype=None) -> str:
+                    frame_dtype=None, op_chain=None) -> str:
         return self._fe().open_stream(
             session_id=session_id, slo_ms=slo_ms,
-            frame_shape=frame_shape, frame_dtype=frame_dtype)
+            frame_shape=frame_shape, frame_dtype=frame_dtype,
+            op_chain=op_chain)
 
     def submit(self, session_id, frame, ts=None, tag=None) -> int:
         return self._fe().submit(session_id, frame, ts=ts, tag=tag)
@@ -472,10 +473,10 @@ class ProcessReplica(ReplicaHandle):
                     f"replica {self.id}: send {op[0]!r} failed: {e!r}")
 
     def open_stream(self, session_id, slo_ms=None, frame_shape=None,
-                    frame_dtype=None) -> str:
+                    frame_dtype=None, op_chain=None) -> str:
         return self._rpc(("open", session_id, slo_ms, frame_shape,
                           str(frame_dtype) if frame_dtype is not None
-                          else None))
+                          else None, op_chain))
 
     def submit(self, session_id, frame, ts=None, tag=None) -> None:
         self._send_only(("submit1", session_id, frame, ts, tag))

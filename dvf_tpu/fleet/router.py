@@ -125,6 +125,11 @@ class FleetConfig:
     #   loss or a replica-side watchdog trip (stalls delta in health())
     #   dumps merged per-replica traces + fleet stats here. None = off.
     flight_min_interval_s: float = 10.0
+    precompile: Optional[list] = None  # --precompile manifest entries
+    #   (runtime.signature.parse_manifest input): every replica AOT-
+    #   compiles these at start — and again at RESPAWN, where the
+    #   persistent compilation cache turns it into deserializes — so
+    #   each signature's first real admission fleet-wide is a pool hit
 
 
 class _FleetSession:
@@ -133,11 +138,11 @@ class _FleetSession:
 
     __slots__ = ("sid", "replica_id", "replica_sid", "generation",
                  "next_index", "last_index", "slo_ms", "frame_shape",
-                 "frame_dtype", "lock", "tail", "migrations", "lost",
-                 "polled", "closed", "orphaned", "load_counted")
+                 "frame_dtype", "op_chain", "lock", "tail", "migrations",
+                 "lost", "polled", "closed", "orphaned", "load_counted")
 
     def __init__(self, sid: str, replica_id: str, slo_ms, frame_shape,
-                 frame_dtype):
+                 frame_dtype, op_chain=None):
         self.sid = sid
         self.replica_id = replica_id
         self.replica_sid = sid           # sid@gN after migrations
@@ -147,6 +152,8 @@ class _FleetSession:
         self.slo_ms = slo_ms
         self.frame_shape = frame_shape   # declared at open (may be None)
         self.frame_dtype = frame_dtype
+        self.op_chain = op_chain         # declared chain — a migration
+        #   re-declares it so the survivor routes to the same bucket
         self.lock = threading.Lock()
         self.tail: List[Delivery] = []   # salvaged pre-migration deliveries
         self.migrations = 0
@@ -227,6 +234,15 @@ class FleetFrontend:
                 stats_fn=self.stats,
                 ring=self.telemetry)
         self._stalls_seen: Dict[str, int] = {}
+        # Per-replica warm-signature sets (canonical renders), fed by
+        # the health monitor from each replica's health() export and
+        # updated optimistically at successful declared opens — what
+        # makes spillover admission SIGNATURE-AWARE: a declared open
+        # prefers a replica whose pool already holds the program.
+        self._warm: Dict[str, List[str]] = {}
+        from dvf_tpu.runtime.signature import canonical_op_chain_or_verbatim
+
+        self._default_chain = canonical_op_chain_or_verbatim(self.filter.name)
         # Last-seen per-replica delivered_total: a transiently missing
         # export (busy channel → stats lock_timeout, replica mid-drain)
         # must not dip the fleet's delivered counter for one scrape —
@@ -263,6 +279,7 @@ class FleetFrontend:
                     "chaos_spec": self.config.chaos_spec,
                     "chaos_seed": self.config.chaos_seed + index,
                     "cpu_affinity": affinity,
+                    "precompile": self.config.precompile,
                 },
                 env=self.config.replica_env,
                 startup_timeout_s=self.config.startup_timeout_s,
@@ -300,7 +317,10 @@ class FleetFrontend:
             engine = Engine(self.filter,
                             mesh=make_mesh(auto_mesh_config(len(chunk)),
                                            devices=chunk))
-            return ServeFrontend(self.filter, scfg, engine=engine).start()
+            fe = ServeFrontend(self.filter, scfg, engine=engine).start()
+            if config.precompile:
+                fe.precompile(config.precompile)
+            return fe
 
         return make
 
@@ -365,10 +385,19 @@ class FleetFrontend:
         slo_ms: Optional[float] = None,
         frame_shape: Optional[tuple] = None,
         frame_dtype: Any = None,
+        op_chain: Optional[str] = None,
     ) -> str:
-        """Admit one stream on the least-loaded healthy replica,
-        spilling over when a replica's own gate refuses; raises
-        ``AdmissionError`` only when every healthy replica has."""
+        """Admit one stream, signature-aware: a declared
+        ``(op_chain, frame_shape, frame_dtype)`` prefers a replica whose
+        program pool is already WARM for that canonical key (admission
+        is a pool hit, not a compile), then least-loaded; cold admits
+        and undeclared opens place least-loaded-first exactly as
+        before. Spills over when a replica's own gate refuses; raises
+        ``AdmissionError`` only when every healthy replica has — and
+        the rejection enumerates the signatures the fleet CAN serve
+        cheaply."""
+        key_render = self._signature_render(op_chain, frame_shape,
+                                            frame_dtype)
         with self._open_lock:
             sid = (session_id if session_id is not None
                    else f"fs{next(self._ids)}")
@@ -376,8 +405,10 @@ class FleetFrontend:
                 if sid in self._sessions or sid in self._retired:
                     raise ServeError(f"session id {sid!r} already exists")
                 load = dict(self._load)
+                warm = {rid: list(v) for rid, v in self._warm.items()}
             cands = self.admission.candidates(
-                list(self._replicas.values()), load)
+                list(self._replicas.values()), load,
+                warm=warm, key=key_render)
             if not cands:
                 self.admission.record_rejection()
                 raise AdmissionError("no healthy replicas in the fleet")
@@ -388,7 +419,8 @@ class FleetFrontend:
                 try:
                     r.open_stream(sid, slo_ms=slo_ms,
                                   frame_shape=frame_shape,
-                                  frame_dtype=frame_dtype)
+                                  frame_dtype=frame_dtype,
+                                  op_chain=op_chain)
                 except AdmissionError as e:
                     last_refusal = e
                     hops += 1
@@ -399,8 +431,19 @@ class FleetFrontend:
                     continue
                 if hops:
                     self.admission.record_spillover(hops)
+                if key_render is not None:
+                    if key_render in set(warm.get(r.id) or ()):
+                        self.admission.record_warm_placement()
+                    with self._lock:
+                        # Optimistic warm update: the replica compiled
+                        # (or pool-hit) this signature just now — don't
+                        # wait one health-poll period to route follow-up
+                        # opens of the same key here.
+                        kn = self._warm.setdefault(r.id, [])
+                        if key_render not in kn:
+                            kn.append(key_render)
                 s = _FleetSession(sid, r.id, slo_ms, frame_shape,
-                                  frame_dtype)
+                                  frame_dtype, op_chain=op_chain)
                 with self._lock:
                     self._sessions[sid] = s
                     self._load[r.id] = self._load.get(r.id, 0) + 1
@@ -415,7 +458,32 @@ class FleetFrontend:
             self.admission.record_rejection()
             raise AdmissionError(
                 f"every healthy replica refused this stream "
-                f"({len(cands)} tried; last refusal: {last_refusal})")
+                f"({len(cands)} tried; last refusal: {last_refusal}); "
+                f"warm signatures across the fleet: "
+                f"{self._fleet_warm_signatures()}")
+
+    def _signature_render(self, op_chain, frame_shape, frame_dtype
+                          ) -> Optional[str]:
+        """Canonical render of a declared signature (the warm-set match
+        key); None when undeclared or unparseable (placement falls back
+        to pure least-loaded — never a refusal from here)."""
+        if frame_shape is None:
+            return None
+        try:
+            from dvf_tpu.runtime.signature import make_key
+
+            return make_key(
+                op_chain if op_chain is not None else self._default_chain,
+                frame_shape, frame_dtype).render()
+        except (ValueError, TypeError):
+            return None
+
+    def _fleet_warm_signatures(self) -> List[str]:
+        with self._lock:
+            out = set()
+            for keys in self._warm.values():
+                out.update(keys)
+        return sorted(out)
 
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
@@ -615,6 +683,13 @@ class FleetFrontend:
                                       f"{h.get('error')}"),
                         reachable=True)
                     continue
+                # Replica-side truth about warm signatures (its program
+                # pool + live buckets) refreshes the fleet's placement
+                # map — the optimistic per-open updates converge to this.
+                warm = h.get("warm_signatures")
+                if warm is not None:
+                    with self._lock:
+                        self._warm[r.id] = list(warm)
                 # Replica-side watchdog trips surface in the health
                 # export's stalls counter; a rising watermark is the
                 # fleet-level flight trigger — the replica recovered on
@@ -639,6 +714,8 @@ class FleetFrontend:
                 return  # already handled (or permanently dead)
             r.state = DRAINING
             self.replica_losses += 1
+            with self._lock:
+                self._warm.pop(r.id, None)  # its pool is gone with it
             self.faults.record(FaultKind.REPLICA, exc, replica=r.id)
             self.tracer.instant("replica_lost", track=0, replica=r.id,
                                 error=repr(exc))
@@ -673,6 +750,9 @@ class FleetFrontend:
                         self._stalls_seen.pop(r.id, None)
                         with self._lock:
                             self._delivered_seen.pop(r.id, None)
+                            # Fresh frontend, empty pool: nothing is
+                            # warm there until health says otherwise.
+                            self._warm.pop(r.id, None)
                         last = None
                         break
                     except Exception as e:  # noqa: BLE001 — judged below
@@ -731,14 +811,18 @@ class FleetFrontend:
             if not orphan:
                 with self._lock:
                     load = dict(self._load)
+                    warm = {rid: list(v) for rid, v in self._warm.items()}
                 for target in self.admission.candidates(
                         list(self._replicas.values()), load,
-                        exclude={old.id}):
+                        exclude={old.id}, warm=warm,
+                        key=self._signature_render(
+                            s.op_chain, s.frame_shape, s.frame_dtype)):
                     new_sid = f"{s.sid}@g{s.generation + 1}"
                     try:
                         target.open_stream(new_sid, slo_ms=s.slo_ms,
                                            frame_shape=s.frame_shape,
-                                           frame_dtype=s.frame_dtype)
+                                           frame_dtype=s.frame_dtype,
+                                           op_chain=s.op_chain)
                     except (AdmissionError, ReplicaLostError):
                         continue
                     self._uncount_load(s)
@@ -817,6 +901,7 @@ class FleetFrontend:
         with self._lock:
             sessions = {**self._retired, **self._sessions}
             load = dict(self._load)
+            warm = {rid: list(keys) for rid, keys in self._warm.items()}
         replica_rows = {}
         for rid, r in self._replicas.items():
             row = replica_row(r, exports.get(rid), load.get(rid, 0))
@@ -856,6 +941,9 @@ class FleetFrontend:
             "migrated_sessions": self.migrated_sessions,
             "orphaned_sessions": self.orphaned_sessions,
             "order_violations": self.order_violations,
+            # Per-replica warm-signature map (the placement input): what
+            # each replica's pool serves without a compile.
+            "warm_replicas": warm,
             **self.admission.stats(),
             "faults": merge_fault_summaries(
                 self.faults.summary(),

@@ -86,7 +86,8 @@ def check_metric_name(name: str) -> Optional[str]:
 DYNAMIC_KEY_PARENTS = frozenset({
     "sessions", "by_kind", "by_replica", "last", "replicas", "recoveries",
     "faults", "heartbeat_ages_s", "chaos", "rules", "fired", "polled",
-    "rates", "series", "configs", "rounds", "trials",
+    "rates", "series", "configs", "rounds", "trials", "buckets",
+    "warm_replicas", "by_signature",
 })
 
 

@@ -24,10 +24,13 @@ Key TPU-first choices:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import os
 import sys
+import threading
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,10 +66,18 @@ class Engine:
         mesh: Optional[Mesh] = None,
         out_uint8: bool = True,
         chaos=None,
+        op_chain: Optional[str] = None,
     ):
         self.filter = filt
         self.mesh = mesh if mesh is not None else make_mesh()
         self.out_uint8 = out_uint8
+        self.op_chain = op_chain if op_chain is not None else filt.name
+        #   the signature-key spelling of what this engine computes
+        #   (runtime.signature.canonical_op_chain where parseable) —
+        #   what the compiled-program pool and the multi-signature
+        #   frontend key this engine by
+        self.freed = False  # set by free(): device buffers released,
+        #   submit is a programming error afterwards
         self.chaos = chaos  # resilience.chaos.FaultPlan; armed test/replay
         #   runs only — submit paths fire the "oom"/"compute" injection
         #   sites through it (zero overhead when None)
@@ -87,6 +98,14 @@ class Engine:
         #   into a host destination) of the warmup output — the
         #   serialized fetch cost the streamed egress path's
         #   overlap_efficiency is judged against (obs.metrics.EgressStats)
+        self.step_block_ms: Optional[float] = None  # calibrated blocking
+        #   execution of ONE compiled step at the signature (measured on
+        #   a post-warmup run in compile(), so trace/compile time stays
+        #   out of it) — the MEASURED per-batch tick cost the bucket
+        #   scheduler's EDF/cost score starts from before it has live
+        #   samples (TVM's measured-stage discipline: pick costs from
+        #   measurements, not guesses). Skipped (None) above the
+        #   calibration size cap.
         self.out_shape: Optional[Tuple[int, ...]] = None  # compiled output
         self.out_dtype = None                             # signature — what
         #   the egress fetcher sizes its host slabs from (set by compile())
@@ -258,6 +277,23 @@ class Engine:
         else:
             self.d2h_block_ms = None
         self._state = fresh_state()
+        # Tick-cost calibration: one more blocking step, AFTER the warmup
+        # compiled it — a measured per-batch execution cost for the
+        # multi-signature bucket scheduler (its EDF/cost score needs a
+        # starting estimate before live ticks arrive; guessing would let
+        # a cheap bucket starve behind an expensive one). The step
+        # donates its operands, so state is rebuilt once more. Skipped
+        # above the calibration cap for the same reason D2H is.
+        if zeros.nbytes <= _D2H_CALIBRATION_CAP_BYTES:
+            cal = jax.device_put(zeros, self._sharding)
+            t0 = time.perf_counter()
+            out2, _ = self._step(cal, self._state)
+            out2.block_until_ready()
+            self.step_block_ms = (time.perf_counter() - t0) * 1e3
+            del cal, out2
+            self._state = fresh_state()
+        else:
+            self.step_block_ms = None
 
     # ------------------------------------------------------------------
 
@@ -275,6 +311,17 @@ class Engine:
         admission-time geometry check compares a declared stream shape
         against (serve.ServeFrontend.open_stream)."""
         return self._signature
+
+    @property
+    def signature_key(self):
+        """The CANONICAL ``(op_chain, geometry, dtype)`` serving
+        signature (runtime.signature.SignatureKey) — dtype and geometry
+        spellings normalized so equal programs can't miss the
+        compiled-program pool or the persistent compilation cache by
+        spelling. None before the first compile."""
+        from dvf_tpu.runtime.signature import engine_signature_key
+
+        return engine_signature_key(self)
 
     @property
     def input_sharding(self):
@@ -296,6 +343,10 @@ class Engine:
         The filter state (if any) is threaded internally across calls —
         device-resident, never copied to host (SURVEY.md §7 hard part 4).
         """
+        if self.freed:
+            raise RuntimeError(
+                "engine was freed (program-pool eviction); re-admission "
+                "builds a fresh engine through the pool")
         if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
             self.compile(batch.shape, batch.dtype)
         if self.chaos is not None:
@@ -316,6 +367,10 @@ class Engine:
         previous batch's compute. State threading, donation, and stats
         are identical to :meth:`submit`.
         """
+        if self.freed:
+            raise RuntimeError(
+                "engine was freed (program-pool eviction); re-admission "
+                "builds a fresh engine through the pool")
         if self._signature != (tuple(batch.shape), np.dtype(batch.dtype)):
             self.compile(batch.shape, np.dtype(batch.dtype))
         if self.chaos is not None:
@@ -370,11 +425,28 @@ class Engine:
         engine's device-resident state is unrecoverable by definition).
         """
         fresh = Engine(self.filter, mesh=self.mesh, out_uint8=self.out_uint8,
-                       chaos=self.chaos)
+                       chaos=self.chaos, op_chain=self.op_chain)
         if self._signature is not None:
             shape, dtype = self._signature
             fresh.compile(shape, dtype)
         return fresh
+
+    def free(self) -> None:
+        """Release this engine's device residency: the compiled program
+        handle, the device-resident state, and the warmup-derived
+        sharding refs are dropped so XLA can reclaim the buffers — the
+        compiled-program pool's eviction path. Idempotent; a freed
+        engine refuses further submits (re-admission goes through a
+        FRESH engine so recompilation hits the persistent cache, it
+        does not resurrect this object)."""
+        if self.freed:
+            return
+        self.freed = True
+        self._step = None
+        self._state = None
+        self._sharding = None
+        self._out_sharding = None
+        _unregister_pool_engine(self)
 
     def reset_state(self) -> None:
         if self._exec_filter.stateful and self._signature is not None:
@@ -388,3 +460,322 @@ class Engine:
             self._state = jax.device_put(
                 ef.init_state(shape, state_dtype), self._state_shardings()
             )
+
+
+# ---------------------------------------------------------------------------
+# Compiled-program pool (multi-signature serving)
+# ---------------------------------------------------------------------------
+
+# Every engine currently holding device buffers under a ProgramPool's
+# management. The conftest session-end guard walks this: a pool engine
+# still live after every frontend closed means some stop() path stopped
+# freeing — a long-lived multi-tenant server would leak one compiled
+# program (plus its device state) per churned signature forever.
+_POOL_ENGINES: "set" = set()
+_POOL_ENGINES_LOCK = threading.Lock()
+
+
+def _register_pool_engine(engine: "Engine") -> None:
+    with _POOL_ENGINES_LOCK:
+        _POOL_ENGINES.add(engine)
+
+
+def _unregister_pool_engine(engine: "Engine") -> None:
+    with _POOL_ENGINES_LOCK:
+        _POOL_ENGINES.discard(engine)
+
+
+def live_pool_engines() -> List["Engine"]:
+    """Pool-managed engines whose device buffers are still live — the
+    conftest leak guard's registry (mirrors fleet.replica.
+    live_worker_processes)."""
+    with _POOL_ENGINES_LOCK:
+        return [e for e in _POOL_ENGINES if not e.freed]
+
+
+class ProgramPool:
+    """Bounded LRU of live compiled Engines, keyed by canonical
+    signature (runtime.signature.SignatureKey).
+
+    N serving signatures time-share ONE device without N processes: a
+    bucket *leases* its engine (refcounted — a leased program is never
+    evicted out from under in-flight batches), releases it when the
+    bucket retires, and the program stays WARM in the pool until LRU
+    capacity pressure frees its device buffers (``Engine.free``).
+    Re-admission of an evicted signature recompiles through ``build`` —
+    with the persistent compilation cache armed
+    (:func:`enable_compilation_cache`) that recompile is a cache
+    deserialize, not a fresh XLA run.
+
+    ``hits``/``misses``/``evictions`` are the ``dvf_compile_cache_*`` /
+    pool-eviction registry exports.
+    """
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError("pool capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # key -> [engine, lease_count]; OrderedDict gives LRU order.
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._building: Dict[Any, threading.Event] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.closed = False
+
+    def acquire(self, key, build: Callable[[], "Engine"]) -> "Engine":
+        """Lease the engine for ``key``: LRU hit (warm — milliseconds)
+        or ``build()`` (cold — trace/compile; runs OUTSIDE the pool lock
+        so one slow compile can't block every other bucket's lease, with
+        a per-key latch so concurrent admits of the same signature
+        compile once)."""
+        while True:
+            with self._lock:
+                if self.closed:
+                    raise RuntimeError("program pool is closed")
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries.move_to_end(key)
+                    ent[1] += 1
+                    self.hits += 1
+                    return ent[0]
+                latch = self._building.get(key)
+                if latch is None:
+                    self._building[key] = latch = threading.Event()
+                    break
+            latch.wait(timeout=300.0)  # builder finished (or died): re-check
+        try:
+            engine = build()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            latch.set()
+            raise
+        with self._lock:
+            if self.closed:
+                # close() raced the build: the pool's free sweep already
+                # ran, so inserting now would leak a live program that
+                # nothing ever frees. Refuse (below, outside the lock,
+                # after freeing what we built).
+                self._building.pop(key, None)
+                raced_close = True
+            else:
+                raced_close = False
+                self.misses += 1
+                self._entries[key] = [engine, 1]
+                _register_pool_engine(engine)
+                self._building.pop(key, None)
+                evicted = self._evict_over_capacity_locked()
+        latch.set()
+        if raced_close:
+            engine.free()
+            raise RuntimeError("program pool is closed")
+        for e in evicted:
+            e.free()
+        return engine
+
+    def adopt(self, key, engine: "Engine") -> None:
+        """Insert an externally built engine as a leased entry — how the
+        frontend's default bucket (whose engine may be caller-built and
+        predate its key being known) joins the pool once pinned.
+        Raises RuntimeError on a closed pool (adopt racing the owner's
+        stop must not insert a program the close sweep already missed)."""
+        with self._lock:
+            if self.closed:
+                raise RuntimeError("program pool is closed")
+            if key in self._entries:
+                ent = self._entries[key]
+                if ent[0] is engine:
+                    return
+                raise ValueError(f"pool already holds a different engine "
+                                 f"for {key}")
+            self._entries[key] = [engine, 1]
+            self._entries.move_to_end(key)
+            _register_pool_engine(engine)
+            evicted = self._evict_over_capacity_locked()
+        for e in evicted:
+            e.free()
+
+    def release(self, key) -> None:
+        """Drop one lease. The program STAYS warm (that is the point —
+        the next admit of this signature is a pool hit) until capacity
+        pressure evicts it."""
+        evicted = []
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return
+            ent[1] = max(0, ent[1] - 1)
+            evicted = self._evict_over_capacity_locked()
+        for e in evicted:
+            e.free()
+
+    def replace(self, key, engine: "Engine") -> None:
+        """Swap the live engine under an existing lease (supervised
+        recovery rebuilt it); the old engine's buffers are freed. On a
+        closed pool the rebuilt engine is freed and the call raises —
+        a recovery racing the owner's stop() must not insert a program
+        the close sweep already missed. A concurrently-retired key
+        re-enters WARM (lease 0): nothing holds it, so capacity
+        pressure may evict it immediately."""
+        old = None
+        evicted: List["Engine"] = []
+        with self._lock:
+            if self.closed:
+                raced_close = True
+            else:
+                raced_close = False
+                ent = self._entries.get(key)
+                if ent is None:
+                    self._entries[key] = [engine, 0]
+                    _register_pool_engine(engine)
+                    evicted = self._evict_over_capacity_locked()
+                else:
+                    old = ent[0]
+                    ent[0] = engine
+                    _register_pool_engine(engine)
+        if raced_close:
+            engine.free()
+            raise RuntimeError("program pool is closed")
+        for e in evicted:
+            e.free()
+        if old is not None and old is not engine:
+            old.free()
+
+    def _evict_over_capacity_locked(self) -> List["Engine"]:
+        """Pop LRU un-leased entries while over capacity; leased entries
+        are skipped (a live program can't be freed under its bucket), so
+        the pool may transiently exceed capacity when every entry is
+        leased — bounded by the frontend's max_buckets."""
+        out: List["Engine"] = []
+        if len(self._entries) <= self.capacity:
+            return out
+        for key in list(self._entries):
+            if len(self._entries) <= self.capacity:
+                break
+            if self._entries[key][1] == 0:
+                out.append(self._entries.pop(key)[0])
+                self.evictions += 1
+        return out
+
+    def evict(self, key) -> bool:
+        """Explicitly drop one un-leased entry (tests; manual cache
+        control). False when absent or still leased."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or ent[1] > 0:
+                return False
+            engine = self._entries.pop(key)[0]
+            self.evictions += 1
+        engine.free()
+        return True
+
+    def warm_keys(self) -> List:
+        """Signatures this pool can serve without a compile — what
+        admission-rejection messages enumerate and the fleet's
+        warm-replica preference matches against."""
+        with self._lock:
+            return list(self._entries)
+
+    def close(self) -> None:
+        """Free every entry (frontend stop): after this, no pool engine
+        holds device buffers — pinned by the conftest leak guard."""
+        with self._lock:
+            self.closed = True
+            engines = [ent[0] for ent in self._entries.values()]
+            self._entries.clear()
+        for e in engines:
+            e.free()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": len(self._entries),
+                "capacity": self.capacity,
+                "leased": sum(1 for ent in self._entries.values()
+                              if ent[1] > 0),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Persistent compilation cache (AOT warm-start)
+# ---------------------------------------------------------------------------
+
+# Default on-disk cache location (gitignored). XLA keys entries by
+# topology + program fingerprint, so one directory serves every
+# (device topology, signature) pair without collisions.
+DEFAULT_COMPILE_CACHE_DIR = ".jax_compile_cache"
+DEFAULT_COMPILE_CACHE_BYTES = 512 * 1024 * 1024
+
+
+def prune_compilation_cache(cache_dir: str,
+                            max_bytes: int = DEFAULT_COMPILE_CACHE_BYTES,
+                            ) -> int:
+    """Bound the cache dir: delete oldest-mtime entries until the total
+    is under ``max_bytes``. Returns files removed. Best-effort (a
+    concurrent process may be writing)."""
+    try:
+        files = []
+        for name in os.listdir(cache_dir):
+            path = os.path.join(cache_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if os.path.isfile(path):
+                files.append((st.st_mtime, st.st_size, path))
+    except OSError:
+        return 0
+    total = sum(size for _, size, _ in files)
+    removed = 0
+    for _, size, path in sorted(files):
+        if total <= max_bytes:
+            break
+        try:
+            os.remove(path)
+            removed += 1
+            total -= size
+        except OSError:
+            pass
+    return removed
+
+
+def enable_compilation_cache(
+    cache_dir: Optional[str] = None,
+    max_bytes: int = DEFAULT_COMPILE_CACHE_BYTES,
+) -> str:
+    """Arm jax's persistent compilation cache for AOT warm-starts.
+
+    A previously-seen signature's recompile (process restart, pool
+    re-admission after eviction, a fleet replica respawn) becomes a
+    cache deserialize instead of a fresh XLA compile — milliseconds, not
+    seconds. The min-compile-time/min-entry-size gates are zeroed so
+    CPU-cheap serving programs persist too (jax's defaults only persist
+    compiles over ~1 s, which would exclude exactly the small mixed-
+    workload signatures the multi-tenant frontend churns through). The
+    directory is bounded by :func:`prune_compilation_cache` at arm time.
+    Returns the directory used.
+    """
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or DEFAULT_COMPILE_CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    prune_compilation_cache(cache_dir, max_bytes)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except AttributeError:
+            pass  # older jax: the dir alone still caches big compiles
+    return cache_dir

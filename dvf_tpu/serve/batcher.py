@@ -25,12 +25,25 @@ Scheduling policy (the genuinely new multi-tenant part):
   time is never spent on a result the client's latency budget has
   already written off. Undersubscribed systems never shed: every frame
   makes the next batch.
+- **EDF/cost across buckets.** A multi-signature frontend groups
+  sessions into signature buckets, each with its own compiled program;
+  one tick serves ONE bucket (one program launch). ``select_bucket``
+  scores every bucket with pending work by *deadline headroom ÷
+  measured per-bucket tick cost* and serves the lowest score: a bucket
+  whose earliest deadline is closest relative to how long its program
+  takes to run is the one most at risk of shedding. Costs are
+  MEASURED, never guessed (TVM's measured-stage discipline): the
+  compile-time ``Engine.step_block_ms`` calibration seeds the estimate
+  and an EWMA over observed batch wall times keeps it current — a
+  starved small bucket's headroom shrinks every tick while the big
+  bucket's stays refreshed, so the small bucket always wins before its
+  deadline passes (fairness pinned in tests/test_multitenant.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -51,6 +64,15 @@ class BatchPlan:
     dead: bool = False  # set by supervisor recovery (or a discard) when
     #   the plan's claims were already released — a late result/second
     #   discard for a dead plan must not double-account the sessions
+    bucket: Any = None  # the signature bucket this batch belongs to
+    #   (serve.server._Bucket): the collect side fetches through that
+    #   bucket's egress fetcher and attributes tick cost / faults to it;
+    #   None on the legacy single-signature paths (tests, ad-hoc plans)
+    cost_sample: bool = True  # False when other batches were in flight
+    #   at submit: the submit→materialize wall then includes queue wait
+    #   behind THEIR device time, which would contaminate the bucket's
+    #   per-program tick-cost EWMA (the EDF/cost denominator) toward the
+    #   shared pipeline latency instead of this program's cost
 
 
 class ContinuousBatcher:
@@ -70,7 +92,7 @@ class ContinuousBatcher:
         self._staging_seq = 0
 
     def select(self, sessions: Sequence[StreamSession],
-               now: float) -> Optional[List[Slot]]:
+               now: float, pre_drained: bool = False) -> Optional[List[Slot]]:
         """EDF slot selection for one batch; None = nothing to do.
 
         Drains every session's ingress, sheds blown deadlines, picks the
@@ -78,12 +100,14 @@ class ContinuousBatcher:
         — everything plan() does except touching frame bytes, so the
         streamed assembler can stage the chosen frames straight into its
         per-shard slabs. Dispatch-thread only: touches the sessions'
-        scheduler-owned ``pending`` staging.
+        scheduler-owned ``pending`` staging. ``pre_drained`` skips the
+        drain/shed pass (select_bucket already ran it this tick).
         """
         candidates: List[Slot] = []
         for s in sessions:
-            s.drain_ingress()
-            s.shed_expired(now)  # counted on the session (stats() sums)
+            if not pre_drained:
+                s.drain_ingress()
+                s.shed_expired(now)  # counted on the session (stats() sums)
             candidates.extend(s.pending)
         if not candidates:
             return None
@@ -103,6 +127,45 @@ class ContinuousBatcher:
                 s.pending.popleft()
             s.claim_inflight(n)
         return chosen
+
+    def select_bucket(
+        self,
+        bucket_sessions: Sequence[Tuple[Any, Sequence[StreamSession]]],
+        now: float,
+    ) -> Tuple[Any, Optional[List[Slot]]]:
+        """EDF/cost-aware bucket pick for one tick; ``(None, None)`` =
+        nothing to do anywhere.
+
+        ``bucket_sessions``: ``[(bucket, sessions)]`` where ``bucket``
+        exposes ``tick_cost_estimate() -> ms`` (a MEASURED per-batch
+        cost — Engine.step_block_ms seed + live EWMA). Every bucket's
+        ingress is drained and its blown deadlines shed each tick (a
+        losing bucket must still age and shed); then buckets with
+        pending work are scored ``(earliest deadline − now) ÷ tick
+        cost`` and the lowest score wins — least headroom per unit of
+        program time is the bucket most at risk. The winner's slots are
+        then claimed by the ordinary within-bucket EDF :meth:`select`.
+        """
+        best = None
+        best_score = None
+        best_sessions: Optional[Sequence[StreamSession]] = None
+        for bucket, sessions in bucket_sessions:
+            earliest = None
+            for s in sessions:
+                s.drain_ingress()
+                s.shed_expired(now)
+                if s.pending:
+                    d = s.pending[0].deadline
+                    earliest = d if earliest is None else min(earliest, d)
+            if earliest is None:
+                continue
+            cost_ms = max(float(bucket.tick_cost_estimate()), 1e-3)
+            score = (earliest - now) * 1e3 / cost_ms
+            if best_score is None or score < best_score:
+                best, best_score, best_sessions = bucket, score, sessions
+        if best is None:
+            return None, None
+        return best, self.select(best_sessions, now, pre_drained=True)
 
     def _pool_staging(self, frame: np.ndarray) -> np.ndarray:
         shape = (self.batch_size, *frame.shape)

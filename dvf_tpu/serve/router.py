@@ -68,6 +68,13 @@ class ResultRouter:
             delivered += s.deliver_ready()
         self.batches += 1
         self.frames += plan.valid
+        if plan.bucket is not None:
+            # Lifetime per-bucket row counter, maintained HERE (the one
+            # place every routed row passes) so the bucket's export
+            # stays monotone across session retirement — a per-session
+            # sum would shrink when a tenant retires, which a counter
+            # consumer reads as a reset.
+            plan.bucket.routed_frames += plan.valid
         return delivered
 
     def discard(self, plan: BatchPlan, kind: str = None) -> None:

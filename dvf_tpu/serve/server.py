@@ -1,24 +1,38 @@
-"""The serving front door: N client streams, one shared engine.
+"""The serving front door: N client streams, one device, M signatures.
 
-``ServeFrontend`` multiplexes independent client sessions onto a single
-``runtime.engine.Engine`` — the first genuinely multi-tenant execution
-path in the framework. Topology (one process, two service threads around
-the async device queue, mirroring the single-stream pipeline's shape):
+``ServeFrontend`` multiplexes independent client sessions onto a small
+pool of compiled programs — the genuinely multi-tenant execution path in
+the framework. Sessions group into **signature buckets** keyed by the
+canonical ``(op_chain, geometry, dtype)`` triple
+(runtime.signature.SignatureKey); each bucket leases its compiled
+``Engine`` from a bounded LRU ``ProgramPool``, so a real traffic mix
+(mixed filters, resolutions, dtypes) time-shares ONE device instead of
+being refused at the door or forked into N processes. Topology (one
+process, two service threads around the async device queue, mirroring
+the single-stream pipeline's shape):
 
   clients ──submit──► per-session ingress (drop-oldest)
-                          │ dispatch thread: ContinuousBatcher (EDF +
-                          ▼ SLO shed) → one fixed-signature batch/tick
-                      Engine.submit  (shared; in-flight depth bounded)
-                          │ collect thread: materialize → ResultRouter
-                          ▼
+                          │ dispatch thread: pick ONE bucket per tick
+                          ▼ (EDF-headroom ÷ measured tick cost), then
+                      ContinuousBatcher EDF within it → one batch
+                      bucket.Engine.submit  (in-flight depth bounded
+                          │  across buckets — one device queue)
+                          │ collect thread: materialize via the
+                          ▼ bucket's fetcher → ResultRouter
                       per-session reorder → out queue / sink ──poll──► clients
 
-Admission control is two-layered: ``max_sessions`` caps tenants at
-``open_stream`` (AdmissionError beyond) and ``max_inflight`` caps device
-batches in flight (bounding queueing delay for everyone — the per-batch
-analog of the single-stream pipeline's semaphore). Overload beyond that
-is absorbed by the per-session drop-oldest bounds and the batcher's
-SLO shedding, never by blocking a client.
+Admission control is three-layered: ``max_sessions`` caps tenants at
+``open_stream`` (AdmissionError beyond), ``max_buckets`` caps live
+signatures (a new signature admits by creating a bucket — compiled
+AHEAD of its first frame, so the JIT stall happens at admission where
+the persistent compilation cache and the program pool turn it into
+milliseconds, never on the serving path; beyond the cap the refusal
+enumerates the warm signatures this frontend can serve cheaply), and
+``max_inflight`` caps device batches in flight (bounding queueing delay
+for everyone — the per-batch analog of the single-stream pipeline's
+semaphore). Overload beyond that is absorbed by the per-session
+drop-oldest bounds and the batcher's SLO shedding, never by blocking a
+client.
 
 Only stateless filters are served: a stateful filter's temporal state
 would thread *across* batches whose rows belong to different tenants —
@@ -39,14 +53,20 @@ import queue
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dvf_tpu.api.filter import Filter
 from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
-from dvf_tpu.obs.registry import MetricsRegistry, TimeSeriesRing
+from dvf_tpu.obs.registry import (
+    COUNTER,
+    GAUGE,
+    MetricSample,
+    MetricsRegistry,
+    TimeSeriesRing,
+)
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
@@ -56,8 +76,16 @@ from dvf_tpu.runtime.egress import (
     AsyncCodecPlane,
     ShardedBatchFetcher,
 )
-from dvf_tpu.runtime.engine import Engine
+from dvf_tpu.runtime.engine import Engine, ProgramPool
 from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
+from dvf_tpu.runtime.signature import (
+    SignatureKey,
+    build_filter,
+    canonical_op_chain,
+    canonical_op_chain_or_verbatim,
+    make_key,
+    parse_manifest,
+)
 from dvf_tpu.serve.batcher import BatchPlan, ContinuousBatcher
 from dvf_tpu.serve.router import ResultRouter
 from dvf_tpu.serve.session import (
@@ -77,6 +105,15 @@ TRACK_DISPATCH, TRACK_DEVICE, TRACK_H2D, TRACK_D2H = 0, 1, 3, 4
 class ServeConfig:
     batch_size: int = 8
     max_sessions: int = 16        # admission cap (open_stream)
+    max_buckets: int = 4          # live signature buckets — how many
+    #   distinct (op_chain, geometry, dtype) mixes this frontend serves
+    #   concurrently; a new signature beyond the cap first retires an
+    #   IDLE bucket (no sessions, nothing in flight — its program stays
+    #   warm in the pool), else refuses with the warm-signature list
+    pool_capacity: int = 8        # compiled-program pool bound (LRU;
+    #   ≥ max_buckets keeps every retired bucket's program warm until
+    #   genuine capacity pressure — eviction frees device buffers and a
+    #   re-admission recompiles through the persistent cache)
     max_inflight: int = 4         # device batches in flight (latency bound)
     queue_size: int = 10          # per-session ingress bound
     slo_ms: float = 1000.0        # default per-stream latency budget
@@ -142,8 +179,155 @@ class ServeConfig:
     #   post-mortem); off by default — profiling is not free
 
 
+class _Bucket:
+    """One serving signature's slice of the frontend.
+
+    A bucket owns everything that is per-compiled-program: the leased
+    ``Engine`` (from the frontend's :class:`ProgramPool`), the pinned
+    frame geometry/dtype, its sessions, the streamed ingest assembler
+    and egress fetcher built against THAT engine's shardings, a
+    per-bucket :class:`ErrorBudget` (fault attribution is per bucket —
+    one tenant mix's broken program must not spend another's budget),
+    and the MEASURED tick-cost estimate the EDF/cost bucket scheduler
+    scores it by (``Engine.step_block_ms`` calibration seed + an EWMA
+    over observed batch wall times).
+    """
+
+    _EWMA_ALPHA = 0.2
+
+    def __init__(self, config: "ServeConfig", filt: Filter, op_chain: str,
+                 engine: Engine, key: Optional[SignatureKey] = None):
+        self.config = config
+        self.filter = filt
+        self.op_chain = op_chain        # canonical chain spelling
+        self.engine = engine
+        self.key = key                  # SignatureKey once pinned
+        self.sessions: Dict[str, StreamSession] = {}
+        self.frame_shape: Optional[tuple] = (tuple(key.geometry)
+                                             if key is not None else None)
+        self.frame_dtype = key.np_dtype if key is not None else None
+        self.budget = ErrorBudget(limit=config.fault_budget,
+                                  window_s=config.fault_window_s)
+        self.faults: Dict[str, int] = {}   # per-bucket kind counters
+        self.inflight_batches = 0          # guarded by _count_lock:
+        #   dispatch increments, collect decrements, recovery resets —
+        #   an unsynchronized `+=` across those threads can lose an
+        #   update and leave the counter pinned >0, which would make
+        #   idle() permanently false (a silent admission outage at the
+        #   bucket cap)
+        self._count_lock = threading.Lock()
+        self.batches = 0
+        self.routed_frames = 0             # lifetime rows demuxed for
+        #   this bucket (ResultRouter.route) — monotone across session
+        #   retirement, unlike a per-live-session sum
+        self.ingest_mode = config.ingest
+        self.degrade_reason: Optional[str] = None
+        self.egress_mode = config.egress
+        self.egress_degrade_reason: Optional[str] = None
+        self.assembler: Optional[ShardedBatchAssembler] = None
+        self.ingest_stats: Optional[IngestStats] = None
+        self.fetcher: Optional[ShardedBatchFetcher] = None
+        self.egress_stats: Optional[EgressStats] = None
+        self._tick_cost_ms: Optional[float] = None  # live EWMA
+        self._pooled = False  # engine leased/adopted in the ProgramPool
+
+    # -- scheduling ------------------------------------------------------
+
+    def tick_cost_estimate(self) -> float:
+        """Measured per-batch cost in ms for the EDF/cost score: the
+        live EWMA when ticks have been observed, else the compile-time
+        step calibration, else a 1 ms floor (a bucket is never scored
+        on a guess for longer than its first batch)."""
+        if self._tick_cost_ms is not None:
+            return self._tick_cost_ms
+        cal = getattr(self.engine, "step_block_ms", None)
+        return cal if cal else 1.0
+
+    def observe_tick(self, wall_ms: float, sample: bool = True) -> None:
+        """Collect-side cost sample (submit → materialized, wall).
+        ``sample=False`` counts the batch without feeding the EWMA —
+        the wall time of a batch that queued behind other in-flight
+        work measures the pipeline, not this bucket's program."""
+        self.batches += 1
+        if wall_ms <= 0 or not sample:
+            return
+        if self._tick_cost_ms is None:
+            self._tick_cost_ms = wall_ms
+        else:
+            a = self._EWMA_ALPHA
+            self._tick_cost_ms = (1 - a) * self._tick_cost_ms + a * wall_ms
+
+    def record_fault(self, kind: str) -> None:
+        self.faults[kind] = self.faults.get(kind, 0) + 1
+
+    def adjust_inflight(self, delta: int) -> None:
+        with self._count_lock:
+            self.inflight_batches = max(0, self.inflight_batches + delta)
+
+    def reset_inflight(self) -> None:
+        with self._count_lock:
+            self.inflight_batches = 0
+
+    # -- signature -------------------------------------------------------
+
+    def pinned_signature(self) -> Optional[tuple]:
+        """The per-frame (shape, dtype) this bucket is committed to: the
+        engine's compiled signature when one exists, else the geometry
+        pinned by the first submit/declaration. None = still free (the
+        default bucket before any traffic)."""
+        sig = self.engine.signature
+        if sig is not None:
+            (batch_shape, dtype) = sig
+            return (tuple(batch_shape[1:]), np.dtype(dtype))
+        if self.frame_shape is not None:
+            return (tuple(self.frame_shape), np.dtype(self.frame_dtype))
+        return None
+
+    def idle(self) -> bool:
+        """True when this bucket could retire right now: no live
+        sessions and nothing in flight on the device."""
+        return not self.sessions and self.inflight_batches == 0
+
+    def label(self) -> str:
+        return self.key.render() if self.key is not None else \
+            f"{self.op_chain}|unpinned"
+
+    # -- observability ---------------------------------------------------
+
+    def stats_row(self) -> dict:
+        live = list(self.sessions.values())
+        agg = LatencyStats.merged([s.latency for s in live])
+        row = {
+            "signature": self.label(),
+            "op_chain": self.op_chain,
+            "open_sessions": len(live),
+            "queue_depth": sum(len(s.ingress) + len(s.pending)
+                               for s in live),
+            "inflight_batches": self.inflight_batches,
+            "batches": self.batches,
+            "tick_cost_ms": self._tick_cost_ms
+            if self._tick_cost_ms is not None
+            else getattr(self.engine, "step_block_ms", None),
+            "fps": agg.get("fps"),
+            "p50_ms": agg.get("p50_ms"),
+            "p99_ms": agg.get("p99_ms"),
+            "routed_frames_total": self.routed_frames,
+            "shed_total": sum(s.shed for s in live),
+            "faults": dict(self.faults),
+            "fault_budget": self.budget.summary(),
+            "engine_batches": self.engine.stats.batches,
+            "engine_compile_count": self.engine.stats.compile_count,
+        }
+        if self.ingest_stats is not None:
+            row["ingest"] = self.ingest_stats.summary()
+        if self.egress_stats is not None:
+            row["egress"] = self.egress_stats.summary()
+        return row
+
+
 class ServeFrontend:
-    """Multi-tenant serving frontend over one shared Engine."""
+    """Multi-tenant serving frontend: signature buckets over one device
+    (see module docstring)."""
 
     def __init__(
         self,
@@ -167,9 +351,27 @@ class ServeFrontend:
             raise ValueError(
                 f"egress must be one of {EGRESS_MODES}, got "
                 f"{self.config.egress!r}")
-        self.engine = engine or Engine(filt, chaos=self.config.chaos)
-        if self.config.chaos is not None and self.engine.chaos is None:
-            self.engine.chaos = self.config.chaos  # arm caller-built engine
+        engine = engine or Engine(filt, chaos=self.config.chaos)
+        if self.config.chaos is not None and engine.chaos is None:
+            engine.chaos = self.config.chaos  # arm caller-built engine
+        # Signature buckets: the DEFAULT bucket (index 0) carries the
+        # constructor filter/engine and keeps the legacy single-
+        # signature behavior (geometry pinned by the first submit or
+        # declaration); further buckets are created at admission when a
+        # session declares a different (op_chain, geometry, dtype).
+        default_chain = canonical_op_chain_or_verbatim(filt.name)
+        self.pool = ProgramPool(capacity=self.config.pool_capacity)
+        self._buckets: List[_Bucket] = [
+            _Bucket(self.config, filt, default_chain, engine)]
+        self._bucket_by_key: Dict[SignatureKey, _Bucket] = {}
+        # Live Filter objects by canonical chain. A filter's DISPLAY
+        # name (e.g. "gaussian_blur(ksize=9)" resolved to its Pallas
+        # impl) is not necessarily a buildable registry spec — so a new
+        # geometry of an ALREADY-SERVED chain must reuse the existing
+        # Filter object (filters are frozen dataclasses, shareable
+        # across engines) instead of round-tripping through
+        # build_filter. Only a never-seen chain builds from the spec.
+        self._filters_by_chain: Dict[str, Filter] = {default_chain: filt}
         self.batcher = ContinuousBatcher(self.config.batch_size)
         self.router = ResultRouter()
         self._lock = threading.Lock()
@@ -218,10 +420,17 @@ class ServeFrontend:
                 stats_fn=self.stats,
                 ring=self.telemetry,
                 jax_profile_s=self.config.flight_profile_s)
+        self.registry.register_provider(self._bucket_samples)
+        #   per-bucket queue depth / p99 + the compile-cache counters
+        #   (dvf_compile_cache_hits_total / _misses_total,
+        #   dvf_pool_evictions_total) — unprefixed provider, so the
+        #   series names are fleet-wide, not per-tier
         self._draining = False       # fleet drain hook: open_stream refuses
         self.recoveries = 0          # supervised engine rebuilds
-        self._budget = ErrorBudget(limit=self.config.fault_budget,
-                                   window_s=self.config.fault_window_s)
+        # Frontend-level budget = the default bucket's (fault budgets
+        # attribute PER BUCKET — a broken signature's faults must not
+        # spend another tenant mix's budget; non-bucket faults land here).
+        self._budget = self._buckets[0].budget
         # Stall escalation is NOT time-windowed: stalls arrive at most
         # once per stall_timeout_s, so a sliding window can never fill.
         # Instead, consecutive recoveries with no successful batch in
@@ -233,13 +442,6 @@ class ServeFrontend:
         # even with the watchdog off: budget-driven recovery must be able
         # to shed batches a wedged collect thread is holding.
         self._window = InflightWindow()
-        self._ingest_mode = self.config.ingest  # may degrade to monolithic
-        self._degrade_reason: Optional[str] = None
-        self._egress_mode = self.config.egress  # the d2h mirror: repeated
-        #   fetch faults degrade streamed → monolithic
-        self._egress_degrade_reason: Optional[str] = None
-        self._fetcher: Optional[ShardedBatchFetcher] = None
-        self._egress_stats: Optional[EgressStats] = None
         self._supervisor: Optional[Supervisor] = None
         self._recovering = threading.Event()  # dispatch parks while set
         self._dispatch_parked = threading.Event()  # ack of that park
@@ -247,10 +449,6 @@ class ServeFrontend:
         self._recover_lock = threading.Lock()
         self._collect_gen = 0  # bumped by recovery; a stale collect thread
         #   exits at its next loop check (and a wedged one, when it wakes)
-        self._frame_shape: Optional[tuple] = None  # pinned at first submit
-        self._frame_dtype = None
-        self._assembler: Optional[ShardedBatchAssembler] = None
-        self._ingest_stats: Optional[IngestStats] = None
         # Plain unbounded FIFO: depth is already bounded by the semaphore,
         # and drop-oldest semantics here would silently leak a permit and
         # the dropped batch's inflight claims.
@@ -260,6 +458,18 @@ class ServeFrontend:
         self._dispatch_done = threading.Event()
         self._error: Optional[BaseException] = None
         self._threads: List[threading.Thread] = []
+
+    @property
+    def engine(self) -> Engine:
+        """The DEFAULT bucket's engine — the legacy single-signature
+        surface (tests monkeypatch its submit; the fleet's local factory
+        hands one in). Multi-signature callers reach per-bucket engines
+        through ``stats()['buckets']``/the pool."""
+        return self._buckets[0].engine
+
+    @engine.setter
+    def engine(self, value: Engine) -> None:
+        self._buckets[0].engine = value
 
     # -- lifecycle ------------------------------------------------------
 
@@ -301,10 +511,21 @@ class ServeFrontend:
         with self._lock:
             sessions = list(self._sessions.items())
             for sid, s in sessions:
+                if s.bucket is not None:
+                    s.bucket.sessions.pop(sid, None)
                 self._retire_locked(sid, s)
             self._sessions.clear()
+            buckets = list(self._buckets)
         for _, s in sessions:
             s.finalize()
+        # Release every compiled program's device residency: pooled
+        # engines free through the pool; an engine that never made it
+        # into the pool (default bucket that never compiled, adoption
+        # race) frees directly. Idempotent — pinned by the conftest
+        # session-end leak guard (runtime.engine.live_pool_engines).
+        self.pool.close()
+        for b in buckets:
+            b.engine.free()
         if self._error is not None:
             raise self._error
 
@@ -364,6 +585,11 @@ class ServeFrontend:
             "fault_total": self.faults.total(),
             "stalls": (self._supervisor.stalls
                        if self._supervisor is not None else 0),
+            # Signatures this frontend serves without a cold compile —
+            # what the fleet's signature-aware spillover prefers a
+            # replica by, and what its rejections enumerate. Cheap: a
+            # key-list copy, no percentile work.
+            "warm_signatures": self._warm_signatures(),
         }
 
     def latency_snapshot(self) -> dict:
@@ -386,6 +612,7 @@ class ServeFrontend:
             live = list(self._sessions.values())
             retired = list(self._retired.values())
             floor = dict(self._evicted_totals)
+            buckets = list(self._buckets)
         every = retired + live
         agg = LatencyStats.merged([s.latency for s in every])
         p99 = agg.get("p99_ms")
@@ -424,19 +651,77 @@ class ServeFrontend:
             "admission_rejections_total": float(self.admission_rejections),
             "errors_total": float(self.errors),
             "recoveries_total": float(self.recoveries),
-            "engine_batches_total": float(self.engine.stats.batches),
-            "engine_frames_total": float(self.engine.stats.frames),
+            "engine_batches_total": float(sum(
+                b.engine.stats.batches for b in buckets)),
+            "engine_frames_total": float(sum(
+                b.engine.stats.frames for b in buckets)),
             "trace_dropped_total": float(self.tracer.dropped),
+            # Multi-signature plane: live buckets + the compiled-program
+            # pool's hit/miss/eviction counters (the admission-cost
+            # story: a hit is a warm admit, a miss a cold compile).
+            "open_buckets": float(len(buckets)),
+            "compile_cache_hits_total": float(self.pool.hits),
+            "compile_cache_misses_total": float(self.pool.misses),
+            "pool_evictions_total": float(self.pool.evictions),
+            "pool_size": float(len(self.pool)),
         }
         if self._supervisor is not None:
             out["stalls_total"] = float(self._supervisor.stalls)
-        ing, egr = self._ingest_stats, self._egress_stats
+        ing = self._buckets[0].ingest_stats
+        egr = self._buckets[0].egress_stats
         if ing is not None:
             out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
         if egr is not None:
             out["egress_overlap_efficiency"] = egr.overlap_efficiency()
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
+        return out
+
+    def _bucket_samples(self) -> List[MetricSample]:
+        """Registry provider: the per-bucket load/latency series
+        (``bucket=`` label carries the canonical signature) plus the
+        frontend-wide compile-cache counters — unprefixed, so the
+        series are ``dvf_compile_cache_hits_total`` /
+        ``dvf_bucket_queue_depth{bucket=…}`` etc. on the scrape."""
+        out = [
+            MetricSample("compile_cache_hits_total",
+                         float(self.pool.hits), (), COUNTER),
+            MetricSample("compile_cache_misses_total",
+                         float(self.pool.misses), (), COUNTER),
+            MetricSample("pool_evictions_total",
+                         float(self.pool.evictions), (), COUNTER),
+            MetricSample("pool_size", float(len(self.pool)), (), GAUGE),
+        ]
+        # Snapshot under the lock, merge percentiles AFTER releasing it
+        # (stats()'s discipline): a scrape must not stall submit/open/
+        # dispatch behind per-bucket percentile math.
+        with self._lock:
+            snap = [(b, list(b.sessions.values())) for b in self._buckets]
+        rows = []
+        for b, live in snap:
+            rows.append((
+                b.label(),
+                sum(len(s.ingress) + len(s.pending) for s in live),
+                len(live),
+                b.inflight_batches,
+                b.tick_cost_estimate(),
+                LatencyStats.merged([s.latency for s in live]),
+            ))
+        for label, qd, n_live, inflight, cost, agg in rows:
+            labels = (("bucket", label),)
+            out.append(MetricSample("bucket_queue_depth", float(qd),
+                                    labels, GAUGE))
+            out.append(MetricSample("bucket_open_sessions", float(n_live),
+                                    labels, GAUGE))
+            out.append(MetricSample("bucket_inflight_batches",
+                                    float(inflight), labels, GAUGE))
+            out.append(MetricSample("bucket_tick_cost_ms", float(cost),
+                                    labels, GAUGE))
+            for pk in ("p50_ms", "p99_ms"):
+                v = agg.get(pk)
+                if v is not None and v == v:  # NaN (empty window) = gap
+                    out.append(MetricSample(f"bucket_{pk}", float(v),
+                                            labels, GAUGE))
         return out
 
     def _check_slo_burn(self, prev: Optional[dict], cur: dict) -> None:
@@ -476,6 +761,7 @@ class ServeFrontend:
         sink: Any = None,
         frame_shape: Optional[tuple] = None,
         frame_dtype: Any = None,
+        op_chain: Optional[str] = None,
     ) -> str:
         """Admit one new stream; returns its session id.
 
@@ -483,14 +769,19 @@ class ServeFrontend:
         is refused at the door, not absorbed as unbounded queueing — and
         when the frontend is draining (fleet replica teardown).
 
-        ``frame_shape``/``frame_dtype`` declare the stream's geometry at
-        admission time: a declaration that mismatches the engine's
-        compiled signature (or the geometry this frontend already pinned)
-        is refused HERE, as an ``AdmissionError``, instead of surfacing
-        frames later as a ``geometry`` fault in the batcher. The first
-        declaration on an unpinned frontend pins it — the seam the
-        (op, geometry) bucketing work extends: a bucketed frontend will
-        route the declaration to a compatible engine instead of refusing.
+        ``op_chain``/``frame_shape``/``frame_dtype`` declare the
+        stream's signature at admission time and ROUTE it: a declaration
+        matching a live bucket (or the default bucket's pin) joins that
+        bucket; a new signature ADMITS BY CREATING a bucket — its
+        program is compiled here, ahead of the first frame
+        (``jit → lower → compile`` through the program pool and the
+        persistent compilation cache, so a previously-seen signature
+        costs milliseconds), never as a JIT stall on the serving path.
+        Only past ``max_buckets`` (with no idle bucket to retire) is a
+        new signature refused — and the refusal enumerates the warm
+        signatures this frontend can serve cheaply. An undeclared open
+        joins the default bucket, whose geometry pins at first submit
+        (the legacy single-signature behavior, unchanged).
         """
         cfg = SessionConfig(
             queue_size=self.config.queue_size,
@@ -501,51 +792,240 @@ class ServeFrontend:
         )
         declared = None
         if frame_shape is not None:
+            # canonical_dtype, NOT np.dtype: the ML spelling "u8" means
+            # uint8, while numpy alone reads it as an 8-BYTE uint64.
+            from dvf_tpu.runtime.signature import canonical_dtype
+
             declared = (tuple(int(d) for d in frame_shape),
-                        np.dtype(frame_dtype if frame_dtype is not None
-                                 else np.uint8))
+                        canonical_dtype(frame_dtype))
         elif frame_dtype is not None:
             raise ValueError("frame_dtype given without frame_shape")
-        with self._lock:
-            if self._draining:
-                self.admission_rejections += 1
-                raise AdmissionError(
-                    "frontend is draining (no new sessions admitted)")
-            if len(self._sessions) >= self.config.max_sessions:
-                self.admission_rejections += 1
-                raise AdmissionError(
-                    f"session limit reached ({self.config.max_sessions} "
-                    f"open); close a stream or raise max_sessions")
-            if declared is not None:
-                pinned = self._pinned_signature_locked()
-                if pinned is not None and declared != pinned:
+        chain = None
+        if op_chain is not None:
+            try:
+                chain = canonical_op_chain(op_chain)
+            except ValueError as e:
+                with self._lock:
                     self.admission_rejections += 1
-                    raise AdmissionError(
-                        f"declared frame signature {declared[0]}/"
-                        f"{declared[1]} does not match this frontend's "
-                        f"compiled signature {pinned[0]}/{pinned[1]} "
-                        f"(one program serves all sessions — geometry is "
-                        f"per-frontend, not per-stream)")
-                if pinned is None:
-                    self._frame_shape, self._frame_dtype = declared
-            sid = session_id if session_id is not None else f"s{next(self._ids)}"
-            if sid in self._sessions or sid in self._retired:
-                raise ServeError(f"session id {sid!r} already exists")
-            self._sessions[sid] = StreamSession(sid, cfg, sink=sink)
+                raise AdmissionError(f"malformed op_chain: {e}") from e
+        with self._lock:
+            self._check_admission_locked()
+            bucket, create_key = self._route_locked(chain, declared)
+            if bucket is not None:
+                return self._register_session_locked(
+                    bucket, session_id, cfg, sink)
+            # Best-effort headroom check BEFORE the compile: a frontend
+            # at the bucket cap with no idle victim must refuse now, not
+            # after seconds of JIT whose orphan program would then sit
+            # in the pool advertising a signature this frontend cannot
+            # actually serve. _create_bucket_locked re-checks
+            # authoritatively (state may change while we compile).
+            self._check_bucket_headroom_locked(create_key)
+        # New signature: build/lease its compiled program OUTSIDE the
+        # frontend lock — a cold compile must not stall dispatch of the
+        # other buckets (that is the JIT stall this design removes from
+        # the serving path); the pool's per-key latch dedups concurrent
+        # admits of the same signature.
+        engine = self._acquire_program(create_key)
+        owned = False
+        try:
+            with self._lock:
+                self._check_admission_locked()
+                bucket = self._bucket_by_key.get(create_key)
+                if bucket is None:
+                    bucket = self._create_bucket_locked(create_key, engine)
+                    owned = True
+                return self._register_session_locked(
+                    bucket, session_id, cfg, sink)
+        finally:
+            if not owned:
+                # Either the signature raced into existence (join — our
+                # extra lease drops; the bucket keeps its own) or
+                # admission failed after the lease: the program stays
+                # WARM in the pool either way.
+                self.pool.release(create_key)
+
+    # -- admission internals (bucket routing) ---------------------------
+
+    def _check_admission_locked(self) -> None:
+        if self._draining:
+            self.admission_rejections += 1
+            raise AdmissionError(
+                "frontend is draining (no new sessions admitted)")
+        if len(self._sessions) >= self.config.max_sessions:
+            self.admission_rejections += 1
+            raise AdmissionError(
+                f"session limit reached ({self.config.max_sessions} "
+                f"open); close a stream or raise max_sessions")
+
+    def _route_locked(
+        self, chain: Optional[str], declared: Optional[tuple],
+    ) -> Tuple[Optional["_Bucket"], Optional[SignatureKey]]:
+        """Map a declaration to ``(bucket, None)`` (join) or
+        ``(None, key)`` (create a bucket for ``key``)."""
+        default = self._buckets[0]
+        if chain is None and declared is None:
+            return default, None  # legacy: default bucket, pin at submit
+        chain = chain if chain is not None else default.op_chain
+        if declared is None:
+            # op_chain alone: join the one live bucket serving it.
+            matches = [b for b in self._buckets if b.op_chain == chain]
+            if len(matches) == 1:
+                return matches[0], None
+            self.admission_rejections += 1
+            raise AdmissionError(
+                f"op_chain {chain!r} needs frame_shape to admit "
+                f"({len(matches)} live buckets serve it); warm "
+                f"signatures: {self._warm_signatures()}")
+        shape, dtype = declared
+        key = make_key(chain, shape, dtype)
+        b = self._bucket_by_key.get(key)
+        if b is not None:
+            return b, None
+        if chain == default.op_chain:
+            pinned = default.pinned_signature()
+            if pinned is None:
+                # First declaration pins the default bucket (the legacy
+                # seam, now one bucket among several).
+                default.frame_shape = tuple(key.geometry)
+                default.frame_dtype = key.np_dtype
+                default.key = key
+                self._bucket_by_key[key] = default
+                return default, None
+            if pinned == (tuple(key.geometry), key.np_dtype):
+                # Same signature spelled differently / pinned by a
+                # first submit before any declaration: join.
+                if default.key is None:
+                    default.key = key
+                self._bucket_by_key.setdefault(key, default)
+                return default, None
+        return None, key
+
+    def _register_session_locked(self, bucket: "_Bucket",
+                                 session_id: Optional[str],
+                                 cfg: SessionConfig, sink: Any) -> str:
+        sid = session_id if session_id is not None else f"s{next(self._ids)}"
+        if sid in self._sessions or sid in self._retired:
+            raise ServeError(f"session id {sid!r} already exists")
+        s = StreamSession(sid, cfg, sink=sink)
+        s.bucket = bucket
+        self._sessions[sid] = s
+        bucket.sessions[sid] = s
         return sid
 
-    def _pinned_signature_locked(self) -> Optional[tuple]:
-        """The per-frame (shape, dtype) this frontend is committed to:
-        the engine's compiled signature when one exists (a caller-built
-        engine may arrive pre-compiled), else the shape pinned by the
-        first submit/declaration. None = still free."""
-        sig = self.engine.signature
-        if sig is not None:
-            (batch_shape, dtype) = sig
-            return (tuple(batch_shape[1:]), np.dtype(dtype))
-        if self._frame_shape is not None:
-            return (tuple(self._frame_shape), np.dtype(self._frame_dtype))
-        return None
+    def _check_bucket_headroom_locked(self, key: SignatureKey) -> None:
+        """Refuse a new-signature admission when the bucket cap is
+        reached and nothing can retire (counts the rejection). Shared by
+        the pre-compile fast refusal and the authoritative post-compile
+        check in _create_bucket_locked."""
+        if len(self._buckets) < self.config.max_buckets:
+            return
+        if any(b.idle() for b in self._buckets[1:]):
+            return
+        self.admission_rejections += 1
+        raise AdmissionError(
+            f"no bucket headroom for signature {key.render()}: "
+            f"{len(self._buckets)}/{self.config.max_buckets} "
+            f"buckets busy; warm signatures this frontend can "
+            f"serve cheaply: {self._warm_signatures()}")
+
+    def _create_bucket_locked(self, key: SignatureKey,
+                              engine: Engine) -> "_Bucket":
+        if len(self._buckets) >= self.config.max_buckets:
+            self._check_bucket_headroom_locked(key)
+            victim = next((b for b in self._buckets[1:] if b.idle()), None)
+            self._retire_bucket_locked(victim)
+        b = _Bucket(self.config, engine.filter, key.op_chain, engine,
+                    key=key)
+        b._pooled = True  # leased through self.pool by _acquire_program
+        self._buckets.append(b)
+        self._bucket_by_key[key] = b
+        return b
+
+    def _retire_bucket_locked(self, bucket: "_Bucket") -> None:
+        """Drop an idle bucket to make headroom. Its program is NOT
+        compiled away — the pool lease drops, the program stays warm
+        until LRU capacity pressure actually frees it, so a returning
+        signature re-admits as a pool hit. Its host staging slabs ARE
+        released eagerly: retired sessions keep a ``.bucket`` reference
+        (for tail drains), so without this a churned bucket would pin
+        2×(max_inflight+1) batch-sized buffers until its sessions age
+        out of the retirement map."""
+        self._buckets.remove(bucket)
+        if bucket.key is not None:
+            if self._bucket_by_key.get(bucket.key) is bucket:
+                del self._bucket_by_key[bucket.key]
+            if getattr(bucket, "_pooled", False):
+                self.pool.release(bucket.key)
+        a, bucket.assembler = bucket.assembler, None
+        f, bucket.fetcher = bucket.fetcher, None
+        if a is not None:
+            a.release()
+        if f is not None:
+            f.release()
+
+    def _acquire_program(self, key: SignatureKey) -> Engine:
+        """Lease (or AOT-compile) the program for ``key`` — the
+        admission-time compile that replaces the first-frame JIT stall."""
+        def build() -> Engine:
+            with self._lock:
+                filt = self._filters_by_chain.get(key.op_chain)
+            if filt is None:
+                filt = build_filter(key.op_chain)
+                if filt.stateful:
+                    raise AdmissionError(
+                        f"op_chain {key.op_chain!r} is stateful; a "
+                        f"shared batch interleaves tenants, so temporal "
+                        f"state would leak across sessions — stateless "
+                        f"chains only")
+                with self._lock:
+                    self._filters_by_chain.setdefault(key.op_chain, filt)
+            eng = Engine(filt, mesh=self.engine.mesh,
+                         chaos=self.config.chaos, op_chain=key.op_chain)
+            eng.compile((self.config.batch_size, *key.geometry),
+                        key.np_dtype)
+            return eng
+
+        try:
+            return self.pool.acquire(key, build)
+        except AdmissionError:
+            with self._lock:
+                self.admission_rejections += 1
+            raise
+        except Exception as e:  # noqa: BLE001 — unknown op, bad
+            # geometry for the filter, compile failure: all refusals at
+            # the door, never a half-created bucket
+            with self._lock:
+                self.admission_rejections += 1
+            raise AdmissionError(
+                f"cannot compile program for signature {key.render()}: "
+                f"{e!r}") from e
+
+    def _warm_signatures(self) -> List[str]:
+        """Signatures servable without a cold compile: pooled programs
+        plus live pinned buckets (which may predate pool adoption).
+        Lock-free (callers may hold the non-reentrant ``_lock``): the
+        dict snapshot below is ``list(dict)`` — one C-level call, atomic
+        under the GIL — so a concurrent open_stream insert cannot raise
+        mid-iteration; at worst the list is one insert stale.
+        """
+        keys = {k.render() for k in self.pool.warm_keys()}
+        keys.update(k.render() for k in list(self._bucket_by_key))
+        return sorted(keys)
+
+    def precompile(self, manifest: Any) -> List[str]:
+        """Warm the program pool from a ``--precompile`` manifest
+        (runtime.signature.parse_manifest): each signature compiles once
+        here — populating the in-process pool AND the persistent
+        compilation cache — then idles warm, so its first real admission
+        is a pool hit. Returns the canonical signatures warmed."""
+        warmed = []
+        for entry in parse_manifest(manifest):
+            key = entry["key"]
+            self._acquire_program(key)
+            self.pool.release(key)  # stays warm, un-leased
+            warmed.append(key.render())
+        return warmed
 
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
@@ -556,18 +1036,23 @@ class ServeFrontend:
             # queueing frames nothing will ever serve.
             raise ServeError(
                 f"frontend failed: {self._error!r}") from self._error
-        if self._frame_shape is None:
+        s = self._session(session_id)
+        bucket = s.bucket if s.bucket is not None else self._buckets[0]
+        if bucket.frame_shape is None:
             with self._lock:
-                if self._frame_shape is None:
-                    self._frame_shape = frame.shape
-                    self._frame_dtype = frame.dtype
-        if frame.shape != self._frame_shape or frame.dtype != self._frame_dtype:
+                if bucket.frame_shape is None:
+                    bucket.frame_shape = tuple(frame.shape)
+                    bucket.frame_dtype = np.dtype(frame.dtype)
+        if tuple(frame.shape) != tuple(bucket.frame_shape) \
+                or np.dtype(frame.dtype) != np.dtype(bucket.frame_dtype):
             raise ValueError(
                 f"frame {frame.shape}/{frame.dtype} does not match this "
-                f"frontend's pinned signature {self._frame_shape}/"
-                f"{self._frame_dtype} (one compiled program serves all "
-                f"sessions — geometry is per-frontend, not per-stream)")
-        return self._session(session_id).submit(frame, ts=ts, tag=tag)
+                f"stream's pinned signature {tuple(bucket.frame_shape)}/"
+                f"{np.dtype(bucket.frame_dtype)} (one compiled program "
+                f"serves every session in a bucket — geometry is "
+                f"per-bucket, not per-stream; open a stream with "
+                f"frame_shape=/op_chain= to route to another bucket)")
+        return s.submit(frame, ts=ts, tag=tag)
 
     def poll(self, session_id: str, max_items: Optional[int] = None) -> list:
         """Pop completed ``Delivery`` records for one stream (works on
@@ -626,54 +1111,80 @@ class ServeFrontend:
 
     # -- service threads -------------------------------------------------
 
-    def _builder_for(self, seq: int):
-        """One staged batch via the shared assembler (runtime/ingest.py)
+    def _builder_for(self, bucket: "_Bucket", seq: int):
+        """One staged batch via the bucket's assembler (runtime/ingest.py)
         — both ingest modes; the assembler owns the per-inflight-slot
         staging pool (max_inflight + 1 buffers: the one being rewritten
         always belongs to an already-collected batch, exactly like the
-        single-stream pipeline's)."""
-        shape = (self.config.batch_size, *self._frame_shape)
-        dtype = np.dtype(self._frame_dtype)
-        if self._assembler is None or self._assembler.batch_shape != shape:
-            self.engine.ensure_compiled(shape, dtype)
-            self._ingest_stats = IngestStats(
+        single-stream pipeline's). Per bucket because the slab layout
+        derives from THAT bucket's compiled input sharding."""
+        shape = (self.config.batch_size, *bucket.frame_shape)
+        dtype = np.dtype(bucket.frame_dtype)
+        if bucket.assembler is None or bucket.assembler.batch_shape != shape:
+            bucket.engine.ensure_compiled(shape, dtype)
+            self._adopt_bucket_key(bucket)
+            bucket.ingest_stats = IngestStats(
                 requested_mode=self.config.ingest,
                 depth=self.config.ingest_depth,
-                h2d_block_ms=self.engine.h2d_block_ms)
-            self._assembler = ShardedBatchAssembler(
-                shape, dtype, self.engine.input_sharding,
-                mode=self._ingest_mode, depth=self.config.ingest_depth,
+                h2d_block_ms=bucket.engine.h2d_block_ms)
+            bucket.assembler = ShardedBatchAssembler(
+                shape, dtype, bucket.engine.input_sharding,
+                mode=bucket.ingest_mode, depth=self.config.ingest_depth,
                 slots=self.config.max_inflight + 1,
-                stats=self._ingest_stats, chaos=self.config.chaos,
+                stats=bucket.ingest_stats, chaos=self.config.chaos,
                 tracer=self.tracer, track=TRACK_H2D)
-            if self._degrade_reason is not None:
-                self._ingest_stats.fallback_reason = self._degrade_reason
-        return self._assembler.begin(seq)
+            if bucket.degrade_reason is not None:
+                bucket.ingest_stats.fallback_reason = bucket.degrade_reason
+        return bucket.assembler.begin(seq)
 
-    def _fetcher_for(self):
-        """The streamed-egress fetcher for the engine's compiled output
-        signature — the delivery-side mirror of ``_builder_for``, same
-        slot discipline (max_inflight + 1 slabs; the router copies rows
-        out during route(), so a slab is quiescent before its slot
-        cycles). Built by the dispatch thread; the collect thread only
-        reads it."""
-        shape = getattr(self.engine, "out_shape", None)
+    def _adopt_bucket_key(self, bucket: "_Bucket") -> None:
+        """Once a bucket's engine has compiled, its canonical signature
+        is known: register the bucket under it (a later declared open of
+        the same signature joins this bucket instead of forking a
+        duplicate program) and adopt the engine into the program pool
+        (the signature stays warm after the bucket retires)."""
+        if getattr(bucket, "_pooled", False):
+            return
+        key = bucket.engine.signature_key
+        if key is None:
+            return
+        with self._lock:
+            if bucket.key is None:
+                bucket.key = key
+            self._bucket_by_key.setdefault(key, bucket)
+        try:
+            self.pool.adopt(key, bucket.engine)
+        except (ValueError, RuntimeError):
+            return  # another engine already pooled under this key (or
+            #   the pool closed mid-stop): this engine stays un-pooled;
+            #   stop() frees it directly
+        bucket._pooled = True
+
+    def _fetcher_for(self, bucket: "_Bucket"):
+        """The bucket's streamed-egress fetcher for its engine's
+        compiled output signature — the delivery-side mirror of
+        ``_builder_for``, same slot discipline (max_inflight + 1 slabs;
+        the router copies rows out during route(), so a slab is
+        quiescent before its slot cycles). Built by the dispatch thread;
+        the collect thread only reads it."""
+        shape = getattr(bucket.engine, "out_shape", None)
         if shape is None:
             return None
-        f = self._fetcher
+        f = bucket.fetcher
         if f is None or f.out_shape != tuple(shape):
-            self._egress_stats = EgressStats(
+            bucket.egress_stats = EgressStats(
                 requested_mode=self.config.egress,
-                d2h_block_ms=self.engine.d2h_block_ms)
-            self._fetcher = f = ShardedBatchFetcher(
-                shape, self.engine.out_dtype, self.engine.output_sharding,
-                mode=self._egress_mode,
+                d2h_block_ms=bucket.engine.d2h_block_ms)
+            bucket.fetcher = f = ShardedBatchFetcher(
+                shape, bucket.engine.out_dtype,
+                bucket.engine.output_sharding,
+                mode=bucket.egress_mode,
                 slots=self.config.max_inflight + 1,
-                stats=self._egress_stats, chaos=self.config.chaos,
+                stats=bucket.egress_stats, chaos=self.config.chaos,
                 tracer=self.tracer, track=TRACK_D2H)
-            if self._egress_degrade_reason is not None:
-                self._egress_stats.fallback_reason = \
-                    self._egress_degrade_reason
+            if bucket.egress_degrade_reason is not None:
+                bucket.egress_stats.fallback_reason = \
+                    bucket.egress_degrade_reason
         return f
 
     def _fail(self, e: BaseException) -> None:
@@ -687,54 +1198,69 @@ class ServeFrontend:
             # worth a dump. Best-effort, rate-limited in the recorder.
             self._flight_trip(f"frontend failed: {e!r}")
 
-    def _contain(self, e: BaseException, where: str) -> bool:
+    def _contain(self, e: BaseException, where: str,
+                 bucket: Optional["_Bucket"] = None) -> bool:
         """Bounded containment (resilience.budget): classify, count,
         continue while within the per-kind budget; the first overflow
         degrades (h2d → monolithic ingest, compute/oom → supervised
         engine rebuild), the second surfaces a hard ServeError — a
-        permanently broken engine must not serve 0 fps silently."""
+        permanently broken engine must not serve 0 fps silently.
+        Budgets attribute PER BUCKET: one signature's broken program
+        spends its own budget, never another tenant mix's."""
         kind = classify(e, site=where)
         self.faults.record(kind, e)
+        if bucket is not None:
+            bucket.record_fault(kind)
         if not (self.config.resilient and isinstance(e, Exception)):
             self._fail(e)
             return False
         self.errors += 1
-        if escalate(self._budget, kind, self._degrade) == ErrorBudget.CONTAIN:
+        budget = bucket.budget if bucket is not None else self._budget
+        if escalate(budget, kind,
+                    lambda k: self._degrade(k, bucket)) == ErrorBudget.CONTAIN:
             print(f"[serve:{where}] {kind} fault (continuing): {e!r}",
                   file=sys.stderr, flush=True)
             return True
         self._fail(ServeError(
             f"error budget exhausted for {kind!r} faults "
             f"(> {self.config.fault_budget} in "
-            f"{self.config.fault_window_s:g}s, after degradation); "
-            f"last: {e!r}"))
+            f"{self.config.fault_window_s:g}s, after degradation"
+            + (f"; bucket {bucket.label()}" if bucket is not None else "")
+            + f"); last: {e!r}"))
         return False
 
-    def _degrade(self, kind: str) -> bool:
-        """First-overflow degradation per kind. Returns True if applied
-        (the fault is then still contained; a second overflow fails)."""
-        if kind == FaultKind.H2D and self._ingest_mode == "streamed":
-            self._ingest_mode = "monolithic"
-            self._degrade_reason = "h2d_fault_budget"
-            self._assembler = None
-            print("[serve] repeated h2d faults: degrading ingest "
-                  "streamed → monolithic", file=sys.stderr, flush=True)
+    def _degrade(self, kind: str,
+                 bucket: Optional["_Bucket"] = None) -> bool:
+        """First-overflow degradation per kind (per bucket). Returns
+        True if applied (the fault is then still contained; a second
+        overflow fails)."""
+        b = bucket if bucket is not None else self._buckets[0]
+        if kind == FaultKind.H2D and b.ingest_mode == "streamed":
+            b.ingest_mode = "monolithic"
+            b.degrade_reason = "h2d_fault_budget"
+            b.assembler = None
+            print(f"[serve] repeated h2d faults: degrading ingest "
+                  f"streamed → monolithic (bucket {b.label()})",
+                  file=sys.stderr, flush=True)
             return True
-        if kind == FaultKind.D2H and self._egress_mode == "streamed":
-            self._egress_mode = "monolithic"
-            self._egress_degrade_reason = "d2h_fault_budget"
-            old, self._fetcher = self._fetcher, None
+        if kind == FaultKind.D2H and b.egress_mode == "streamed":
+            b.egress_mode = "monolithic"
+            b.egress_degrade_reason = "d2h_fault_budget"
+            old, b.fetcher = b.fetcher, None
             if old is not None:
                 old.release()
-            print("[serve] repeated d2h faults: degrading egress "
-                  "streamed → monolithic", file=sys.stderr, flush=True)
+            print(f"[serve] repeated d2h faults: degrading egress "
+                  f"streamed → monolithic (bucket {b.label()})",
+                  file=sys.stderr, flush=True)
             return True
         if kind in (FaultKind.COMPUTE, FaultKind.OOM, FaultKind.INTERNAL):
-            # The engine itself may be the broken thing (poisoned compile
-            # cache, leaked device state): rebuild it once. If the fresh
-            # engine still faults through a second budget window, the
-            # filter/input is broken, not the engine — FAIL.
-            self._recover(f"fault budget overflow ({kind})", kind=kind)
+            # The bucket's engine itself may be the broken thing
+            # (poisoned compile cache, leaked device state): rebuild it
+            # once. If the fresh engine still faults through a second
+            # budget window, the filter/input is broken, not the
+            # engine — FAIL.
+            self._recover(f"fault budget overflow ({kind})", kind=kind,
+                          bucket=b)
             return True
         return False
 
@@ -761,13 +1287,19 @@ class ServeFrontend:
             return
         self._recover(reason, kind=FaultKind.STALL)
 
-    def _recover(self, reason: str, kind: str = FaultKind.STALL) -> None:
+    def _recover(self, reason: str, kind: str = FaultKind.STALL,
+                 bucket: Optional["_Bucket"] = None) -> None:
         """Supervised recovery: shed the in-flight window (each lost
         frame attributed to ``kind`` in its session's fault counters),
         replace the collect thread (a wedged one exits when it wakes —
-        generation check), rebuild the Engine (recompile, re-warm,
-        re-calibrate h2d_block_ms), and reset the in-flight semaphore.
-        Open sessions are untouched: their frame index spaces, reorder
+        generation check), rebuild the affected buckets' Engines
+        (recompile, re-warm, re-calibrate h2d_block_ms — through the
+        program pool, so the persistent cache absorbs the recompile),
+        and reset the in-flight semaphore. ``bucket`` names the faulted
+        bucket when the caller knows it (budget overflow); a stall
+        rebuilds every bucket found in the shed window (all buckets if
+        the window was empty — the wedge has no known owner). Open
+        sessions are untouched: their frame index spaces, reorder
         cursors, and out queues survive, so indices stay monotone across
         the recovery. Runs in whichever thread detected the fault
         (supervisor, dispatch, or collect); serialized by _recover_lock.
@@ -779,6 +1311,7 @@ class ServeFrontend:
                   f"in-flight window, rebuilding engine",
                   file=sys.stderr, flush=True)
             self._recovering.set()
+            affected = set() if bucket is None else {bucket}
             try:
                 # Wait (bounded) for the dispatch thread to park, unless
                 # WE are the dispatch thread (then it's here, not mid-
@@ -798,6 +1331,8 @@ class ServeFrontend:
                         seq, plan, _result, _t0 = old_q.get_nowait()
                     except queue.Empty:
                         break
+                    if plan.bucket is not None:
+                        affected.add(plan.bucket)
                     self.router.discard(plan, kind=kind)
                     self._window.remove(seq)
                 # Batches popped by a wedged collect but never routed:
@@ -806,6 +1341,8 @@ class ServeFrontend:
                 # by the frontend, so this works with the watchdog off.
                 for _seq, plan in self._window.drain():
                     if plan is not None:
+                        if plan.bucket is not None:
+                            affected.add(plan.bucket)
                         self.router.discard(plan, kind=kind)
                 # Fresh queue + semaphore BEFORE the replacement collect
                 # thread starts: generation-pinning means the old thread
@@ -827,10 +1364,26 @@ class ServeFrontend:
                 self._threads = [x for x in self._threads if x.is_alive()]
                 self._threads.append(t)
                 t.start()
-                self.engine = self.engine.rebuild()
-                self._assembler = None
-                self._fetcher = None  # re-derive from the fresh engine's
-                #   re-calibrated d2h_block_ms
+                # Rebuild the affected buckets' engines. A wedge with no
+                # known owner (empty window, no named bucket) rebuilds
+                # everything — correctness first; the persistent cache
+                # makes the recompiles deserializes, not fresh XLA runs.
+                with self._lock:
+                    all_buckets = list(self._buckets)
+                targets = affected or set(all_buckets)
+                for b in targets:
+                    b.engine = b.engine.rebuild()
+                    if b._pooled and b.key is not None:
+                        try:
+                            self.pool.replace(b.key, b.engine)
+                        except RuntimeError:
+                            # Pool closed mid-recovery (owner stopping):
+                            # replace() freed the rebuilt engine — the
+                            # frontend is past serving this bucket.
+                            pass
+                    b.assembler = None
+                    b.fetcher = None  # re-derive from the fresh engine's
+                    #   re-calibrated d2h_block_ms
                 # Second straggler sweep: a dispatch iteration that was
                 # mid-staging when the drain above ran (wedged past the
                 # park deadline) has had the whole engine rebuild to land
@@ -847,6 +1400,9 @@ class ServeFrontend:
                 for _seq, plan in self._window.drain():
                     if plan is not None:
                         self.router.discard(plan, kind=kind)
+                # The window is empty: no bucket has anything in flight.
+                for b in all_buckets:
+                    b.reset_inflight()
                 self.recoveries += 1
             finally:
                 self._recovering.clear()
@@ -859,6 +1415,8 @@ class ServeFrontend:
                     if s.drained()]
             for sid, s in done:
                 self._sessions.pop(sid)
+                if s.bucket is not None:
+                    s.bucket.sessions.pop(sid, None)
                 self._retire_locked(sid, s)
         for _, s in done:
             s.finalize()
@@ -878,19 +1436,25 @@ class ServeFrontend:
                 if self._supervisor is not None:
                     self._supervisor.beat("dispatch")
                 with self._lock:
-                    sessions = [s for s in self._sessions.values()
-                                if s.state != CLOSED]
+                    bucket_sessions = [
+                        (b, [s for s in b.sessions.values()
+                             if s.state != CLOSED])
+                        for b in self._buckets if b.sessions]
                 plan = None
-                if sessions and self._frame_shape is not None:
-                    # Pick the slots only; the frames are staged through
-                    # the shared assembler below, after the in-flight
+                if bucket_sessions:
+                    # One bucket per tick (one compiled program per
+                    # batch): EDF-headroom ÷ measured tick cost picks
+                    # the bucket, then the ordinary within-bucket EDF
+                    # picks the slots. Frames are staged through the
+                    # bucket's assembler below, after the in-flight
                     # permit is acquired (the permit is what makes
                     # staging-slab reuse safe) — one staging
                     # implementation for both ingest modes.
-                    chosen = self.batcher.select(sessions, time.time())
+                    pick, chosen = self.batcher.select_bucket(
+                        bucket_sessions, time.time())
                     if chosen:
                         plan = BatchPlan(batch=None, valid=len(chosen),
-                                         slots=chosen)
+                                         slots=chosen, bucket=pick)
                 self._finalize_drained()
                 if plan is None:
                     time.sleep(self.config.tick_s)
@@ -925,27 +1489,39 @@ class ServeFrontend:
                     continue
                 q = self._inflight
                 t0 = time.time()
+                bucket = plan.bucket
+                # A tick-cost sample is trustworthy only when nothing
+                # else is in flight at submit: otherwise submit→
+                # materialize includes queue wait behind OTHER batches'
+                # device time (possibly other buckets' much costlier
+                # programs) and the EWMA the EDF/cost score divides by
+                # converges to the shared pipeline latency, not this
+                # program's cost. Contended ticks still count batches;
+                # they just don't feed the estimate.
+                plan.cost_sample = len(self._window) == 0
                 try:
-                    builder = self._builder_for(seq)
+                    builder = self._builder_for(bucket, seq)
                     for row, slot in enumerate(plan.slots):
                         builder.write_row(row, slot.frame)
                         slot.frame = None  # drop the client's buffer
                     batch, resident = builder.finish(plan.valid)
-                    result = (self.engine.submit_resident(batch)
-                              if resident else self.engine.submit(batch))
+                    engine = bucket.engine
+                    result = (engine.submit_resident(batch)
+                              if resident else engine.submit(batch))
                     # Start the D2H now — per output shard on the streamed
                     # egress path — so the collect side only waits, never
                     # initiates (runtime/egress.py).
-                    fetcher = self._fetcher_for()
+                    fetcher = self._fetcher_for(bucket)
                     if fetcher is not None:
                         fetcher.prefetch(result)
                     self.tracer.complete("serve_dispatch", t0, time.time(),
                                          TRACK_DISPATCH, seq=seq,
-                                         frames=plan.valid)
+                                         frames=plan.valid,
+                                         bucket=bucket.label())
                 except Exception as e:  # noqa: BLE001 — drop this batch
                     sem.release()
                     self.router.discard(plan, kind=classify(e, "dispatch"))
-                    if not self._contain(e, "dispatch"):
+                    if not self._contain(e, "dispatch", bucket=bucket):
                         return
                     continue
                 # In-flight window: registered from now until the collect
@@ -954,6 +1530,7 @@ class ServeFrontend:
                 # batch a wedged collect thread is holding. The watchdog
                 # (when armed) trips on this window's oldest age.
                 self._window.add(seq, plan)
+                bucket.adjust_inflight(1)
                 q.put((seq, plan, result, t0))
                 seq += 1
         except BaseException as e:  # noqa: BLE001
@@ -985,7 +1562,8 @@ class ServeFrontend:
                     if self._dispatch_done.is_set() and q.empty():
                         break
                     continue
-                fetcher = self._fetcher
+                bucket = plan.bucket
+                fetcher = bucket.fetcher if bucket is not None else None
                 try:
                     # Streamed egress: shard host copies into the slot's
                     # preallocated slab (D2H issued at submit); fallback:
@@ -1006,8 +1584,10 @@ class ServeFrontend:
                         continue
                     self._window.remove(seq)
                     sem.release()
+                    if bucket is not None:
+                        bucket.adjust_inflight(-1)
                     self.router.discard(plan, kind=classify(e, "collect"))
-                    if not self._contain(e, "collect"):
+                    if not self._contain(e, "collect", bucket=bucket):
                         return
                     continue
                 if self._collect_gen != gen:
@@ -1020,6 +1600,14 @@ class ServeFrontend:
                     continue
                 self._window.remove(seq)
                 sem.release()
+                if bucket is not None:
+                    # Live tick-cost sample for the EDF/cost bucket score
+                    # (submit → materialized wall time, EWMA-smoothed;
+                    # contended ticks are counted but not sampled — see
+                    # the dispatch-side cost_sample comment).
+                    bucket.observe_tick((time.time() - _t0) * 1e3,
+                                        sample=plan.cost_sample)
+                    bucket.adjust_inflight(-1)
                 self.tracer.complete("batch_complete", _t0, time.time(),
                                      TRACK_DEVICE, seq=seq,
                                      frames=plan.valid)
@@ -1037,6 +1625,7 @@ class ServeFrontend:
         with self._lock:
             live = dict(self._sessions)
             retired = dict(self._retired)
+            buckets = list(self._buckets)
         every = {**retired, **live}
         session_stats = {sid: s.stats() for sid, s in every.items()}
         return {
@@ -1064,15 +1653,20 @@ class ServeFrontend:
             "faults": self.faults.summary(),
             "fault_budget": self._budget.summary(),
             "recoveries": self.recoveries,
-            "engine_batches": self.engine.stats.batches,
-            "engine_frames": self.engine.stats.frames,
+            "engine_batches": sum(b.engine.stats.batches for b in buckets),
+            "engine_frames": sum(b.engine.stats.frames for b in buckets),
+            # Multi-signature plane: one row per live bucket (keyed by
+            # canonical signature) + the compiled-program pool counters.
+            "open_buckets": len(buckets),
+            "buckets": {b.label(): b.stats_row() for b in buckets},
+            "pool": self.pool.stats(),
             **self.router.stats(),
             "aggregate": LatencyStats.merged(
                 [s.latency for s in every.values()]),
-            **({"ingest": self._ingest_stats.summary()}
-               if self._ingest_stats is not None else {}),
-            **({"egress": self._egress_stats.summary()}
-               if self._egress_stats is not None else {}),
+            **({"ingest": buckets[0].ingest_stats.summary()}
+               if buckets[0].ingest_stats is not None else {}),
+            **({"egress": buckets[0].egress_stats.summary()}
+               if buckets[0].egress_stats is not None else {}),
             **({"supervisor": {
                     "stalls": self._supervisor.stalls,
                     "heartbeat_ages_s": self._supervisor.heartbeat_ages(),

@@ -107,6 +107,10 @@ class StreamSession:
         self.id = session_id
         self.config = config or SessionConfig()
         self.sink = sink
+        self.bucket: Any = None  # the signature bucket this session is
+        #   bound to (serve.server._Bucket, set at admission): which
+        #   compiled program serves it, which geometry its frames must
+        #   match, and where its faults/budget overflow attribute
         self.ingress = DropOldestQueue(maxsize=self.config.queue_size)
         # Scheduler-owned staging between ingress and the device: the
         # EDF/shed scan needs to see every queued frame, which the

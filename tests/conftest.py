@@ -81,6 +81,11 @@ def pytest_configure(config):
                    "subprocesses — run in tier-1, select with -m fleet; "
                    "capacity-gated scaling assertions skip cleanly where "
                    "the host can't express real parallelism)")
+    config.addinivalue_line(
+        "markers", "multitenant: multi-signature serving tests (signature "
+                   "buckets, compiled-program pool, AOT warm-start — CPU "
+                   "backend, bounded wall time; run in tier-1, select "
+                   "with -m multitenant)")
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -110,6 +115,33 @@ def _fleet_resources_released():
         fleet_threads = {t for t in fleet_threads if t.is_alive()}
     assert not fleet_threads, (
         f"fleet threads leaked: {sorted(t.name for t in fleet_threads)}")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _pool_engines_freed_on_close():
+    """Every pool-managed compiled program must release its device
+    buffers when its frontend closes (ServeFrontend.stop → pool.close /
+    engine.free): a pool engine still live at session end means some
+    stop path stopped freeing — a long-lived multi-tenant server
+    churning signatures would leak one compiled program (plus device
+    state) per signature forever. Only consults the registry when the
+    engine module was actually imported; a short grace window absorbs
+    teardown latency (the fleet guard's discipline)."""
+    yield
+    import sys as _sys
+
+    mod = _sys.modules.get("dvf_tpu.runtime.engine")
+    if mod is None:
+        return
+    deadline = time.time() + 5.0
+    leaked = mod.live_pool_engines()
+    while leaked and time.time() < deadline:
+        time.sleep(0.05)
+        leaked = mod.live_pool_engines()
+    assert not leaked, (
+        f"program-pool engines leaked (frontend stop() not called, or no "
+        f"longer freeing?): "
+        f"{[getattr(e, 'op_chain', '?') for e in leaked]}")
 
 
 @pytest.fixture

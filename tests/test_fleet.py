@@ -146,24 +146,61 @@ class TestLocalFleet:
             assert st["spillovers"] == 1
 
     def test_declared_signature_passthrough(self):
-        """The admission-time geometry check travels through the fleet:
-        a mismatched declaration is refused at open, not at submit."""
+        """Signature-aware admission end to end (max_buckets=1 pins the
+        pre-bucketing one-signature-per-replica contract): a follow-up
+        open of the SAME declared signature prefers the replica that
+        already compiled it (warm tiebreak over plain least-loaded); a
+        NEW signature cold-admits on the other, still-unpinned replica;
+        and a third signature — with every replica's bucket busy — is
+        refused by the whole fleet with the warm-signature list in the
+        rejection."""
         fleet = FleetFrontend(
             get_filter("invert"),
-            FleetConfig(replicas=2, mode="local", serve=serve_cfg()))
+            FleetConfig(replicas=2, mode="local",
+                        serve=serve_cfg(max_buckets=1)))
         with fleet:
             a = fleet.open_stream(frame_shape=(H, W, 3))
             fleet.submit(a, tagged_frame(0, 0))
-            # Same replica would be chosen next (least-loaded tiebreak
-            # means the OTHER one, which is unpinned) — declare on every
-            # open so both replicas pin to the fleet geometry.
+            # Warm preference: plain least-loaded would pick the OTHER
+            # (empty) replica; the warm tiebreak routes the same
+            # signature back to the one that already holds its program.
             b = fleet.open_stream(frame_shape=(H, W, 3))
-            with pytest.raises(AdmissionError, match="signature"):
-                # Both replicas hold a pinned signature now (declaration
-                # pins even before the first submit), so whichever
-                # replica this lands on must refuse it.
-                fleet.open_stream(frame_shape=(H + 2, W, 3))
-            del b
+            st = fleet.stats()
+            assert (st["sessions"][a]["replica"]
+                    == st["sessions"][b]["replica"])
+            assert st["warm_placements"] >= 1
+            # A new signature cold-admits on the unpinned survivor…
+            c = fleet.open_stream(frame_shape=(H + 2, W, 3))
+            st = fleet.stats()
+            assert (st["sessions"][c]["replica"]
+                    != st["sessions"][a]["replica"])
+            # …and a third, with both replicas' single bucket busy, is
+            # refused fleet-wide with the warm signatures enumerated.
+            with pytest.raises(AdmissionError,
+                               match=r"warm signatures.*invert\|16x24x3"):
+                fleet.open_stream(frame_shape=(H + 4, W, 3))
+
+    def test_fleet_precompile_warms_every_replica(self):
+        """FleetConfig.precompile (CLI --precompile): each replica AOT-
+        compiles the manifest at start, so the signature is warm
+        fleet-wide before any traffic and its first admission is a pool
+        hit."""
+        manifest = [{"op_chain": "grayscale",
+                     "frame_shape": [H, W, 3], "dtype": "u8"}]
+        fleet = FleetFrontend(
+            get_filter("invert"),
+            FleetConfig(replicas=2, mode="local", serve=serve_cfg(),
+                        precompile=manifest))
+        with fleet:
+            key = f"grayscale|{H}x{W}x3|uint8"
+            for r in fleet._replicas.values():
+                assert key in r.health()["warm_signatures"]
+            sid = fleet.open_stream(op_chain="grayscale",
+                                    frame_shape=(H, W, 3))
+            rid = fleet._sessions[sid].replica_id
+            st = fleet._replicas[rid].frontend.stats()
+            assert st["pool"]["hits"] >= 1
+            assert st["pool"]["misses"] == 1  # the precompile itself
 
     def test_chaos_replica_loss_migrate_restart(self):
         """Deterministic replica-loss injection (chaos site 'replica'):

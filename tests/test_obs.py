@@ -873,9 +873,27 @@ class TestExportSchemas:
         fe = ServeFrontend(get_filter("invert"),
                            ServeConfig(telemetry_sample_s=0.0))
         fe.open_stream()
-        self._assert_clean("serve.stats", fe.stats())
+        # A second signature exercises the multi-tenant surfaces: the
+        # per-bucket stats rows, the pool counters, and the bucket/
+        # compile-cache registry samples (all walked below).
+        fe.open_stream(op_chain="grayscale", frame_shape=(H, W, 3))
+        st = fe.stats()
+        assert st["open_buckets"] == 2 and len(st["buckets"]) == 2
+        assert st["pool"]["misses"] == 1
+        self._assert_clean("serve.stats", st)
         self._assert_clean("serve.signals", fe.signals())
         self._assert_clean("serve.health", fe.health())
+        # The bucket provider's sample names pass the same conformance
+        # gate the exporter applies (a bad name is silently dropped
+        # there — so pin the series we promise exist).
+        prom = fe.registry.to_prometheus()
+        for series in ("dvf_compile_cache_hits_total",
+                       "dvf_compile_cache_misses_total",
+                       "dvf_pool_evictions_total",
+                       "dvf_bucket_queue_depth"):
+            assert series in prom, series
+        assert 'bucket="grayscale|16x24x3|uint8"' in prom
+        fe.pool.close()  # unstarted frontend: free the leased program
 
         pipe = Pipeline([], get_filter("invert"), NullSink(),
                         PipelineConfig())
@@ -936,3 +954,22 @@ class TestExportSchemas:
             bench_e2e_streaming(get_filter("invert"), 16, 4, 16, 16))
         self._assert_clean("jpeg_wire_budget",
                            jpeg_wire_budget(32, 32, threads=1))
+
+    def test_admit_bench_writer(self):
+        """The ADMIT_BENCH.json writer (benchmarks/admit_bench.run) is
+        schema-conformant in quick mode — a renamed key there breaks
+        here instead of silently shipping a non-scrapable bench doc."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from benchmarks.admit_bench import run
+
+        doc = run(quick=True)
+        self._assert_clean("admit_bench", doc)
+        acc = doc["acceptance"]
+        # Quick mode still demonstrates the acceptance inequality: a
+        # pool-hit admission beats a cold JIT admission ≥ 10×.
+        assert acc["warm_admit_speedup_measured"] >= \
+            acc["warm_admit_speedup_target"]
+        assert doc["mixed"]["mixed_over_solo_ratio"] is not None

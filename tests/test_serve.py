@@ -471,29 +471,53 @@ def test_cli_serve_multi_demo(capsys):
 
 
 class TestAdmissionSignatureCheck:
-    """Satellite: a geometry/dtype declared at open_stream that can't
-    run on this frontend's compiled program is refused AT ADMISSION
-    (AdmissionError) instead of surfacing later as a geometry fault in
-    the batcher — the seam signature bucketing will extend."""
+    """A geometry/dtype declared at open_stream ROUTES the session: a
+    declaration matching a live bucket joins it, a new signature admits
+    by creating a bucket (its program compiled at admission, never as a
+    JIT stall on the serving path), and only past ``max_buckets`` is the
+    open refused — with the warm-signature list in the message
+    (tests/test_multitenant.py covers the multi-bucket matrix)."""
 
-    def test_mismatch_vs_pinned_signature_refused_at_open(self):
+    def test_mismatched_declaration_routes_to_new_bucket(self):
         fe = ServeFrontend(get_filter("invert"),
                            ServeConfig(batch_size=2, slo_ms=60_000.0))
         with fe:
             a = fe.open_stream(frame_shape=(H, W, 3))
             fe.submit(a, tagged_frame(0, 0))
-            before = fe.stats()["admission_rejections"]
-            with pytest.raises(AdmissionError, match="signature"):
-                fe.open_stream(frame_shape=(H + 8, W, 3))
-            with pytest.raises(AdmissionError, match="signature"):
-                fe.open_stream(frame_shape=(H, W, 3),
+            before = fe.stats()
+            b = fe.open_stream(frame_shape=(H + 8, W, 3))
+            c = fe.open_stream(frame_shape=(H, W, 3),
                                frame_dtype=np.float32)
-            assert fe.stats()["admission_rejections"] == before + 2
+            stats = fe.stats()
+            assert stats["admission_rejections"] == \
+                before["admission_rejections"]
+            assert stats["open_buckets"] == 3
+            # Each declared signature got its own compiled program.
+            assert stats["pool"]["misses"] == 2
+            assert b != c
 
-    def test_mismatch_vs_precompiled_engine_refused_at_open(self):
-        """A caller-built engine arrives already compiled: the declared
-        shape is checked against ITS signature, not just first-submit
-        pinning."""
+    def test_bucket_cap_refusal_enumerates_warm_signatures(self):
+        """At max_buckets with no idle bucket, the refusal names what
+        the pool CAN serve cheaply (satellite: actionable rejections)."""
+        fe = ServeFrontend(get_filter("invert"),
+                           ServeConfig(batch_size=2, max_buckets=1,
+                                       slo_ms=60_000.0))
+        with fe:
+            a = fe.open_stream(frame_shape=(H, W, 3))
+            assert a
+            with pytest.raises(AdmissionError,
+                               match=r"warm signatures.*invert\|16x24x3"):
+                fe.open_stream(frame_shape=(H + 8, W, 3))
+            st = fe.stats()
+            assert st["admission_rejections"] == 1
+            # The refusal happened BEFORE any compile: a full frontend
+            # must not pay (and pool) seconds of JIT just to say no.
+            assert st["pool"]["misses"] == 0
+
+    def test_matching_declaration_joins_precompiled_engine(self):
+        """A caller-built engine arrives already compiled: a matching
+        declaration joins its bucket (no second program), a mismatch
+        forks a new bucket."""
         from dvf_tpu.runtime.engine import Engine
 
         filt = get_filter("invert")
@@ -501,14 +525,17 @@ class TestAdmissionSignatureCheck:
         engine.compile((2, H, W, 3), np.uint8)
         fe = ServeFrontend(filt, ServeConfig(batch_size=2), engine=engine)
         with fe:
-            with pytest.raises(AdmissionError, match="signature"):
-                fe.open_stream(frame_shape=(H * 2, W, 3))
-            sid = fe.open_stream(frame_shape=(H, W, 3))  # match: admitted
+            sid = fe.open_stream(frame_shape=(H, W, 3))  # match: joins
             assert sid
+            assert fe.stats()["open_buckets"] == 1
+            assert fe.stats()["pool"]["misses"] == 0
+            fe.open_stream(frame_shape=(H * 2, W, 3))    # fork
+            assert fe.stats()["open_buckets"] == 2
 
-    def test_declaration_pins_unpinned_frontend(self):
-        """First declaration pins the frontend: a later submit at a
-        different geometry gets the pinned-signature ValueError."""
+    def test_declaration_pins_default_bucket(self):
+        """First declaration pins the default bucket: a later submit at
+        a different geometry on THAT session gets the pinned-signature
+        ValueError (per-stream geometry is still fixed)."""
         fe = ServeFrontend(get_filter("invert"),
                            ServeConfig(batch_size=2))
         with fe:
