@@ -1,0 +1,420 @@
+"""Sustained-overload soak bench: graceful degradation as a feature.
+
+The acceptance question for the load-adaptive control plane
+(``dvf_tpu/control``) is not "how fast is it" but "what happens past
+capacity": a serving stack without load control answers a 2x traffic
+burst by letting every queue fill — p99 explodes to the queue-drain
+time — while a controlled stack should BEND: downshift per-session
+quality (sr upscale keeps deliveries full resolution), refuse the
+lowest tiers at the door, and hold interactive-tier p99 near its
+at-capacity value with zero hard session failures.
+
+Three legs, same signature and session-churn harness (bursty arrivals,
+bounded lifetimes — 1000s of sessions over a full run):
+
+- **uncontrolled_capacity**: control off, offered ~0.8x measured
+  capacity — the baseline interactive-tier p99 everything is judged
+  against.
+- **uncontrolled_overload**: control off, offered >= 2x capacity — the
+  collapse leg (p99 blows up >= 10x and/or frames shed en masse).
+- **controlled_overload**: control ON at the same offered load — the
+  acceptance bar: interactive-tier p99 within 2x the baseline leg's,
+  zero hard session failures (admission refusals are graceful shed,
+  not failures).
+
+Writes benchmarks/SOAK_BENCH.json. CPU-runnable (``quick=True``
+shrinks every leg for the tier-1 schema test); numbers on this
+hypervisor-oversubscribed CI box drift with steal time — the LEG
+RATIOS are the claim, not the absolute fps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+TIER_NAMES = {0: "interactive", 1: "standard", 2: "batch"}
+# Arrival tier mix: 25% interactive, 25% standard, 50% batch — the
+# batch half is what the admission floor / bin-packing shed first.
+TIER_CYCLE = (0, 1, 2, 2)
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+# ---------------------------------------------------------------------------
+# One soak leg (shared churn harness)
+# ---------------------------------------------------------------------------
+
+
+def run_leg(control, concurrency, duration_s, chain, shape, batch,
+            slo_ms=4000.0, per_session_fps=25.0, life_s=1.5,
+            burst=4, queue_size=64, seed=0, control_interval_s=0.25,
+            n_persistent=4):
+    """Persistent interactive tenants + bursty open/close churn at a
+    fixed aggregate offered rate; returns per-tier latency percentiles
+    + failure accounting.
+
+    ``n_persistent`` tier-0 (interactive) sessions live the WHOLE leg —
+    the "paid tenant" shape the acceptance p99 is measured on (a
+    session must outlive the control loop's reaction time for
+    downshift to mean anything). ``concurrency`` churn slots each
+    loop: open a standard/batch-tier session -> submit at
+    ``per_session_fps`` for ~``life_s`` -> graceful close -> reopen.
+    Churn slots start in bursts of ``burst`` and lifetimes jitter
+    +-30%, so opens/closes arrive in clumps, not a steady drip. An
+    admission refusal (tier floor, capacity guard) is counted and
+    retried after a backoff — graceful shed by contract. Hard
+    failures = ServeError/unexpected errors on a live session."""
+    from dvf_tpu.control import ControlConfig
+    from dvf_tpu.runtime.signature import build_filter
+    from dvf_tpu.serve import AdmissionError, ServeConfig, ServeFrontend
+    from dvf_tpu.serve.session import ServeError
+
+    cfg = ServeConfig(
+        batch_size=batch, queue_size=queue_size, slo_ms=slo_ms,
+        max_sessions=max(32, 2 * (concurrency + n_persistent)),
+        control=control,
+        control_config=(ControlConfig(interval_s=control_interval_s,
+                                      down_after=2,
+                                      # Sustained-overload posture:
+                                      # recovery probes are the enemy of
+                                      # p99 here — every release/upshift
+                                      # re-admits the flood and re-trips
+                                      # the overload (~1-2 s of tail per
+                                      # probe), so calm must be LONG
+                                      # (10 s) before the floor steps or
+                                      # quality recovers, and opposite
+                                      # quality moves dwell 15 s apart.
+                                      up_after=40, min_dwell=60,
+                                      overload_after=3,
+                                      saturate_after=12,
+                                      # A recompile on this 2-vCPU host
+                                      # costs more than a better batch
+                                      # size saves at soak timescales.
+                                      resize_hold=6, resize_cooldown=40)
+                        if control else None))
+    fe = ServeFrontend(build_filter(chain), cfg)
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_by_tier = {t: [] for t in TIER_NAMES}
+    counts = {"opened": 0, "admission_refusals": 0, "hard_failures": 0,
+              "delivered": 0}
+    rng0 = np.random.default_rng(seed)
+    frame = rng0.integers(0, 255, shape, dtype=np.uint8)
+    # Churn arrivals: 1/3 standard, 2/3 batch (interactive traffic is
+    # the persistent set).
+    churn_tiers = (1, 2, 2)
+
+    def persistent(idx):
+        """One interactive tenant, alive the whole leg."""
+        period = 1.0 / per_session_fps
+        try:
+            sid = fe.open_stream(op_chain=chain, frame_shape=shape,
+                                 tier=0)
+        except Exception:  # noqa: BLE001 — an interactive open refused
+            with lock:     # IS a hard failure: they shed last
+                counts["hard_failures"] += 1
+            return
+        with lock:
+            counts["opened"] += 1
+        my_lat = []
+        nxt = time.perf_counter()
+        try:
+            while not stop.is_set():
+                fe.submit(sid, frame)
+                for d in fe.poll(sid):
+                    my_lat.append(d.latency_ms)
+                nxt += period
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            fe.close(sid, drain=True)
+            t_tail = time.time() + 3.0
+            idle = 0
+            while time.time() < t_tail and idle < 5:
+                got = fe.poll(sid)
+                for d in got:
+                    my_lat.append(d.latency_ms)
+                idle = 0 if got else idle + 1
+                time.sleep(0.02)
+        except Exception:  # noqa: BLE001 — incl. ServeError: a live
+            with lock:     # interactive session erroring is THE hard
+                counts["hard_failures"] += 1   # failure the bench exists
+            return                             # to rule out
+        with lock:
+            lat_by_tier[0].extend(my_lat)
+            counts["delivered"] += len(my_lat)
+
+    def slot(slot_idx):
+        rng = np.random.default_rng(seed * 10_007 + slot_idx)
+        # Bursty starts: slots wake in clumps of ``burst``.
+        time.sleep((slot_idx // burst) * (life_s / max(1, burst)))
+        period = 1.0 / per_session_fps
+        while not stop.is_set():
+            tier = churn_tiers[(slot_idx + counts["opened"])
+                               % len(churn_tiers)]
+            try:
+                sid = fe.open_stream(op_chain=chain, frame_shape=shape,
+                                     tier=tier)
+            except AdmissionError:
+                with lock:
+                    counts["admission_refusals"] += 1
+                time.sleep(0.25)   # graceful: retry after backoff
+                continue
+            except Exception:  # noqa: BLE001
+                with lock:
+                    counts["hard_failures"] += 1
+                time.sleep(0.25)
+                continue
+            with lock:
+                counts["opened"] += 1
+            my_lat = []
+            life = life_s * (0.7 + 0.6 * rng.random())
+            t_end = time.time() + life
+            nxt = time.perf_counter()
+            try:
+                while time.time() < t_end and not stop.is_set():
+                    fe.submit(sid, frame)
+                    for d in fe.poll(sid):
+                        my_lat.append(d.latency_ms)
+                    nxt += period
+                    dt = nxt - time.perf_counter()
+                    if dt > 0:
+                        time.sleep(dt)
+                fe.close(sid, drain=True)
+                t_tail = time.time() + 3.0
+                idle = 0
+                while time.time() < t_tail and idle < 5:
+                    got = fe.poll(sid)
+                    for d in got:
+                        my_lat.append(d.latency_ms)
+                    idle = 0 if got else idle + 1
+                    time.sleep(0.02)
+            except (ServeError, ValueError):
+                with lock:
+                    counts["hard_failures"] += 1
+                return
+            except Exception:  # noqa: BLE001
+                with lock:
+                    counts["hard_failures"] += 1
+                return
+            with lock:
+                lat_by_tier[tier].extend(my_lat)
+                counts["delivered"] += len(my_lat)
+
+    with fe:
+        # AOT warm-start (PR 9's --precompile, the documented production
+        # posture): every leg pays its program compiles BEFORE the load
+        # clock starts, identically — the leg measures serving under
+        # load, not cold-compile queueing. The controlled leg
+        # additionally warms the ×2 downshift program so the quality
+        # controller's first actuation is a pool hit, not a mid-overload
+        # compile on an already-saturated host.
+        manifest = [{"op_chain": chain, "frame_shape": list(shape)}]
+        if control:
+            manifest.append({
+                "op_chain": f"{chain}|upscale(scale=2)",
+                "frame_shape": [shape[0] // 2, shape[1] // 2, *shape[2:]],
+            })
+        fe.precompile(manifest)
+        threads = [threading.Thread(target=persistent, args=(i,),
+                                    daemon=True)
+                   for i in range(n_persistent)]
+        threads += [threading.Thread(target=slot, args=(i,), daemon=True)
+                    for i in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        wall = time.perf_counter() - t0
+        st = fe.stats()
+
+    tiers = {}
+    for t, name in TIER_NAMES.items():
+        xs = lat_by_tier[t]
+        tiers[name] = {
+            "delivered_total": len(xs),
+            "p50_ms": _pct(xs, 0.50),
+            "p99_ms": _pct(xs, 0.99),
+        }
+    all_lat = [x for xs in lat_by_tier.values() for x in xs]
+    out = {
+        "control": bool(control),
+        "offered_fps": (concurrency + n_persistent) * per_session_fps,
+        "concurrency": concurrency + n_persistent,
+        "persistent_interactive_sessions": n_persistent,
+        "duration_s": round(wall, 2),
+        "sessions_opened_total": counts["opened"],
+        "admission_refusals_total": counts["admission_refusals"],
+        "hard_failures_total": counts["hard_failures"],
+        "delivered_total": counts["delivered"],
+        "delivered_fps": counts["delivered"] / wall if wall else None,
+        "shed_total": int(st["shed_total"]),
+        "failed_frames_total": int(sum(
+            s.get("failed", 0) for s in st["sessions"].values())),
+        "errors_total": int(st["errors"]),
+        "p50_ms": _pct(all_lat, 0.50),
+        "p99_ms": _pct(all_lat, 0.99),
+        "tiers": tiers,
+    }
+    if control and "control" in st:
+        ctl = st["control"]
+        out["control_actions"] = {
+            k: ctl[k] for k in
+            ("actions_total", "downshifts_total", "upshifts_total",
+             "batch_resizes_total", "tick_changes_total",
+             "tier_floor_changes_total", "saturations_total",
+             "rejected_quality_total", "apply_errors_total")}
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick=False):
+    """The full bench document (SOAK_BENCH.json). ``quick`` shrinks
+    every leg to seconds for the tier-1 schema test.
+
+    Leg order: the UNCONTROLLED OVERLOAD leg runs first at a fixed
+    high concurrency and doubles as the capacity measurement — admitted
+    capacity is what the serving stack actually delivers when the SAME
+    paced-churn harness pushes it past saturation. (An unthrottled
+    4-driver probe measures a different regime on 2 vCPUs: its spin
+    loops steal the GIL from the serve threads, and the number it
+    produces set every leg's offered load from a denominator the legs
+    never experience — the first committed run's "2.2x capacity" was
+    really ~1.1x and nothing collapsed.) The baseline leg then offers
+    0.8x that capacity, and the controlled leg re-runs the EXACT
+    overload concurrency with the control plane on."""
+    import jax
+
+    if quick:
+        chain, shape, batch = "gaussian_blur(ksize=9)|invert", \
+            (32, 32, 3), 2
+        leg_s, life_s, psf = 3.0, 0.8, 40.0
+        over_conc, max_conc, n_pers = 6, 6, 2
+        interval = 0.1
+    else:
+        # Heavy enough per frame that true capacity sits well below
+        # what the paced driver threads can offer on 2 vCPUs —
+        # otherwise "2x capacity" is unreachable by the harness itself.
+        chain = "gaussian_blur(ksize=9)|gaussian_blur(ksize=9)|invert"
+        shape, batch = (256, 256, 3), 8
+        leg_s, life_s, psf = 75.0, 1.5, 12.5
+        over_conc, max_conc, n_pers = 20, 24, 4
+        interval = 0.25
+
+    common = dict(chain=chain, shape=shape, batch=batch,
+                  per_session_fps=psf, life_s=life_s,
+                  control_interval_s=interval, n_persistent=n_pers)
+    over_unc = run_leg(False, over_conc, leg_s, seed=2, **common)
+    capacity = over_unc["delivered_fps"]
+
+    def _churn(mult):
+        # Churn-slot count for an offered load of mult x capacity
+        # (persistent interactive tenants included), bounded: 2 vCPUs
+        # host only so many paced threads before the harness is the
+        # bottleneck (a clamp is visible via offered_fps).
+        want = mult * capacity / psf - n_pers
+        return max(2, min(max_conc, int(round(want))))
+
+    base = run_leg(False, _churn(0.8), leg_s, seed=1, **common)
+    # Same offered load as the uncontrolled overload leg, control ON.
+    over_ctl = run_leg(True, over_conc, leg_s, seed=3, **common)
+
+    def _ratio(a, b):
+        return (a / b) if (a and b) else None
+
+    base_int_p99 = base["tiers"]["interactive"]["p99_ms"]
+    shed_ratio = _ratio(
+        over_unc["shed_total"],
+        over_unc["shed_total"] + over_unc["delivered_total"])
+    return {
+        "schema": "dvf.soak_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "device_count": jax.device_count(),
+        "op_chain": chain,
+        "frame_shape": list(shape),
+        "batch": batch,
+        "capacity_fps": capacity,
+        "capacity_method": "uncontrolled overload leg delivered fps "
+                           "(saturated paced-churn harness, control off)",
+        "offered_over_capacity_ratio": _ratio(
+            over_unc["offered_fps"], capacity),
+        "uncontrolled_capacity": base,
+        "uncontrolled_overload": over_unc,
+        "controlled_overload": over_ctl,
+        "acceptance": {
+            # Controlled interactive p99 within 2x its at-capacity value,
+            # with zero hard session failures.
+            "target_controlled_interactive_p99_over_baseline_ratio": 2.0,
+            "controlled_interactive_p99_over_baseline_ratio": _ratio(
+                over_ctl["tiers"]["interactive"]["p99_ms"], base_int_p99),
+            "controlled_hard_failures_total":
+                over_ctl["hard_failures_total"],
+            # Uncontrolled collapse: overall p99 blows >= 10x baseline
+            # AND/OR frames shed en masse (tier-aware slot picking is
+            # structural — it protects interactive p99 even with the
+            # control plane off, so the collapse shows up as everyone
+            # else's p99 plus mass shedding, exactly the "sheds/fails
+            # sessions" arm of the acceptance bar).
+            "target_uncontrolled_p99_over_baseline_ratio": 10.0,
+            "uncontrolled_p99_over_baseline_ratio": _ratio(
+                over_unc["p99_ms"], base["p99_ms"]),
+            "uncontrolled_interactive_p99_over_baseline_ratio": _ratio(
+                over_unc["tiers"]["interactive"]["p99_ms"], base_int_p99),
+            "uncontrolled_shed_total": over_unc["shed_total"],
+            "uncontrolled_shed_ratio": shed_ratio,
+            "controlled_shed_total": over_ctl["shed_total"],
+        },
+    }
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    out_path = os.path.join(_HERE, "SOAK_BENCH.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, default=float)
+        f.write("\n")
+    acc = doc["acceptance"]
+
+    def _f(x, spec=".2f"):
+        return format(x, spec) if isinstance(x, (int, float)) else "n/a"
+
+    print(f"[soak_bench] capacity {_f(doc['capacity_fps'], '.0f')} fps; "
+          f"overload x{_f(doc['offered_over_capacity_ratio'], '.1f')}: "
+          f"uncontrolled p99 ratio "
+          f"{_f(acc['uncontrolled_p99_over_baseline_ratio'])}, "
+          f"shed {acc['uncontrolled_shed_total']}; controlled "
+          f"interactive p99 ratio "
+          f"{_f(acc['controlled_interactive_p99_over_baseline_ratio'])} "
+          f"(target <= {acc['target_controlled_interactive_p99_over_baseline_ratio']}), "
+          f"hard failures {acc['controlled_hard_failures_total']}; "
+          f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -350,8 +350,11 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         flight_dir=args.flight_dir,
         # The sliding signal window costs a per-second percentile merge;
         # pay it only when something reads it (scrape endpoint here,
-        # or the burn trigger via flight_dir inside the frontend).
+        # the burn trigger via flight_dir, or the control plane, which
+        # arms its own cadence inside the frontend).
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
+        control=args.control,
+        default_tier=args.tier if args.tier is not None else 1,
     )
     frontend = ServeFrontend(filt, config, engine=engine)
     manifest = _load_manifest(args.precompile)
@@ -382,7 +385,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
 
     try:
         with frontend:
-            sids = [frontend.open_stream(slo_ms=args.slo_ms) for _ in range(n)]
+            sids = [frontend.open_stream(slo_ms=args.slo_ms, tier=args.tier)
+                    for _ in range(n)]
             drivers = [
                 threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
                 for i, (sid, rate) in enumerate(zip(sids, rates))
@@ -709,6 +713,7 @@ def cmd_fleet(args) -> int:
         stall_timeout_s=(args.stall_timeout
                          if args.stall_timeout is not None else 30.0),
         trace=args.trace,
+        control=args.control,
     )
     config = FleetConfig(
         replicas=args.replicas,
@@ -763,7 +768,8 @@ def cmd_fleet(args) -> int:
                 try:
                     sids.append(fleet.open_stream(
                         slo_ms=args.slo_ms,
-                        frame_shape=(args.height, args.width, 3)))
+                        frame_shape=(args.height, args.width, 3),
+                        tier=args.tier))
                 except AdmissionError as e:
                     print(f"error: admission refused: {e}", file=sys.stderr)
                     return 2
@@ -1537,6 +1543,20 @@ def main(argv=None) -> int:
     sp.add_argument("--max-sessions", type=int, default=0,
                     help="admission cap for --sessions mode "
                          "(0 = max(16, --sessions))")
+    sp.add_argument("--control", action="store_true",
+                    help="--sessions mode: arm the load-adaptive control "
+                         "plane (dvf_tpu.control) — closed-loop "
+                         "controllers over the telemetry ring resize "
+                         "per-bucket batches/tick budget, downshift "
+                         "session quality under sustained pressure "
+                         "(sr upscale keeps deliveries full-res), and "
+                         "raise the priority-tier admission floor")
+    sp.add_argument("--tier", type=int, default=None,
+                    help="priority tier for the demo's streams (0 "
+                         "interactive — sheds LAST, 1 standard, 2 "
+                         "batch — sheds first; default 1). Under "
+                         "--control overload the admission floor "
+                         "refuses high tier values first")
 
     fl = sub.add_parser(
         "fleet", parents=[plat, ing, res, obsp, sig],
@@ -1584,6 +1604,15 @@ def main(argv=None) -> int:
                          "demo: aggregate throughput at 1 and "
                          "--replicas replicas, core-pinned workers "
                          "(benchmarks/fleet_bench.py persists this)")
+    fl.add_argument("--control", action="store_true",
+                    help="arm the load-adaptive control plane on every "
+                         "replica's frontend (see serve --control); the "
+                         "fleet door additionally bin-packs batch-tier "
+                         "opens and reserves headroom for "
+                         "interactive/standard tiers")
+    fl.add_argument("--tier", type=int, default=None,
+                    help="priority tier for the demo's streams (0 "
+                         "interactive, 1 standard, 2 batch; default 1)")
 
     cp = sub.add_parser(
         "camera",  # host-only (no jax): the --platform flag would be a no-op

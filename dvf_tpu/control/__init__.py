@@ -1,0 +1,42 @@
+"""Load-adaptive control plane — the fifth tier, above supervision.
+
+PR 4 made the runtime degrade gracefully on *faults*; this package makes
+it degrade gracefully on *load*: closed-loop controllers read the
+telemetry ring PR 8 built (queue depth, SLO headroom, fps, p99 at a
+fixed cadence) and actuate the knobs the runtime already exposes —
+per-bucket batch size and the dispatch tick budget, per-session
+resolution (with the ``ops/sr.py`` upscale stage restoring full
+client-visible resolution), and priority-tier admission — so a traffic
+burst past capacity bends p99 instead of collapsing it.
+
+See `control.controllers` for the decision logic (deterministic,
+replayable) and `control.plane` for the loop wiring.
+"""
+
+from dvf_tpu.control.controllers import (
+    TIER_BATCH,
+    TIER_INTERACTIVE,
+    TIER_NAMES,
+    TIER_STANDARD,
+    Action,
+    BatchTickController,
+    ControlConfig,
+    QualityController,
+    TierAdmissionController,
+    is_pressure,
+)
+from dvf_tpu.control.plane import ControlPlane
+
+__all__ = [
+    "Action",
+    "BatchTickController",
+    "ControlConfig",
+    "ControlPlane",
+    "QualityController",
+    "TierAdmissionController",
+    "TIER_BATCH",
+    "TIER_INTERACTIVE",
+    "TIER_NAMES",
+    "TIER_STANDARD",
+    "is_pressure",
+]

@@ -43,6 +43,8 @@ class SpilloverAdmission:
         self.spillovers = 0   # opens that fell past their first choice
         self.rejections = 0   # opens refused by every healthy replica
         self.warm_placements = 0  # opens routed by signature warmth
+        self.tier_rejections = 0  # low-tier opens refused by the fleet
+        #   capacity guard (graceful shed, not a failure)
 
     def candidates(
         self,
@@ -51,6 +53,7 @@ class SpilloverAdmission:
         exclude: Optional[Iterable[str]] = None,
         warm: Optional[Dict[str, Iterable[str]]] = None,
         key: Optional[str] = None,
+        prefer_packed: bool = False,
     ) -> List:
         """Healthy replicas ranked by warm-biased load (see module
         docstring): effective load = load − 1 for a replica warm for
@@ -60,7 +63,16 @@ class SpilloverAdmission:
         ``health()`` export); ``key`` is the open's canonical signature
         render (None = undeclared → pure least-loaded). ``exclude``
         drops specific ids — migration must not re-place a session on
-        the replica it is fleeing."""
+        the replica it is fleeing.
+
+        ``prefer_packed`` inverts the load rank (bin-packing): batch-
+        tier sessions fill the FULLEST replica that still admits them,
+        keeping the emptiest replicas' headroom for interactive opens —
+        the placement half of "paid sessions shed last". Warmth is an
+        attraction in BOTH modes: spillover subtracts the bias from the
+        load (a warm replica looks emptier), packing adds it (a warm
+        replica looks fuller) — negating the spillover rank wholesale
+        would turn the warm bonus into a cold preference."""
         from dvf_tpu.fleet.replica import HEALTHY
 
         banned = set(exclude or ())
@@ -71,9 +83,16 @@ class SpilloverAdmission:
             cold = 1
             if key is not None and warm:
                 cold = 0 if key in set(warm.get(r.id) or ()) else 1
-            return (load.get(r.id, 0) - (1 - cold), cold, r.id)
+            bias = 1 - cold   # bounded +1 attraction for a warm pool
+            if prefer_packed:
+                return (-(load.get(r.id, 0) + bias), cold, r.id)
+            return (load.get(r.id, 0) - bias, cold, r.id)
 
         return sorted(ok, key=rank)
+
+    def record_tier_rejection(self) -> None:
+        with self._lock:
+            self.tier_rejections += 1
 
     def record_warm_placement(self) -> None:
         with self._lock:
@@ -91,4 +110,5 @@ class SpilloverAdmission:
         with self._lock:
             return {"spillovers": self.spillovers,
                     "rejections": self.rejections,
-                    "warm_placements": self.warm_placements}
+                    "warm_placements": self.warm_placements,
+                    "tier_rejections": self.tier_rejections}

@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dvf_tpu.control.controllers import TIER_BATCH
 from dvf_tpu.fleet.admission import SpilloverAdmission
 from dvf_tpu.fleet.replica import (
     DEAD,
@@ -125,6 +126,18 @@ class FleetConfig:
     #   loss or a replica-side watchdog trip (stalls delta in health())
     #   dumps merged per-replica traces + fleet stats here. None = off.
     flight_min_interval_s: float = 10.0
+    flight_max_total_bytes: Optional[int] = 256 * 1024 * 1024  # on-disk
+    #   bound across dumps (oldest evicted; None = count cap only)
+    tier_guard_frac: float = 0.85  # fleet-level tier-aware admission:
+    #   batch-tier (tier >= 2) opens are refused once fleet-wide bound
+    #   sessions reach this fraction of total healthy capacity
+    #   (healthy replicas × serve.max_sessions) — the remaining slots
+    #   are headroom reserved for interactive/standard tenants. 0
+    #   disables the guard. Batch-tier opens also BIN-PACK (fullest
+    #   admitting replica first) so empty replicas stay empty for
+    #   high-priority arrivals; replica-local admission floors (the
+    #   serve control plane) additionally push refused low-tier opens
+    #   to replicas with headroom via ordinary spillover.
     precompile: Optional[list] = None  # --precompile manifest entries
     #   (runtime.signature.parse_manifest input): every replica AOT-
     #   compiles these at start — and again at RESPAWN, where the
@@ -138,11 +151,12 @@ class _FleetSession:
 
     __slots__ = ("sid", "replica_id", "replica_sid", "generation",
                  "next_index", "last_index", "slo_ms", "frame_shape",
-                 "frame_dtype", "op_chain", "lock", "tail", "migrations",
-                 "lost", "polled", "closed", "orphaned", "load_counted")
+                 "frame_dtype", "op_chain", "tier", "lock", "tail",
+                 "migrations", "lost", "polled", "closed", "orphaned",
+                 "load_counted")
 
     def __init__(self, sid: str, replica_id: str, slo_ms, frame_shape,
-                 frame_dtype, op_chain=None):
+                 frame_dtype, op_chain=None, tier=None):
         self.sid = sid
         self.replica_id = replica_id
         self.replica_sid = sid           # sid@gN after migrations
@@ -154,6 +168,10 @@ class _FleetSession:
         self.frame_dtype = frame_dtype
         self.op_chain = op_chain         # declared chain — a migration
         #   re-declares it so the survivor routes to the same bucket
+        self.tier = tier                 # priority tier — controller
+        #   state that SURVIVES migration: re-declared at the migration
+        #   open, so the survivor's control plane sheds this session in
+        #   the same order the lost replica's would have
         self.lock = threading.Lock()
         self.tail: List[Delivery] = []   # salvaged pre-migration deliveries
         self.migrations = 0
@@ -230,6 +248,7 @@ class FleetFrontend:
             self.flight = FlightRecorder(
                 self.config.flight_dir, label="fleet",
                 min_interval_s=self.config.flight_min_interval_s,
+                max_total_bytes=self.config.flight_max_total_bytes,
                 trace_fn=self.trace_snapshots,
                 stats_fn=self.stats,
                 ring=self.telemetry)
@@ -386,6 +405,7 @@ class FleetFrontend:
         frame_shape: Optional[tuple] = None,
         frame_dtype: Any = None,
         op_chain: Optional[str] = None,
+        tier: Optional[int] = None,
     ) -> str:
         """Admit one stream, signature-aware: a declared
         ``(op_chain, frame_shape, frame_dtype)`` prefers a replica whose
@@ -398,6 +418,7 @@ class FleetFrontend:
         cheaply."""
         key_render = self._signature_render(op_chain, frame_shape,
                                             frame_dtype)
+        low_tier = tier is not None and int(tier) >= TIER_BATCH
         with self._open_lock:
             sid = (session_id if session_id is not None
                    else f"fs{next(self._ids)}")
@@ -406,9 +427,26 @@ class FleetFrontend:
                     raise ServeError(f"session id {sid!r} already exists")
                 load = dict(self._load)
                 warm = {rid: list(v) for rid, v in self._warm.items()}
+            if low_tier and self.config.tier_guard_frac > 0:
+                # Tier-aware capacity guard: refuse batch tier while the
+                # fleet is near capacity — the remaining slots are
+                # reserved headroom for higher-priority arrivals.
+                healthy = sum(1 for r in self._replicas.values()
+                              if r.state == HEALTHY)
+                cap = healthy * self.config.serve.max_sessions
+                if cap and sum(load.values()) >= \
+                        self.config.tier_guard_frac * cap:
+                    self.admission.record_tier_rejection()
+                    self.admission.record_rejection()
+                    raise AdmissionError(
+                        f"tier {tier} not admitted: fleet at "
+                        f"{sum(load.values())}/{cap} bound sessions "
+                        f"(>= {self.config.tier_guard_frac:g} guard) — "
+                        f"remaining capacity is reserved for "
+                        f"interactive/standard tiers")
             cands = self.admission.candidates(
                 list(self._replicas.values()), load,
-                warm=warm, key=key_render)
+                warm=warm, key=key_render, prefer_packed=low_tier)
             if not cands:
                 self.admission.record_rejection()
                 raise AdmissionError("no healthy replicas in the fleet")
@@ -420,7 +458,7 @@ class FleetFrontend:
                     r.open_stream(sid, slo_ms=slo_ms,
                                   frame_shape=frame_shape,
                                   frame_dtype=frame_dtype,
-                                  op_chain=op_chain)
+                                  op_chain=op_chain, tier=tier)
                 except AdmissionError as e:
                     last_refusal = e
                     hops += 1
@@ -443,7 +481,8 @@ class FleetFrontend:
                         if key_render not in kn:
                             kn.append(key_render)
                 s = _FleetSession(sid, r.id, slo_ms, frame_shape,
-                                  frame_dtype, op_chain=op_chain)
+                                  frame_dtype, op_chain=op_chain,
+                                  tier=tier)
                 with self._lock:
                     self._sessions[sid] = s
                     self._load[r.id] = self._load.get(r.id, 0) + 1
@@ -819,10 +858,16 @@ class FleetFrontend:
                             s.op_chain, s.frame_shape, s.frame_dtype)):
                     new_sid = f"{s.sid}@g{s.generation + 1}"
                     try:
+                        # Controller-relevant state survives migration:
+                        # the tier is re-declared, so the survivor's
+                        # control plane sheds this session in the same
+                        # order (its quality level re-converges from the
+                        # survivor's own telemetry).
                         target.open_stream(new_sid, slo_ms=s.slo_ms,
                                            frame_shape=s.frame_shape,
                                            frame_dtype=s.frame_dtype,
-                                           op_chain=s.op_chain)
+                                           op_chain=s.op_chain,
+                                           tier=s.tier)
                     except (AdmissionError, ReplicaLostError):
                         continue
                     self._uncount_load(s)
@@ -883,6 +928,8 @@ class FleetFrontend:
             "migrated_sessions_total": float(self.migrated_sessions),
             "orphaned_sessions_total": float(self.orphaned_sessions),
             "order_violations_total": float(self.order_violations),
+            "tier_rejections_total": float(
+                self.admission.tier_rejections),
             "replica_restarts_total": float(sum(
                 r.restarts for r in self._replicas.values())),
         }
@@ -929,6 +976,7 @@ class FleetFrontend:
                 "polled": s.polled,
                 "lost": s.lost,
                 "migrations": s.migrations,
+                "tier": s.tier,
                 "state": ("orphaned" if s.orphaned
                           else "closed" if s.closed else "open"),
             }

@@ -153,6 +153,7 @@ def fleet_samples(fleet) -> List[MetricSample]:
         "order_violations_total": st.get("order_violations"),
         "spillovers_total": st.get("spillovers"),
         "rejections_total": st.get("rejections"),
+        "tier_rejections_total": st.get("tier_rejections"),
         "replica_restarts_total": st.get("replica_restarts"),
     }, prefix="fleet")
     faults = st.get("faults") or {}
@@ -336,6 +337,19 @@ class MetricsExporter:
 # ---------------------------------------------------------------------------
 
 
+def _dir_bytes(path: str) -> int:
+    """Recursive on-disk size of one dump directory (best-effort: a
+    file racing deletion counts 0, never raises)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
 def _slug(reason: str, limit: int = 48) -> str:
     s = re.sub(r"[^a-z0-9]+", "-", reason.lower()).strip("-")
     return (s[:limit].rstrip("-")) or "trip"
@@ -367,11 +381,19 @@ class FlightRecorder:
         stats_fn: Optional[Callable[[], dict]] = None,
         ring: Optional[TimeSeriesRing] = None,
         jax_profile_s: float = 0.0,
+        max_total_bytes: Optional[int] = None,
     ):
         self.out_dir = out_dir
         self.label = label
         self.min_interval_s = min_interval_s
         self.max_dumps = max_dumps
+        # Disk bound, not just a count bound: one dump's size scales
+        # with the trace/stats/timeseries rings feeding it, so a count
+        # cap alone can still eat a disk on a long-lived server whose
+        # triggers keep firing. Past the cap the OLDEST dumps are
+        # evicted (their count slots free up with them) — the newest
+        # post-mortem always survives.
+        self.max_total_bytes = max_total_bytes
         self.trace_fn = trace_fn
         self.stats_fn = stats_fn
         self.ring = ring
@@ -379,7 +401,9 @@ class FlightRecorder:
         self.dumps: List[str] = []
         self.suppressed = 0
         self.dump_errors = 0
+        self.evicted_dumps = 0
         self.last_reason: Optional[str] = None
+        self._dump_bytes: dict = {}   # dump dir -> measured bytes
         self._last_ts: float = float("-inf")
         self._seq = 0
         self._lock = threading.Lock()
@@ -441,11 +465,35 @@ class FlightRecorder:
             return None
         with self._lock:
             self.dumps.append(dump_dir)
+            self._dump_bytes[dump_dir] = _dir_bytes(dump_dir)
+        self._enforce_byte_cap()
         if self.jax_profile_s > 0:
             self._profile_window(dump_dir)
         print(f"[flight] {reason!r} → {dump_dir} ({', '.join(wrote)})",
               file=sys.stderr, flush=True)
         return dump_dir
+
+    def _enforce_byte_cap(self) -> None:
+        """Evict oldest dumps while the directory's total measured size
+        exceeds ``max_total_bytes`` (the newest dump always survives —
+        a cap smaller than one dump degrades to keep-latest-only)."""
+        if self.max_total_bytes is None:
+            return
+        while True:
+            with self._lock:
+                total = sum(self._dump_bytes.get(d, 0) for d in self.dumps)
+                if total <= self.max_total_bytes or len(self.dumps) <= 1:
+                    return
+                victim = self.dumps.pop(0)
+                self._dump_bytes.pop(victim, None)
+                self.evicted_dumps += 1
+            import shutil
+
+            try:
+                shutil.rmtree(victim)
+            except OSError:
+                pass  # eviction is best-effort; the tracking entry is
+                #   gone either way, so the cap converges
 
     def _write_artifacts(self, dump_dir: str, reason: str) -> List[str]:
         wrote: List[str] = []
@@ -506,6 +554,17 @@ class FlightRecorder:
                     self.dump_errors += 1
             finally:
                 FlightRecorder._profiling.release()
+            # The device trace landed AFTER the dump was measured for
+            # the byte cap — remeasure and re-enforce, unless the dump
+            # was evicted while the capture window was open.
+            with self._lock:
+                tracked = dump_dir in self._dump_bytes
+            if tracked:
+                size = _dir_bytes(dump_dir)
+                with self._lock:
+                    if dump_dir in self._dump_bytes:
+                        self._dump_bytes[dump_dir] = size
+                self._enforce_byte_cap()
 
         threading.Thread(target=capture, name="dvf-flight-profile",
                          daemon=True).start()
@@ -516,6 +575,9 @@ class FlightRecorder:
                 "dumps": len(self.dumps),
                 "suppressed": self.suppressed,
                 "dump_errors": self.dump_errors,
+                "evicted_dumps": self.evicted_dumps,
+                "total_bytes": sum(self._dump_bytes.get(d, 0)
+                                   for d in self.dumps),
                 "last_reason": self.last_reason,
                 "dir": self.out_dir,
             }

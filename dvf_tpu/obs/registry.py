@@ -515,6 +515,11 @@ class TimeSeriesRing:
             "interval_s": self.interval_s,
             "capacity": self.capacity,
             "sample_errors": self.sample_errors,
+            # Contained on_sample failures: a raising hook (burn check,
+            # control plane) is counted here and sampling CONTINUES —
+            # pinned in tests/test_obs.py (a dead sampler would blind
+            # every controller and the flight recorder at once).
+            "hook_errors_total": self.hook_errors,
             "rows": rows,
         }
 

@@ -33,6 +33,49 @@ from dvf_tpu.ops.registry import measured_default_for, register_filter
 from dvf_tpu.utils.compat import shard_map
 
 
+@register_filter("upscale")
+def upscale(scale: int = 2, method: str = "nearest") -> Filter:
+    """Stateless geometry-restoring upscale — the quality controller's
+    return path (dvf_tpu.control): a session downshifted to 1/``scale``
+    resolution under load appends this stage to its op chain, so the
+    device program's OUTPUT is full client-visible resolution and the
+    delivery path never knows the session was downshifted. Like
+    ``super_resolution`` this changes output geometry ((H, W) →
+    (H·scale, W·scale)); unlike it, it is stateless (no params), so the
+    multi-tenant frontend can serve it, and cheap (one VPU
+    repeat/resize, not a conv net — degradation must cost less than it
+    saves).
+
+    ``method``: ``nearest`` (exact pixel replication, dtype-preserving —
+    works on the uint8 passthrough path) or ``linear``
+    (``jax.image.resize`` bilinear, float path only).
+    """
+    s = int(scale)
+    if s < 1:
+        raise ValueError(f"scale must be >= 1, got {scale}")
+    if method not in ("nearest", "linear"):
+        raise ValueError(f"method must be 'nearest' or 'linear', "
+                         f"got {method!r}")
+
+    def fn(batch: jnp.ndarray) -> jnp.ndarray:
+        if s == 1:
+            return batch
+        if method == "nearest":
+            return jnp.repeat(jnp.repeat(batch, s, axis=1), s, axis=2)
+        b, h, w, c = batch.shape
+        return jax.image.resize(batch, (b, h * s, w * s, c),
+                                method="linear")
+
+    from dvf_tpu.api.filter import stateless
+
+    # halo=None (unknown), not 0: the output pixel grid is a different
+    # geometry, so the pointwise H-sharding contract does not apply —
+    # a space-sharded mesh conservatively replicates H through this
+    # stage instead of trusting GSPMD across the geometry change.
+    return stateless(f"upscale(scale={s})", fn,
+                     uint8_ok=(method == "nearest"), halo=None)
+
+
 @register_filter("super_resolution")
 def super_resolution(
     params: Optional[Any] = None,

@@ -92,8 +92,10 @@ class ContinuousBatcher:
         self._staging_seq = 0
 
     def select(self, sessions: Sequence[StreamSession],
-               now: float, pre_drained: bool = False) -> Optional[List[Slot]]:
-        """EDF slot selection for one batch; None = nothing to do.
+               now: float, pre_drained: bool = False,
+               limit: Optional[int] = None) -> Optional[List[Slot]]:
+        """Tier-then-EDF slot selection for one batch; None = nothing
+        to do.
 
         Drains every session's ingress, sheds blown deadlines, picks the
         ``batch_size`` earliest-deadline slots, and claims them in-flight
@@ -101,7 +103,9 @@ class ContinuousBatcher:
         streamed assembler can stage the chosen frames straight into its
         per-shard slabs. Dispatch-thread only: touches the sessions'
         scheduler-owned ``pending`` staging. ``pre_drained`` skips the
-        drain/shed pass (select_bucket already ran it this tick).
+        drain/shed pass (select_bucket already ran it this tick);
+        ``limit`` overrides ``batch_size`` for this pick (the control
+        plane's per-bucket batch sizing).
         """
         candidates: List[Slot] = []
         for s in sessions:
@@ -111,13 +115,20 @@ class ContinuousBatcher:
             candidates.extend(s.pending)
         if not candidates:
             return None
-        # EDF: earliest SLO deadline first. Stable sort + per-session
-        # monotonic deadlines (a hard guarantee — submit clamps each
-        # deadline to at least the previous one, whatever client ts
-        # says) ⇒ the chosen set is a prefix of each session's pending
-        # deque, so popleft below removes exactly the chosen slots.
-        candidates.sort(key=lambda slot: slot.deadline)
-        chosen = candidates[: self.batch_size]
+        # Priority tier first, then EDF within a tier: with spare slots
+        # every queued frame makes the batch regardless of tier, so this
+        # only bites when OVERSUBSCRIBED — then lower-priority (higher
+        # tier value) frames lose the slot race, age, and shed first;
+        # paid/interactive sessions shed last by construction. Stable
+        # sort + per-session monotonic deadlines (a hard guarantee —
+        # submit clamps each deadline to at least the previous one,
+        # whatever client ts says) + per-session constant tier ⇒ the
+        # chosen set is a prefix of each session's pending deque, so
+        # popleft below removes exactly the chosen slots.
+        candidates.sort(
+            key=lambda slot: (slot.session.config.tier, slot.deadline))
+        chosen = candidates[: (limit if limit is not None
+                               else self.batch_size)]
         taken_per_session: dict = {}
         for slot in chosen:
             taken_per_session[slot.session] = (
@@ -141,31 +152,47 @@ class ContinuousBatcher:
         cost — Engine.step_block_ms seed + live EWMA). Every bucket's
         ingress is drained and its blown deadlines shed each tick (a
         losing bucket must still age and shed); then buckets with
-        pending work are scored ``(earliest deadline − now) ÷ tick
-        cost`` and the lowest score wins — least headroom per unit of
-        program time is the bucket most at risk. The winner's slots are
-        then claimed by the ordinary within-bucket EDF :meth:`select`.
+        pending work are picked by ``(best pending tier, (earliest
+        deadline − now) ÷ tick cost)``: priority tier first — a bucket
+        holding a tier-0 frame beats any bucket whose best is tier 1+,
+        else the within-bucket tier-EDF guarantee silently dissolves
+        the moment sessions span buckets (exactly what the quality
+        controller's downshift buckets create: under a re-admission
+        flood, cost-weighted EDF alone serves interactive only once its
+        frames have burned down to the flood's headroom-per-cost) —
+        then lowest score wins within a tier: least headroom per unit
+        of program time is the bucket most at risk. The winner's slots
+        are then claimed by the ordinary within-bucket EDF
+        :meth:`select`.
         """
         best = None
-        best_score = None
+        best_key = None
         best_sessions: Optional[Sequence[StreamSession]] = None
         for bucket, sessions in bucket_sessions:
             earliest = None
+            tier = None
             for s in sessions:
                 s.drain_ingress()
                 s.shed_expired(now)
                 if s.pending:
                     d = s.pending[0].deadline
                     earliest = d if earliest is None else min(earliest, d)
+                    t = s.config.tier
+                    tier = t if tier is None else min(tier, t)
             if earliest is None:
                 continue
             cost_ms = max(float(bucket.tick_cost_estimate()), 1e-3)
-            score = (earliest - now) * 1e3 / cost_ms
-            if best_score is None or score < best_score:
-                best, best_score, best_sessions = bucket, score, sessions
+            key = (tier, (earliest - now) * 1e3 / cost_ms)
+            if best_key is None or key < best_key:
+                best, best_key, best_sessions = bucket, key, sessions
         if best is None:
             return None, None
-        return best, self.select(best_sessions, now, pre_drained=True)
+        # Per-bucket batch size (control plane autotune): a small bucket
+        # runs small batches instead of inheriting the frontend-wide
+        # batch_size and padding the difference with repeated rows.
+        limit = getattr(best, "batch_size", None)
+        return best, self.select(best_sessions, now, pre_drained=True,
+                                 limit=limit)
 
     def _pool_staging(self, frame: np.ndarray) -> np.ndarray:
         shape = (self.batch_size, *frame.shape)

@@ -57,7 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dvf_tpu.api.filter import Filter
+from dvf_tpu.api.filter import Filter, FilterChain
 from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
 from dvf_tpu.obs.registry import (
@@ -81,6 +81,8 @@ from dvf_tpu.runtime.ingest import INGEST_MODES, ShardedBatchAssembler
 from dvf_tpu.runtime.signature import (
     SignatureKey,
     build_filter,
+    canonical_dtype,
+    canonical_geometry,
     canonical_op_chain,
     canonical_op_chain_or_verbatim,
     make_key,
@@ -90,6 +92,7 @@ from dvf_tpu.serve.batcher import BatchPlan, ContinuousBatcher
 from dvf_tpu.serve.router import ResultRouter
 from dvf_tpu.serve.session import (
     CLOSED,
+    OPEN,
     AdmissionError,
     ServeError,
     SessionConfig,
@@ -171,12 +174,23 @@ class ServeConfig:
     #   the watchdog trips, a fault budget overflows (frontend failure),
     #   or the SLO burn rate crosses slo_burn_threshold. None = off.
     flight_min_interval_s: float = 10.0  # dump rate limit
+    flight_max_total_bytes: Optional[int] = 256 * 1024 * 1024  # on-disk
+    #   bound across all dumps: past it the oldest are evicted (the
+    #   newest always survives). None = count cap (max_dumps) only.
     slo_burn_threshold: float = 0.5  # fraction of a sampling window's
     #   deliveries missing their SLO that trips a flight dump (needs
     #   flight_dir + the telemetry ring); 0 disables the burn trigger
     flight_profile_s: float = 0.0  # >0: each dump also opens a
     #   jax.profiler capture window of this length (device lanes in the
     #   post-mortem); off by default — profiling is not free
+    control: bool = False         # arm the load-adaptive control plane
+    #   (dvf_tpu.control): closed-loop controllers over the telemetry
+    #   ring actuating per-bucket batch size + tick budget, per-session
+    #   resolution downshift (sr upscale return path), and the
+    #   priority-tier admission floor (--control on the CLI)
+    control_config: Any = None    # control.ControlConfig; None = defaults
+    default_tier: int = 1         # tier for open_stream(tier=None):
+    #   0 interactive (sheds last), 1 standard, 2 batch (sheds first)
 
 
 class _Bucket:
@@ -220,6 +234,19 @@ class _Bucket:
         self.routed_frames = 0             # lifetime rows demuxed for
         #   this bucket (ResultRouter.route) — monotone across session
         #   retirement, unlike a per-live-session sum
+        self.batch_size = config.batch_size  # per-bucket device batch
+        #   rows — the control plane's batch controller resizes this
+        #   from measured occupancy (initiated by the dispatch thread
+        #   only while nothing is in flight: a resize recompiles the
+        #   program, and in-flight batches must not straddle shapes)
+        self.resizing = False  # guarded by the frontend lock: a resize
+        #   recompile is running on its own thread — dispatch skips the
+        #   bucket (keeping it quiescent) so the OTHER buckets' ticks
+        #   never stall behind this bucket's compile
+        self.mean_valid_rows: Optional[float] = None  # EWMA of VALID
+        #   rows per served batch — the occupancy signal batch sizing
+        #   divides by (rows beyond it are padding the device computes
+        #   and drops)
         self.ingest_mode = config.ingest
         self.degrade_reason: Optional[str] = None
         self.egress_mode = config.egress
@@ -243,18 +270,27 @@ class _Bucket:
         cal = getattr(self.engine, "step_block_ms", None)
         return cal if cal else 1.0
 
-    def observe_tick(self, wall_ms: float, sample: bool = True) -> None:
+    def observe_tick(self, wall_ms: float, sample: bool = True,
+                     valid: Optional[int] = None) -> None:
         """Collect-side cost sample (submit → materialized, wall).
-        ``sample=False`` counts the batch without feeding the EWMA —
+        ``sample=False`` counts the batch without feeding the cost EWMA —
         the wall time of a batch that queued behind other in-flight
-        work measures the pipeline, not this bucket's program."""
+        work measures the pipeline, not this bucket's program.
+        ``valid`` (real rows in the batch) always feeds the occupancy
+        EWMA: queueing doesn't contaminate a row count."""
         self.batches += 1
+        a = self._EWMA_ALPHA
+        if valid is not None:
+            if self.mean_valid_rows is None:
+                self.mean_valid_rows = float(valid)
+            else:
+                self.mean_valid_rows = ((1 - a) * self.mean_valid_rows
+                                        + a * float(valid))
         if wall_ms <= 0 or not sample:
             return
         if self._tick_cost_ms is None:
             self._tick_cost_ms = wall_ms
         else:
-            a = self._EWMA_ALPHA
             self._tick_cost_ms = (1 - a) * self._tick_cost_ms + a * wall_ms
 
     def record_fault(self, kind: str) -> None:
@@ -300,6 +336,8 @@ class _Bucket:
         row = {
             "signature": self.label(),
             "op_chain": self.op_chain,
+            "batch_size": self.batch_size,
+            "mean_valid_rows": self.mean_valid_rows,
             "open_sessions": len(live),
             "queue_depth": sum(len(s.ingress) + len(s.pending)
                                for s in live),
@@ -400,8 +438,46 @@ class ServeFrontend:
         attach_signal_provider(
             self.registry, "serve", self.signals,
             labels={"replica": label} if label else None)
+        # -- load-adaptive control plane (dvf_tpu.control) ----------------
+        # Built BEFORE the ring so the ring cadence can come from the
+        # control config; the plane's decisions ride the ring's
+        # on_sample seam (chained with the SLO burn check below).
+        self.control_plane = None
+        self._admission_tier_floor: Optional[int] = None  # controller-
+        #   set admission floor: open_stream refuses tier > floor
+        self._tick_s = self.config.tick_s  # live dispatch tick (the
+        #   control plane's tick-budget actuator writes it)
+        self._pending_resizes: Dict[_Bucket, int] = {}  # applied by the
+        #   dispatch thread when the bucket has nothing in flight
+        self._pending_rebinds: "queue.Queue" = queue.Queue()  # (sid,
+        #   key, level) quality moves — applied by the dispatch thread,
+        #   which owns the session pending deques being flushed
+        self.quality_rebinds = 0
+        self.quality_rebinds_dropped = 0
+        self._warmed_quality: set = set()   # quality keys pre-compiled
+        #   at admission time (control armed): the moment the quality
+        #   controller needs the downshift program is mid-overload —
+        #   the worst time to pay a compile on a busy host
+        self.quality_flushed_frames = 0   # frames dropped by rebind
+        #   flushes — kept OUT of shed_total (the pressure predicate
+        #   reads shed deltas; the controller's own moves must not feed
+        #   back as overload evidence)
+        self.resize_compile_errors = 0
+        control_sample_s = 0.0
+        if self.config.control:
+            from dvf_tpu.control import ControlConfig, ControlPlane
+
+            ccfg = self.config.control_config or ControlConfig()
+            if ccfg.batch_max <= 0:
+                # The compiled staging/slab pools size from the
+                # frontend batch_size; the controller may shrink below
+                # it, never grow past it.
+                ccfg = dataclasses.replace(ccfg,
+                                           batch_max=self.config.batch_size)
+            self.control_plane = ControlPlane(self, ccfg)
+            control_sample_s = ccfg.interval_s
         self.telemetry: Optional[TimeSeriesRing] = None
-        sample_s = self.config.telemetry_sample_s or (
+        sample_s = self.config.telemetry_sample_s or control_sample_s or (
             1.0 if self.config.flight_dir else 0.0)  # burn trigger +
         #   post-mortem window need the ring; plain serving doesn't pay
         if sample_s > 0:
@@ -409,13 +485,14 @@ class ServeFrontend:
                 self.signals,
                 interval_s=sample_s,
                 name="dvf-serve-telemetry",
-                on_sample=self._check_slo_burn)
+                on_sample=self._on_telemetry_sample)
         self.flight: Optional[FlightRecorder] = None
         if self.config.flight_dir:
             self.flight = FlightRecorder(
                 self.config.flight_dir,
                 label=f"serve-{label}" if label else "serve",
                 min_interval_s=self.config.flight_min_interval_s,
+                max_total_bytes=self.config.flight_max_total_bytes,
                 trace_fn=lambda: [self.tracer.snapshot()],
                 stats_fn=self.stats,
                 ring=self.telemetry,
@@ -491,6 +568,8 @@ class ServeFrontend:
                 name="dvf-serve-supervisor", window=self._window,
                 on_trip=self._flight_trip)
             self._supervisor.start()
+        if self.control_plane is not None:
+            self.control_plane.start()
         if self.telemetry is not None:
             self.telemetry.start()
         return self
@@ -501,6 +580,8 @@ class ServeFrontend:
         self._stop.set()
         if self._supervisor is not None:
             self._supervisor.stop()
+        if self.control_plane is not None:
+            self.control_plane.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
             self.telemetry.sample_once()  # terminal row: a short run still
@@ -667,6 +748,23 @@ class ServeFrontend:
         }
         if self._supervisor is not None:
             out["stalls_total"] = float(self._supervisor.stalls)
+        if self.control_plane is not None:
+            # Control-plane decision counters (the acceptance bar:
+            # controller actions are observable on the scrape endpoint)
+            # plus the live actuation state.
+            for k, v in self.control_plane.signals().items():
+                out[f"control_{k}"] = v
+            out["control_quality_rebinds_total"] = float(
+                self.quality_rebinds)
+            out["control_quality_rebinds_dropped_total"] = float(
+                self.quality_rebinds_dropped)
+            out["control_quality_flushed_frames_total"] = float(
+                self.quality_flushed_frames)
+            out["control_resize_compile_errors_total"] = float(
+                self.resize_compile_errors)
+            out["downshifted_sessions"] = float(sum(
+                1 for s in live if s.quality_level > 0))
+            out["dispatch_tick_s"] = float(self._tick_s)
         ing = self._buckets[0].ingest_stats
         egr = self._buckets[0].egress_stats
         if ing is not None:
@@ -724,6 +822,25 @@ class ServeFrontend:
                                             labels, GAUGE))
         return out
 
+    def _on_telemetry_sample(self, prev: Optional[dict], cur: dict) -> None:
+        """The ring's on_sample hook: SLO burn check, then the control
+        plane's decision step. Each leg is independently contained (the
+        ring counts a raising hook in hook_errors_total and keeps
+        sampling, but a burn-check hiccup must not also cost the
+        controller its tick)."""
+        try:
+            self._check_slo_burn(prev, cur)
+        except Exception:  # noqa: BLE001 — the controller still runs
+            if self.control_plane is None:
+                raise  # sole hook: let the ring count it
+            if self.telemetry is not None:
+                # Swallowed so the controller keeps its tick, but a
+                # broken burn trigger must stay visible on the same
+                # containment counter a raising hook lands on.
+                self.telemetry.hook_errors += 1
+        if self.control_plane is not None:
+            self.control_plane.on_sample(prev, cur)
+
     def _check_slo_burn(self, prev: Optional[dict], cur: dict) -> None:
         """Telemetry-ring hook: burn rate over one sampling window =
         fraction of the window's deliveries that missed their SLO; past
@@ -762,12 +879,20 @@ class ServeFrontend:
         frame_shape: Optional[tuple] = None,
         frame_dtype: Any = None,
         op_chain: Optional[str] = None,
+        tier: Optional[int] = None,
     ) -> str:
         """Admit one new stream; returns its session id.
 
         Raises ``AdmissionError`` at the ``max_sessions`` cap — overload
         is refused at the door, not absorbed as unbounded queueing — and
         when the frontend is draining (fleet replica teardown).
+
+        ``tier`` is the stream's priority tier (0 interactive, 1
+        standard, 2 batch; default ``config.default_tier``): under
+        sustained overload the control plane's admission floor refuses
+        the highest tiers first, the batcher's slot pick prefers lower
+        tiers, and the quality controller downshifts higher tiers first
+        — paid/interactive streams shed LAST end to end.
 
         ``op_chain``/``frame_shape``/``frame_dtype`` declare the
         stream's signature at admission time and ROUTE it: a declaration
@@ -783,19 +908,21 @@ class ServeFrontend:
         joins the default bucket, whose geometry pins at first submit
         (the legacy single-signature behavior, unchanged).
         """
+        t = self.config.default_tier if tier is None else int(tier)
+        if t < 0:
+            raise ValueError(f"tier must be >= 0, got {tier!r}")
         cfg = SessionConfig(
             queue_size=self.config.queue_size,
             slo_ms=slo_ms if slo_ms is not None else self.config.slo_ms,
             frame_delay=self.config.frame_delay,
             reorder_capacity=self.config.reorder_capacity,
             out_queue_size=self.config.out_queue_size,
+            tier=t,
         )
         declared = None
         if frame_shape is not None:
             # canonical_dtype, NOT np.dtype: the ML spelling "u8" means
             # uint8, while numpy alone reads it as an 8-BYTE uint64.
-            from dvf_tpu.runtime.signature import canonical_dtype
-
             declared = (tuple(int(d) for d in frame_shape),
                         canonical_dtype(frame_dtype))
         elif frame_dtype is not None:
@@ -809,11 +936,15 @@ class ServeFrontend:
                     self.admission_rejections += 1
                 raise AdmissionError(f"malformed op_chain: {e}") from e
         with self._lock:
-            self._check_admission_locked()
+            self._check_admission_locked(tier=t)
             bucket, create_key = self._route_locked(chain, declared)
             if bucket is not None:
-                return self._register_session_locked(
+                sid_out = self._register_session_locked(
                     bucket, session_id, cfg, sink)
+        if bucket is not None:
+            self._warm_quality_async(bucket)
+            return sid_out
+        with self._lock:
             # Best-effort headroom check BEFORE the compile: a frontend
             # at the bucket cap with no idle victim must refuse now, not
             # after seconds of JIT whose orphan program would then sit
@@ -830,12 +961,12 @@ class ServeFrontend:
         owned = False
         try:
             with self._lock:
-                self._check_admission_locked()
+                self._check_admission_locked(tier=t)
                 bucket = self._bucket_by_key.get(create_key)
                 if bucket is None:
                     bucket = self._create_bucket_locked(create_key, engine)
                     owned = True
-                return self._register_session_locked(
+                sid_out = self._register_session_locked(
                     bucket, session_id, cfg, sink)
         finally:
             if not owned:
@@ -844,14 +975,28 @@ class ServeFrontend:
                 # admission failed after the lease: the program stays
                 # WARM in the pool either way.
                 self.pool.release(create_key)
+        self._warm_quality_async(bucket)
+        return sid_out
 
     # -- admission internals (bucket routing) ---------------------------
 
-    def _check_admission_locked(self) -> None:
+    def _check_admission_locked(self, tier: Optional[int] = None) -> None:
         if self._draining:
             self.admission_rejections += 1
             raise AdmissionError(
                 "frontend is draining (no new sessions admitted)")
+        floor = self._admission_tier_floor
+        if tier is not None and floor is not None and tier > floor:
+            # Controller-set load shed at the door: the cheapest place
+            # to refuse work is before any of it is queued. Graceful by
+            # contract — a refused low-tier open is degradation, not a
+            # failure (the fleet tier spills it to a replica with
+            # headroom when one exists).
+            self.admission_rejections += 1
+            raise AdmissionError(
+                f"tier {tier} not admitted under overload (admission "
+                f"floor {floor}: the load controller is shedding "
+                f"low-priority sessions first)")
         if len(self._sessions) >= self.config.max_sessions:
             self.admission_rejections += 1
             raise AdmissionError(
@@ -1027,6 +1172,338 @@ class ServeFrontend:
             warmed.append(key.render())
         return warmed
 
+    # -- control-plane actuator surface (dvf_tpu.control) ----------------
+    # The ControlPlane's apply thread calls these; the decisions behind
+    # them are deterministic over the telemetry window (controllers.py).
+    # Anything that must be serialized with staging (quality rebinds,
+    # batch resizes) is handed to the dispatch thread instead of done
+    # here — the apply thread only ever pays for COMPILES, never for a
+    # lock the serving path is hot on.
+
+    def control_view(self) -> dict:
+        """The per-bucket/per-session half of a control row — what the
+        plane composes with each flat telemetry sample before the
+        controllers' decision step. Cheap: counter reads, no percentile
+        work."""
+        with self._lock:
+            buckets = [(b, len(b.sessions),
+                        sum(len(s.ingress) + len(s.pending)
+                            for s in b.sessions.values()),
+                        min((s.config.tier
+                             for s in b.sessions.values()), default=None))
+                       for b in self._buckets]
+            sessions = list(self._sessions.items())
+        b_rows = []
+        for b, n_live, qd, min_tier in buckets:
+            b_rows.append({
+                "label": b.label(),
+                "batch_size": b.batch_size,
+                "queue_depth": qd,
+                "open_sessions": n_live,
+                "inflight_batches": b.inflight_batches,
+                "mean_valid_rows": b.mean_valid_rows,
+                "tick_cost_ms": b.tick_cost_estimate(),
+                # Highest-priority tenant tier (the resize stall-guard:
+                # a bucket hosting tier 0 never shrink-resizes).
+                "min_tier": min_tier,
+            })
+        s_rows = []
+        for sid, s in sessions:
+            s_rows.append({
+                "sid": sid,
+                "tier": s.config.tier,
+                "level": s.quality_level,
+                "downshiftable": self._downshiftable(s),
+            })
+        return {"buckets": b_rows, "sessions": s_rows}
+
+    def _downshiftable(self, s: StreamSession) -> bool:
+        """Whether one more ×2 downshift step is geometrically possible
+        for this session (signature pinned, H and W divisible)."""
+        sig = s.base_sig
+        if sig is None:
+            bucket = s.bucket if s.bucket is not None else self._buckets[0]
+            sig = bucket.pinned_signature()
+        if sig is None:
+            return False
+        shape = sig[0]
+        f = 1 << (s.quality_level + 1)
+        return len(shape) >= 2 and shape[0] % f == 0 and shape[1] % f == 0
+
+    def request_batch_size(self, bucket_label: str, n: int) -> bool:
+        """Queue a per-bucket batch resize; the dispatch thread applies
+        it once that bucket has nothing in flight (a resize recompiles
+        the program — through the pool and the persistent cache, so a
+        previously-seen size costs a deserialize). False = no such
+        bucket (it retired between decide and apply)."""
+        n = max(1, int(n))
+        with self._lock:
+            for b in self._buckets:
+                if b.label() == bucket_label:
+                    if n == b.batch_size:
+                        self._pending_resizes.pop(b, None)
+                    else:
+                        self._pending_resizes[b] = n
+                    return True
+        return False
+
+    def set_tick_interval(self, tick_s: float) -> None:
+        """The tick budget: how long dispatch idles between scheduling
+        passes. Tight under load (queueing delay is paid per tick),
+        relaxed when idle (a hot spin over empty queues is wasted
+        host CPU)."""
+        self._tick_s = max(1e-4, float(tick_s))
+
+    def set_admission_tier_floor(self, floor: Optional[int]) -> None:
+        """Controller-set admission floor: ``open_stream`` refuses
+        sessions with tier > floor (None admits every tier)."""
+        with self._lock:
+            self._admission_tier_floor = floor
+
+    def flight_trip(self, reason: str) -> None:
+        """Control-plane observability tap (controller saturation):
+        same off-thread flight dump as the watchdog/budget paths."""
+        self._flight_trip(reason)
+
+    def request_session_quality(self, session_id: str, level: int) -> bool:
+        """Move one session to quality ``level`` (0 = full). Builds or
+        leases the downshift bucket's program HERE (apply thread — a
+        compile must not stall sampling or dispatch), then hands the
+        actual rebind to the dispatch thread, which owns the queues
+        being flushed. False = impossible right now (session gone,
+        geometry not divisible, bucket cap with no idle victim) — the
+        controller counts it and re-decides on a later window."""
+        level = int(level)
+        if level < 0:
+            return False
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is None or s.state != OPEN:
+                return False
+            if level == s.quality_level:
+                return True
+            if s.base_sig is None:
+                # First shift: capture the full-quality signature so
+                # recovery can route home even if the base bucket
+                # retires (its program stays warm in the pool).
+                bucket = s.bucket if s.bucket is not None \
+                    else self._buckets[0]
+                pinned = bucket.pinned_signature()
+                if pinned is None:
+                    return False  # nothing has flowed yet — no geometry
+                s.base_sig = pinned
+                s.base_chain = bucket.op_chain
+            shape, dtype = s.base_sig
+            base_chain = s.base_chain
+        key = self._quality_key(base_chain, shape, dtype, level)
+        if key is None:
+            return False
+        try:
+            self._ensure_quality_bucket(key, base_chain, level)
+        except AdmissionError:
+            return False
+        self._pending_rebinds.put((session_id, key, level))
+        return True
+
+    def _quality_key(self, base_chain: str, shape: tuple, dtype,
+                     level: int) -> Optional[SignatureKey]:
+        """The canonical signature serving ``base_chain`` at quality
+        ``level``: decimated geometry + the matching upscale stage (so
+        the program's OUTPUT stays full resolution). None when the
+        geometry doesn't divide."""
+        if level == 0:
+            chain = base_chain
+            geom = tuple(shape)
+        else:
+            f = 1 << level
+            if len(shape) < 2 or shape[0] % f or shape[1] % f:
+                return None
+            chain = canonical_op_chain_or_verbatim(
+                f"{base_chain}|upscale(scale={f})")
+            geom = (shape[0] // f, shape[1] // f, *shape[2:])
+        return SignatureKey(chain, canonical_geometry(geom),
+                            canonical_dtype(dtype).name)
+
+    def _warm_quality_async(self, bucket) -> None:
+        """Pre-compile the ×2 downshift program for ``bucket``'s
+        signature on a background thread (control armed only). The
+        moment the quality controller needs that program is
+        mid-overload — the worst possible time to pay a cold compile on
+        a busy host — so it is warmed through the pool at ADMISSION
+        time instead; the eventual downshift costs a pool hit. No-op
+        for an unpinned bucket (an undeclared open warms once a later
+        declared open or the running controller touches the bucket) and
+        for an already-warm or live key."""
+        if self.control_plane is None:
+            return
+        sig = bucket.pinned_signature()
+        base_chain = bucket.op_chain
+        if sig is None or base_chain is None:
+            return
+        shape, dtype = sig
+        key = self._quality_key(base_chain, shape, dtype, 1)
+        if key is None:
+            return
+        with self._lock:
+            if key in self._warmed_quality \
+                    or self._bucket_by_key.get(key) is not None:
+                return
+            self._warmed_quality.add(key)
+            self._register_quality_chain_locked(key, base_chain, 2)
+
+        def warm():
+            try:
+                self._acquire_program(key)
+                self.pool.release(key)
+            except Exception:  # noqa: BLE001 — a failed warm only means
+                with self._lock:   # the first downshift pays the
+                    self._warmed_quality.discard(key)   # compile after all
+
+        threading.Thread(target=warm, name="dvf-quality-warm",
+                         daemon=True).start()
+
+    def _register_quality_chain_locked(self, key: SignatureKey,
+                                       base_chain: str, scale: int) -> None:
+        """Register the downshift chain's Filter under ``key.op_chain``
+        (caller holds ``_lock``): the live base Filter composed with the
+        matching ``upscale`` stage — needed when the base chain is an
+        ad-hoc filter name ``build_filter`` can't re-parse. No-op when
+        already registered or the base filter is unknown (a registry
+        spec builds through ``_acquire_program`` instead)."""
+        if key.op_chain in self._filters_by_chain:
+            return
+        base_filt = self._filters_by_chain.get(base_chain)
+        if base_filt is not None:
+            from dvf_tpu.ops import get_filter
+
+            self._filters_by_chain[key.op_chain] = FilterChain(
+                base_filt, get_filter("upscale", scale=scale),
+                name=key.op_chain)
+
+    def _ensure_quality_bucket(self, key: SignatureKey, base_chain: str,
+                               level: int) -> None:
+        """Make a live bucket exist for ``key`` (join or create —
+        open_stream's admission discipline, compile outside the lock).
+        For a base chain that is NOT a registry spec (an ad-hoc filter
+        name), the downshift filter is composed from the LIVE base
+        Filter object instead of build_filter."""
+        with self._lock:
+            if self._bucket_by_key.get(key) is not None:
+                return
+            if level > 0:
+                self._register_quality_chain_locked(key, base_chain,
+                                                    1 << level)
+            self._check_bucket_headroom_locked(key)
+        engine = self._acquire_program(key)
+        owned = False
+        try:
+            with self._lock:
+                bucket = self._bucket_by_key.get(key)
+                if bucket is None:
+                    self._create_bucket_locked(key, engine)
+                    owned = True
+        finally:
+            if not owned:
+                self.pool.release(key)  # raced into existence: program
+                #   stays warm, the live bucket keeps its own lease
+
+    def _apply_rebinds_dispatch(self) -> None:
+        """Dispatch-thread half of a quality move: flush the session's
+        queued frames (OLD geometry — they cannot enter the new
+        program), swap its bucket binding, set the level. Atomic with
+        submit's decimate+enqueue under ``_lock``. A target bucket that
+        retired between request and apply drops the move (counted); the
+        controller re-decides from a later window."""
+        while True:
+            try:
+                sid, key, level = self._pending_rebinds.get_nowait()
+            except queue.Empty:
+                return
+            with self._lock:
+                s = self._sessions.get(sid)
+                if s is None or s.state == CLOSED:
+                    self.quality_rebinds_dropped += 1
+                    continue
+                target = self._bucket_by_key.get(key)
+                if target is None:
+                    self.quality_rebinds_dropped += 1
+                    continue
+                old = s.bucket if s.bucket is not None else self._buckets[0]
+                if target is not old:
+                    self.quality_flushed_frames += s.flush_queued(
+                        count_shed=False)
+                    old.sessions.pop(sid, None)
+                    target.sessions[sid] = s
+                    s.bucket = target
+                s.quality_level = level
+                s.quality_shifts += 1
+                self.quality_rebinds += 1
+
+    def _apply_resizes_dispatch(self) -> None:
+        """Dispatch-thread half of a batch resize: initiated only while
+        the bucket has nothing in flight (batches must not straddle
+        program shapes); otherwise retried next tick. The recompile
+        itself runs on a short-lived background thread with the bucket
+        marked ``resizing`` — dispatch skips a resizing bucket, so the
+        OTHER buckets' ticks never stall behind this one's compile (on
+        the dispatch thread, a 300 ms compile would hole EVERY bucket's
+        p99, which is exactly the latency the controller is trying to
+        protect). The compile serializes with supervised recovery via
+        ``_recover_lock``."""
+        with self._lock:
+            pending = list(self._pending_resizes.items())
+        for bucket, n in pending:
+            with self._lock:
+                # Liveness checked HERE, under the same lock that
+                # retires buckets: a pre-loop snapshot could let a
+                # just-retired bucket through, and its pooled engine —
+                # possibly re-leased to a new bucket by now — would be
+                # recompiled under a live tenant's feet.
+                if bucket not in self._buckets:
+                    self._pending_resizes.pop(bucket, None)
+                    continue
+                if bucket.resizing or bucket.inflight_batches != 0:
+                    continue  # retry next tick
+                if self._pending_resizes.get(bucket) != n:
+                    continue  # superseded since the snapshot above
+                self._pending_resizes.pop(bucket, None)
+                if bucket.frame_shape is None:
+                    # Nothing has flowed yet: no program at the old size
+                    # to swap, the first batch compiles at the new one.
+                    bucket.batch_size = n
+                    bucket.assembler = None
+                    continue
+                bucket.resizing = True
+                shape = (n, *bucket.frame_shape)
+                dtype = np.dtype(bucket.frame_dtype)
+            threading.Thread(
+                target=self._resize_compile, args=(bucket, n, shape, dtype),
+                name="dvf-serve-resize", daemon=True).start()
+
+    def _resize_compile(self, bucket: "_Bucket", n: int,
+                        shape: tuple, dtype) -> None:
+        """Off-dispatch half of a batch resize (see
+        ``_apply_resizes_dispatch``): compile the bucket's program at
+        the new batch shape while dispatch keeps the bucket quiescent,
+        then swap the size in. Through the pool's persistent
+        compilation cache a previously-seen size costs a deserialize.
+        Failure is contained — the old size keeps serving."""
+        try:
+            with self._recover_lock:
+                bucket.engine.ensure_compiled(shape, dtype)
+            self._adopt_bucket_key(bucket)  # takes self._lock itself
+            with self._lock:
+                bucket.batch_size = n
+                bucket.assembler = None  # staging re-derives from the
+                #   new program's sharding in _builder_for (which finds
+                #   the compile already done)
+        except Exception:  # noqa: BLE001 — counted, never raised into
+            with self._lock:               # the serving path
+                self.resize_compile_errors += 1
+        finally:
+            with self._lock:
+                bucket.resizing = False
+
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
         """Enqueue one frame on a stream; returns its per-stream index."""
@@ -1037,22 +1514,62 @@ class ServeFrontend:
             raise ServeError(
                 f"frontend failed: {self._error!r}") from self._error
         s = self._session(session_id)
-        bucket = s.bucket if s.bucket is not None else self._buckets[0]
-        if bucket.frame_shape is None:
-            with self._lock:
-                if bucket.frame_shape is None:
-                    bucket.frame_shape = tuple(frame.shape)
-                    bucket.frame_dtype = np.dtype(frame.dtype)
-        if tuple(frame.shape) != tuple(bucket.frame_shape) \
-                or np.dtype(frame.dtype) != np.dtype(bucket.frame_dtype):
-            raise ValueError(
-                f"frame {frame.shape}/{frame.dtype} does not match this "
-                f"stream's pinned signature {tuple(bucket.frame_shape)}/"
-                f"{np.dtype(bucket.frame_dtype)} (one compiled program "
-                f"serves every session in a bucket — geometry is "
-                f"per-bucket, not per-stream; open a stream with "
-                f"frame_shape=/op_chain= to route to another bucket)")
-        return s.submit(frame, ts=ts, tag=tag)
+        if self.control_plane is None:
+            # No control plane → no quality rebinds: a session's bucket
+            # binding and level are fixed after open, so the hot path
+            # stays lock-free (the lock below exists only to serialize
+            # with rebind flushes). Geometry pin is the one first-frame
+            # race, double-checked under the lock.
+            bucket = s.bucket if s.bucket is not None else self._buckets[0]
+            if bucket.frame_shape is None:
+                with self._lock:
+                    if bucket.frame_shape is None:
+                        bucket.frame_shape = tuple(frame.shape)
+                        bucket.frame_dtype = np.dtype(frame.dtype)
+            if tuple(frame.shape) != tuple(bucket.frame_shape) \
+                    or np.dtype(frame.dtype) != np.dtype(
+                        bucket.frame_dtype):
+                raise ValueError(
+                    f"frame {frame.shape}/{frame.dtype} does not match "
+                    f"this stream's pinned signature "
+                    f"{tuple(bucket.frame_shape)}/"
+                    f"{np.dtype(bucket.frame_dtype)} (one compiled "
+                    f"program serves every session in a bucket — "
+                    f"geometry is per-bucket, not per-stream; open a "
+                    f"stream with frame_shape=/op_chain= to route to "
+                    f"another bucket)")
+            return s.submit(frame, ts=ts, tag=tag)
+        # ONE atomic section for the (bucket, quality_level) read, the
+        # decimation, the geometry check, AND the enqueue: quality
+        # rebinds (dispatch thread) swap bucket+level and flush the
+        # queues under this same lock, so no frame of the OLD geometry
+        # can slip into the ingress after the flush — without this, a
+        # submit racing a rebind could poison a whole device batch.
+        with self._lock:
+            bucket = s.bucket if s.bucket is not None else self._buckets[0]
+            level = s.quality_level
+            if level > 0:
+                # Downshifted session: decimate ×2^level per axis at the
+                # door (a strided VIEW — zero copy until staging); the
+                # downshift bucket's op chain ends in the matching
+                # upscale stage, so the DELIVERY is still full
+                # resolution. Bit-exactness is waived exactly while the
+                # level is > 0.
+                f = 1 << level
+                frame = frame[::f, ::f]
+            if bucket.frame_shape is None:
+                bucket.frame_shape = tuple(frame.shape)
+                bucket.frame_dtype = np.dtype(frame.dtype)
+            if tuple(frame.shape) != tuple(bucket.frame_shape) \
+                    or np.dtype(frame.dtype) != np.dtype(bucket.frame_dtype):
+                raise ValueError(
+                    f"frame {frame.shape}/{frame.dtype} does not match this "
+                    f"stream's pinned signature {tuple(bucket.frame_shape)}/"
+                    f"{np.dtype(bucket.frame_dtype)} (one compiled program "
+                    f"serves every session in a bucket — geometry is "
+                    f"per-bucket, not per-stream; open a stream with "
+                    f"frame_shape=/op_chain= to route to another bucket)")
+            return s.submit(frame, ts=ts, tag=tag)
 
     def poll(self, session_id: str, max_items: Optional[int] = None) -> list:
         """Pop completed ``Delivery`` records for one stream (works on
@@ -1117,8 +1634,9 @@ class ServeFrontend:
         staging pool (max_inflight + 1 buffers: the one being rewritten
         always belongs to an already-collected batch, exactly like the
         single-stream pipeline's). Per bucket because the slab layout
-        derives from THAT bucket's compiled input sharding."""
-        shape = (self.config.batch_size, *bucket.frame_shape)
+        derives from THAT bucket's compiled input sharding AND its
+        (control-plane-resizable) batch size."""
+        shape = (bucket.batch_size, *bucket.frame_shape)
         dtype = np.dtype(bucket.frame_dtype)
         if bucket.assembler is None or bucket.assembler.batch_shape != shape:
             bucket.engine.ensure_compiled(shape, dtype)
@@ -1430,16 +1948,32 @@ class ServeFrontend:
                     # queue, and semaphore are being replaced under us.
                     # _recover waits for this flag before touching them.
                     self._dispatch_parked.set()
-                    time.sleep(self.config.tick_s)
+                    time.sleep(self._tick_s)
                     continue
                 self._dispatch_parked.clear()
                 if self._supervisor is not None:
                     self._supervisor.beat("dispatch")
+                # Control-plane actuations owned by THIS thread: quality
+                # rebinds (flush + bucket swap touch the session pending
+                # deques only dispatch may touch) and batch resizes
+                # (only safe while the bucket has nothing in flight —
+                # a resize recompiles, and a batch must not straddle
+                # the old and new program shapes).
+                if not self._pending_rebinds.empty():
+                    self._apply_rebinds_dispatch()
+                if self._pending_resizes:
+                    self._apply_resizes_dispatch()
                 with self._lock:
+                    # A bucket mid-resize is quiescent by contract: its
+                    # program is being recompiled on the resize thread
+                    # and a batch must not straddle the old and new
+                    # shapes. Its sessions keep queueing; EDF picks the
+                    # backlog up the tick the swap lands.
                     bucket_sessions = [
                         (b, [s for s in b.sessions.values()
                              if s.state != CLOSED])
-                        for b in self._buckets if b.sessions]
+                        for b in self._buckets
+                        if b.sessions and not b.resizing]
                 plan = None
                 if bucket_sessions:
                     # One bucket per tick (one compiled program per
@@ -1457,7 +1991,7 @@ class ServeFrontend:
                                          slots=chosen, bucket=pick)
                 self._finalize_drained()
                 if plan is None:
-                    time.sleep(self.config.tick_s)
+                    time.sleep(self._tick_s)
                     continue
                 # Bounded in-flight depth; poll so shutdown can't wedge on
                 # a dead collect thread. Acquired before any staging
@@ -1606,7 +2140,8 @@ class ServeFrontend:
                     # contended ticks are counted but not sampled — see
                     # the dispatch-side cost_sample comment).
                     bucket.observe_tick((time.time() - _t0) * 1e3,
-                                        sample=plan.cost_sample)
+                                        sample=plan.cost_sample,
+                                        valid=plan.valid)
                     bucket.adjust_inflight(-1)
                 self.tracer.complete("batch_complete", _t0, time.time(),
                                      TRACK_DEVICE, seq=seq,
@@ -1678,6 +2213,13 @@ class ServeFrontend:
                if self.tracer.enabled else {}),
             **({"flight": self.flight.stats()}
                if self.flight is not None else {}),
+            **({"control": {
+                    **self.control_plane.stats(),
+                    "quality_rebinds": self.quality_rebinds,
+                    "quality_rebinds_dropped": self.quality_rebinds_dropped,
+                    "resize_compile_errors": self.resize_compile_errors,
+                    "admission_tier_floor": self._admission_tier_floor,
+                }} if self.control_plane is not None else {}),
         }
 
 

@@ -62,6 +62,11 @@ class SessionConfig:
     frame_delay: int = 0          # reorder cursor lag; 0 = deliver ASAP
     reorder_capacity: int = 50
     out_queue_size: int = 64      # poll()-side bound, drop-oldest beyond
+    tier: int = 1                 # priority tier (control.controllers:
+    #   0 interactive, 1 standard, 2 batch — lower sheds LAST): breaks
+    #   EDF ties in the batcher's slot pick, orders the quality
+    #   controller's downshift victims, and is what the admission floor
+    #   refuses by under sustained overload
 
 
 @dataclasses.dataclass
@@ -111,6 +116,16 @@ class StreamSession:
         #   bound to (serve.server._Bucket, set at admission): which
         #   compiled program serves it, which geometry its frames must
         #   match, and where its faults/budget overflow attribute
+        # -- load-adaptive quality state (dvf_tpu.control) --------------
+        self.quality_level = 0   # 0 = full quality; level L frames are
+        #   decimated ×2^L per axis at submit and served by a bucket
+        #   whose op chain ends in upscale(scale=2^L), so DELIVERIES are
+        #   always full resolution (bit-exactness waived while > 0)
+        self.base_sig: Any = None    # (frame_shape, np_dtype) of the
+        #   full-quality signature, captured at the first downshift so
+        #   recovery can route home even if the base bucket retired
+        self.base_chain: Any = None  # the full-quality canonical chain
+        self.quality_shifts = 0      # lifetime level changes (stats)
         self.ingress = DropOldestQueue(maxsize=self.config.queue_size)
         # Scheduler-owned staging between ingress and the device: the
         # EDF/shed scan needs to see every queued frame, which the
@@ -208,6 +223,23 @@ class StreamSession:
                     self.shed += n
             return
         self.pending.extend(self.ingress.pop_up_to(len(self.ingress)))
+
+    def flush_queued(self, count_shed: bool = True) -> int:
+        """Drop everything queued (pending + ingress) — the
+        quality-rebind flush: frames queued at the OLD geometry cannot
+        be staged into the new bucket's program. Dispatch-thread only
+        (owns ``pending``). ``count_shed=False`` keeps the loss out of
+        ``shed`` — the control plane's pressure predicate watches
+        ``shed_total``, and a flush caused by the controller's OWN
+        quality move must not read back as fresh overload evidence (the
+        frontend counts these separately)."""
+        n = len(self.pending) + len(
+            self.ingress.pop_up_to(len(self.ingress)))
+        self.pending.clear()
+        if n and count_shed:
+            with self._lock:
+                self.shed += n
+        return n
 
     def shed_expired(self, now: float) -> int:
         """Drop pending frames whose SLO deadline has passed. Deadlines
@@ -347,6 +379,9 @@ class StreamSession:
                 #   evicted from the poll queue before the client read it
                 "inflight": self.inflight,
                 "slo_ms": self.config.slo_ms,
+                "tier": self.config.tier,
+                "quality_level": self.quality_level,
+                "quality_shifts": self.quality_shifts,
                 **self.latency.summary(),
             }
 

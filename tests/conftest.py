@@ -86,6 +86,11 @@ def pytest_configure(config):
                    "buckets, compiled-program pool, AOT warm-start — CPU "
                    "backend, bounded wall time; run in tier-1, select "
                    "with -m multitenant)")
+    config.addinivalue_line(
+        "markers", "control: load-adaptive control plane tests (seeded, "
+                   "CPU backend, deterministic controller replay, quality "
+                   "downshift/recovery, priority tiers — run in tier-1; "
+                   "select with -m control)")
 
 
 @pytest.fixture(scope="session", autouse=True)

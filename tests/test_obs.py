@@ -706,7 +706,9 @@ class TestServeFlightTriggers:
                         flight_dir=str(tmp_path),
                         flight_min_interval_s=0.0))
         with fe:
-            assert fe.telemetry.on_sample == fe._check_slo_burn  # wired
+            # Wired through the chained hook (burn check + control
+            # plane; the plane leg is a no-op when control is off).
+            assert fe.telemetry.on_sample == fe._on_telemetry_sample
             # Healthy window: 10 deliveries, 1 miss → 0.1 < 0.5: no dump.
             fe._check_slo_burn({"delivered_total": 0, "slo_miss_total": 0},
                                {"delivered_total": 10, "slo_miss_total": 1})
