@@ -1,0 +1,232 @@
+"""Lineage overhead gate: attribution must cost ≤ 3% of serve fps.
+
+Frame-lineage attribution (obs.lineage) promises "normal frames fold
+into counters at near-zero cost". This bench holds it to that: the SAME
+closed-loop multi-session serve harness runs lineage-off and lineage-on,
+and the committed numbers (benchmarks/ATTR_BENCH.json) pin the
+throughput overhead under the budget:
+
+    overhead_frac = 1 − fps_on / fps_off   ≤   0.03
+
+Methodology for this hypervisor-oversubscribed host (its wall clock
+drifts ±5× with steal on a timescale of seconds — CHANGES.md's
+long-standing caveat, which defeats naive A-then-B legs entirely, and
+even alternating-burst pairs: measured ratios swung 0.4–1.8 per round):
+BOTH frontends are built and warmed up front, then each round drives
+them CONCURRENTLY — identical closed-loop load on each, same wall
+window — so every instant of steal and every scheduler decision is
+common-mode, and the per-round fps RATIO isolates the per-frame code
+cost. Under saturated shared CPU, a leg needing k% more cycles per
+frame delivers ~k% fewer frames; measured rounds are stable to ±0.3%
+while absolute fps swings 2× with steal. Throughput context (best
+burst fps per leg) and each leg's p99 (under the same concurrent load)
+are recorded beside the ratio — attribution that kept fps but fattened
+the tail would be a lie of omission.
+
+The harness is the serving frontend end to end (open → submit → device
+batch → poll), N sessions each driving a bounded closed loop (window =
+a few batches in flight), so the measured fps is sustainable serve
+throughput, not a queue-flood artifact. CPU-runnable; the same harness
+reports TPU numbers inside a TPU window.
+
+Tier-1 runs ``run(quick=True)`` for the schema and asserts the
+COMMITTED json stays within budget (tests/test_obs.py) — a quick run
+on a noisy box is a smoke test, not evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+OVERHEAD_BUDGET_FRAC = 0.03
+
+
+def _drive_burst(fe, sid, frame, n_frames, window, out):
+    """One session's closed loop for one burst: keep ``window`` frames
+    in flight, count deliveries, drain the tail."""
+    submitted = polled = 0
+    while submitted < n_frames:
+        if submitted - polled < window:
+            fe.submit(sid, frame)
+            submitted += 1
+        else:
+            time.sleep(0.0005)
+        polled += len(fe.poll(sid))
+    deadline = time.time() + 30.0
+    while polled < submitted and time.time() < deadline:
+        got = len(fe.poll(sid))
+        polled += got
+        if not got:
+            time.sleep(0.001)
+    out[sid] = polled
+
+
+def _burst_fps(fe, sids, frame, n_frames, window):
+    out: dict = {}
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=_drive_burst,
+                                args=(fe, sid, frame, n_frames, window,
+                                      out))
+               for sid in sids]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return sum(out.values()) / wall if wall > 0 else 0.0
+
+
+def _build_frontend(lineage, sessions, batch):
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    fe = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=batch, max_sessions=max(16, sessions),
+                    queue_size=4000, out_queue_size=16384,
+                    slo_ms=60_000.0, lineage=lineage,
+                    telemetry_sample_s=0.0)).start()
+    sids = [fe.open_stream() for _ in range(sessions)]
+    return fe, sids
+
+
+def run(quick=False):
+    """The full bench document (ATTR_BENCH.json). ``quick`` shrinks
+    everything to smoke-test scale for the tier-1 schema gate."""
+    if quick:
+        sessions, batch, n_frames, rounds = 2, 4, 40, 2
+        size = (64, 64, 3)
+    else:
+        sessions, batch, n_frames, rounds = 4, 8, 150, 10
+        size = (96, 96, 3)
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, size, dtype=np.uint8)
+    window = batch * 3
+    fe_off, sids_off = _build_frontend(False, sessions, batch)
+    fe_on, sids_on = _build_frontend(True, sessions, batch)
+    try:
+        # Warm BOTH (compile + first batches) outside every clock.
+        _burst_fps(fe_off, sids_off, frame, max(8, batch), window)
+        _burst_fps(fe_on, sids_on, frame, max(8, batch), window)
+        rows = []
+        for i in range(rounds):
+            # One round = both frontends driven CONCURRENTLY with the
+            # identical closed-loop load: steal is common-mode, the
+            # ratio isolates the per-frame code cost.
+            sample: dict = {}
+
+            def leg(fe, sids, key):
+                sample[key] = _burst_fps(fe, sids, frame, n_frames,
+                                         window)
+
+            ta = threading.Thread(target=leg,
+                                  args=(fe_off, sids_off, "off"))
+            tb = threading.Thread(target=leg,
+                                  args=(fe_on, sids_on, "on"))
+            ta.start()
+            tb.start()
+            ta.join()
+            tb.join()
+            rows.append({
+                "round": i,
+                "off_fps": round(sample["off"], 2),
+                "on_fps": round(sample["on"], 2),
+                "on_over_off": round(sample["on"] / sample["off"], 4)
+                if sample["off"] else None,
+            })
+        # Latency legs: the saturated rounds above measure throughput
+        # (their p99 is closed-loop queue depth, not serving latency);
+        # latency compares on a PACED sub-capacity load — fresh session
+        # per frontend, both driven concurrently at the same rate.
+        lat: dict = {}
+
+        def paced(fe, key, rate_fps=60.0, n=200):
+            sid = fe.open_stream()
+            period = 1.0 / rate_fps
+            nxt = time.perf_counter()
+            for _ in range(n):
+                fe.submit(sid, frame)
+                fe.poll(sid)
+                nxt += period
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            deadline = time.time() + 20.0
+            got = 0
+            while got < n and time.time() < deadline:
+                got += len(fe.poll(sid))
+                time.sleep(0.002)
+            lat[key] = {k: fe.stats()["sessions"][sid].get(k)
+                        for k in ("p50_ms", "p99_ms", "delivered")}
+            fe.close(sid, drain=False)
+
+        ta = threading.Thread(target=paced, args=(fe_off, "off"))
+        tb = threading.Thread(target=paced, args=(fe_on, "on"))
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+        p99_off = lat["off"]["p99_ms"]
+        p99_on = lat["on"]["p99_ms"]
+        paced_lat = lat
+    finally:
+        fe_off.stop()
+        fe_on.stop()
+    ratios = [r["on_over_off"] for r in rows if r["on_over_off"]]
+    ratio = statistics.median(ratios) if ratios else None
+    overhead = 1.0 - ratio if ratio is not None else None
+    return {
+        "bench": "attr_bench",
+        "quick": quick,
+        "rounds": {str(r["round"]): r for r in rows},
+        "sessions": sessions,
+        "batch": batch,
+        "frames_per_burst": n_frames,
+        "height": size[0],
+        "width": size[1],
+        "lineage_off": {"best_fps": max((r["off_fps"] for r in rows),
+                                        default=None),
+                        **paced_lat["off"]},
+        "lineage_on": {"best_fps": max((r["on_fps"] for r in rows),
+                                       default=None),
+                       **paced_lat["on"]},
+        "acceptance": {
+            "overhead_budget_frac": OVERHEAD_BUDGET_FRAC,
+            # Median of per-round on/off ratios from CONCURRENT legs —
+            # steal is common-mode within a round, so the ratio
+            # isolates the per-frame code cost (module docstring).
+            "measured_overhead_frac": (round(overhead, 4)
+                                       if overhead is not None else None),
+            "p99_on_over_off_ratio": (round(p99_on / p99_off, 4)
+                                      if p99_off and p99_on else None),
+            "within_budget": (overhead is not None
+                              and overhead <= OVERHEAD_BUDGET_FRAC),
+        },
+    }
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    out_path = os.path.join(_HERE, "ATTR_BENCH.json")
+    if not quick:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(json.dumps(doc["acceptance"], indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

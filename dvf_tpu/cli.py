@@ -249,7 +249,8 @@ def _resolve_source(args, allow_shm: bool = True):
     )
 
 
-def _start_exporter(args, registry, health_fn=None, ring=None):
+def _start_exporter(args, registry, health_fn=None, ring=None,
+                    explain_fn=None):
     """--metrics-port: start the pull-based scrape endpoint (obs.export)
     over this invocation's registry. Returns the started exporter (None
     when the flag is absent). Port 0 binds an ephemeral port; the bound
@@ -260,8 +261,10 @@ def _start_exporter(args, registry, health_fn=None, ring=None):
     from dvf_tpu.obs.export import MetricsExporter
 
     ex = MetricsExporter(registry, port=port, health_fn=health_fn,
-                         ring=ring).start()
-    print(f"[metrics] /metrics /healthz /timeseries on {ex.url}",
+                         ring=ring, explain_fn=explain_fn).start()
+    endpoints = "/metrics /healthz /timeseries" + (
+        " /explain" if explain_fn is not None else "")
+    print(f"[metrics] {endpoints} on {ex.url}",
           file=sys.stderr, flush=True)
     return ex
 
@@ -355,6 +358,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
         control=args.control,
         default_tier=args.tier if args.tier is not None else 1,
+        lineage=args.lineage,
+        profile_dir=args.profile_dir,
     )
     frontend = ServeFrontend(filt, config, engine=engine)
     manifest = _load_manifest(args.precompile)
@@ -364,7 +369,9 @@ def _cmd_serve_multi(args, filt, engine) -> int:
               f"{', '.join(warmed)}", file=sys.stderr)
     exporter = _start_exporter(args, frontend.registry,
                                health_fn=frontend.health,
-                               ring=frontend.telemetry)
+                               ring=frontend.telemetry,
+                               explain_fn=(frontend.explain
+                                           if args.lineage else None))
 
     # Spread the streams across ~0.4×..1.6× the base rate: genuinely
     # different per-tenant cadences, so batches interleave sessions
@@ -497,7 +504,17 @@ def cmd_serve(args) -> int:
         fault_window_s=args.fault_window,
         stall_timeout_s=args.stall_timeout or 0.0,
         chaos=_parse_chaos(args),
+        # The single-stream tier honors --flight-dir with the same
+        # spelling as serve --sessions N / fleet / worker: watchdog
+        # trips and hard pipeline failures dump post-mortems there.
+        flight_dir=args.flight_dir,
     )
+    if args.lineage or args.profile_dir:
+        print("[serve] note: --lineage/--profile-dir are multi-session "
+              "features (per-frame attribution and per-signature stage "
+              "profiles need the serving frontend); single-stream runs "
+              "report stage costs via stats() — use --sessions N or "
+              "the fleet tier", file=sys.stderr)
 
     queue = None
     if args.transport == "ring":
@@ -714,6 +731,8 @@ def cmd_fleet(args) -> int:
                          if args.stall_timeout is not None else 30.0),
         trace=args.trace,
         control=args.control,
+        lineage=args.lineage,
+        profile_dir=args.profile_dir,
     )
     config = FleetConfig(
         replicas=args.replicas,
@@ -748,7 +767,9 @@ def cmd_fleet(args) -> int:
 
     exporter = _start_exporter(args, fleet.registry,
                                health_fn=fleet_health,
-                               ring=fleet.telemetry)
+                               ring=fleet.telemetry,
+                               explain_fn=(fleet.explain
+                                           if args.lineage else None))
 
     def drive(sid: str, rate: float, seed: int) -> None:
         src = SyntheticSource(height=args.height, width=args.width,
@@ -879,6 +900,17 @@ def cmd_worker(args) -> int:
                                health_fn=lambda: {"ok": True,
                                                   **worker.signals()},
                                ring=ring)
+    flight = None
+    if args.flight_dir:
+        from dvf_tpu.obs.export import FlightRecorder
+
+        # The worker tier's flight recorder: its loop contains faults
+        # per iteration, so the trigger is the FATAL exit (budget
+        # exhaustion / unrecoverable engine) — the moment the trace
+        # window + stats are worth a dump.
+        flight = FlightRecorder(args.flight_dir, label="worker",
+                                trace_fn=lambda: [worker.tracer.snapshot()],
+                                stats_fn=worker.stats, ring=ring)
     print(
         f"TPU worker serving {filt.name} on "
         f"tcp://{args.host}:{args.distribute_port} → :{args.collect_port}",
@@ -888,6 +920,10 @@ def cmd_worker(args) -> int:
         worker.run()
     except KeyboardInterrupt:
         pass
+    except Exception as e:  # noqa: BLE001 — dump, then re-raise
+        if flight is not None:
+            flight.trigger(f"worker failed: {e!r}")
+        raise
     finally:
         if exporter is not None:
             exporter.stop()
@@ -896,6 +932,28 @@ def cmd_worker(args) -> int:
         if worker.tracer.enabled:
             worker.tracer.export("dvf_worker_timing.pftrace")
         worker.close()
+    return 0
+
+
+def cmd_trace_view(args) -> int:
+    """Offline post-mortem summary: a trace file or a flight-dump
+    directory → per-lane utilization, slowest spans, and (when the dump
+    carries lineage.json) the slowest frame lineages."""
+    from dvf_tpu.obs.viewer import render_text, summarize
+
+    if not os.path.exists(args.path):
+        print(f"error: {args.path}: no such file or directory",
+              file=sys.stderr)
+        return 2
+    try:
+        summary = summarize(args.path, top=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(summary, default=float))
+    else:
+        print(render_text(summary))
     return 0
 
 
@@ -1543,6 +1601,20 @@ def main(argv=None) -> int:
     sp.add_argument("--max-sessions", type=int, default=0,
                     help="admission cap for --sessions mode "
                          "(0 = max(16, --sessions))")
+    sp.add_argument("--lineage", action="store_true",
+                    help="arm frame-lineage latency attribution "
+                         "(multi-session serve: per-frame additive "
+                         "decomposition — ingress/bucket-queue/"
+                         "assemble+H2D/device/D2H/deliver — behind "
+                         "stats()['attribution'], attr_* metrics, and "
+                         "the /explain endpoint; SLO-breaching frames "
+                         "keep full lineage as flight-dump exemplars)")
+    sp.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="persist per-signature stage-cost profiles "
+                         "here (sibling of --compile-cache-dir): "
+                         "measured component costs seed the next run's "
+                         "tick-cost estimates and annotate control-"
+                         "plane decisions")
     sp.add_argument("--control", action="store_true",
                     help="--sessions mode: arm the load-adaptive control "
                          "plane (dvf_tpu.control) — closed-loop "
@@ -1604,6 +1676,14 @@ def main(argv=None) -> int:
                          "demo: aggregate throughput at 1 and "
                          "--replicas replicas, core-pinned workers "
                          "(benchmarks/fleet_bench.py persists this)")
+    fl.add_argument("--lineage", action="store_true",
+                    help="arm frame-lineage latency attribution on every "
+                         "replica (same spelling as serve --lineage); "
+                         "lineage crosses the ProcessReplica RPC with a "
+                         "clock re-base and /explain fans out per replica")
+    fl.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="persist per-signature stage-cost profiles "
+                         "(serve --profile-dir, applied per replica)")
     fl.add_argument("--control", action="store_true",
                     help="arm the load-adaptive control plane on every "
                          "replica's frontend (see serve --control); the "
@@ -1634,7 +1714,15 @@ def main(argv=None) -> int:
                          "the shm ring (serve cold-start can take ~10 s)")
 
     wp = sub.add_parser("worker", parents=[plat, ing, res, obsp],
+                        # --flight-dir spelled identically to serve/fleet:
+                        # every tier that accepts --metrics-port accepts
+                        # the flight flag too (audited in tests/test_cli)
                         help="ZMQ worker for the reference app")
+    wp.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="flight recorder: a fatal worker fault dumps "
+                         "the bounded post-mortem (trace window + stats "
+                         "+ telemetry ring) here — serve/fleet's "
+                         "--flight-dir, worker tier")
     wp.add_argument("--trace", action="store_true",
                     help="arm the worker's tracer (bounded ring; exported "
                          "to dvf_worker_timing.pftrace at exit)")
@@ -1667,6 +1755,20 @@ def main(argv=None) -> int:
                          "(simulate a slow worker, like inverter.py --delay)")
     wp.add_argument("--mesh", default=None,
                     help="device mesh, same forms as serve --mesh")
+
+    tv = sub.add_parser(
+        "trace-view",
+        help="offline summary of a Perfetto trace or flight dump: "
+             "per-lane utilization, slowest spans, slowest frame "
+             "lineages — post-mortems without loading Perfetto")
+    tv.add_argument("path",
+                    help="a .pftrace / Chrome-trace JSON file, or a "
+                         "flight-dump directory (meta.json + "
+                         "trace.pftrace + lineage.json)")
+    tv.add_argument("--top", type=int, default=10,
+                    help="rows per section (slowest spans / lineages)")
+    tv.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON instead of the text view")
 
     tp = sub.add_parser("train", parents=[plat], help="train the style net (checkpoint/resume)")
     tp.add_argument("--steps", type=int, default=50)
@@ -1751,7 +1853,7 @@ def main(argv=None) -> int:
             "filters": cmd_filters, "doctor": cmd_doctor,
             "serve": cmd_serve, "worker": cmd_worker, "fleet": cmd_fleet,
             "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
-            "camera": cmd_camera,
+            "camera": cmd_camera, "trace-view": cmd_trace_view,
         }[args.cmd](args)
     finally:
         if getattr(args, "platform", None):

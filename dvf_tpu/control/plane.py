@@ -149,12 +149,23 @@ class ControlPlane:
             self._saturation_open = False
         self._prev_row = row
         if actions:
+            # Measured stage-cost annotation (obs.lineage via the
+            # actuator's control_view): a bucket-targeted decision
+            # records WHERE that bucket's latency was going when the
+            # controller acted — the decision log's half of "why did
+            # the controller do that at 14:02".
+            cost_by_label = {b.get("label"): b.get("stage_cost_ms")
+                             for b in row.get("buckets") or []
+                             if isinstance(b, dict)}
             with self._lock:
                 self.actions_total += len(actions)
                 for a in actions:
-                    self.decisions.append({
-                        "kind": a.kind, "target": a.target,
-                        "value": a.value, "reason": a.reason})
+                    entry = {"kind": a.kind, "target": a.target,
+                             "value": a.value, "reason": a.reason}
+                    cost = cost_by_label.get(a.target)
+                    if cost:
+                        entry["stage_cost_ms"] = cost
+                    self.decisions.append(entry)
         return actions
 
     # -- apply side ------------------------------------------------------

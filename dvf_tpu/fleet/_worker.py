@@ -140,8 +140,14 @@ def main(argv=None) -> int:
                 elif kind == "drain":
                     out = frontend.drain(timeout=op[1])
                 elif kind == "health":
+                    import time as _time
+
+                    # wall_time_s: the parent's clock-offset probe for
+                    # per-frame lineage re-basing (ProcessReplica.health
+                    # estimates offset from the RPC midpoint).
                     out = dict(frontend.health(),
-                               submit_errors=submit_errors)
+                               submit_errors=submit_errors,
+                               wall_time_s=_time.time())
                 elif kind == "stats":
                     out = {"stats": frontend.stats(),
                            "latency": frontend.latency_snapshot(),

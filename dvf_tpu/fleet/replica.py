@@ -116,6 +116,12 @@ class ReplicaHandle:
         self.state = DEAD          # until start() succeeds
         self.restarts = 0
         self.started_at: Optional[float] = None
+        self.clock_offset_s = 0.0  # replica wall clock − front-door
+        #   wall clock: what frame-lineage marks crossing this replica's
+        #   boundary are re-based by (obs.lineage.FrameLineage.rebase —
+        #   the merge_tracer_snapshots epoch discipline, per frame).
+        #   Exactly 0 for in-process replicas; process replicas estimate
+        #   it from the health RPC's midpoint each monitor tick.
 
     # lifecycle
     def start(self) -> "ReplicaHandle":
@@ -501,7 +507,22 @@ class ProcessReplica(ReplicaHandle):
         # slow submit for the full RPC budget (TimeoutError = "busy,
         # retry next tick"; liveness and the submit path's own socket
         # timeout still catch real deaths).
-        return self._rpc(("health",), timeout=5.0, lock_timeout=5.0)
+        t0 = time.time()
+        out = self._rpc(("health",), timeout=5.0, lock_timeout=5.0)
+        t1 = time.time()
+        if isinstance(out, dict):
+            wall = out.get("wall_time_s")
+            # RPC-midpoint clock-offset estimate (NTP's trick): the
+            # worker stamped its wall clock somewhere inside [t0, t1];
+            # the midpoint bounds the error by half the round trip.
+            # GATED on that round trip: a health RPC that waited
+            # seconds behind a busy submit (the channel lock allows up
+            # to 5 s) would poison the offset by up to half that wait,
+            # garbling every lineage re-base until the next tick —
+            # keep the previous estimate and wait for a clean probe.
+            if wall is not None and (t1 - t0) <= 0.25:
+                self.clock_offset_s = wall - (t0 + t1) / 2.0
+        return out
 
     def stats_full(self) -> dict:
         # Bounded on the CHANNEL LOCK only: a stats pull queued behind a

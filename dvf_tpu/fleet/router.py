@@ -269,6 +269,13 @@ class FleetFrontend:
         # RESTART still resets its share: that is the idiomatic counter
         # reset consumers already handle.
         self._delivered_seen: Dict[str, float] = {}
+        # explain() freshness cache (see its docstring): one stats
+        # fan-out per second however hard /explain is polled.
+        self._explain_cache: dict = {
+            "lineage": bool(self.config.serve.lineage), "replicas": {}}
+        self._explain_cache_t = float("-inf")
+        self._explain_cache_lock = threading.Lock()
+        self._explain_refresh_lock = threading.Lock()
         for i in range(self.config.replicas):
             rid = f"r{i}"
             self._replicas[rid] = self._make_replica(rid, i)
@@ -588,7 +595,7 @@ class FleetFrontend:
                         if isinstance(e, ReplicaLostError):
                             self._note_loss(r, e)
                         got = []
-                    out.extend(self._map_deliveries(s, got))
+                    out.extend(self._map_deliveries(s, got, replica=r))
             for d in out:
                 if d.index <= s.last_index:
                     self.order_violations += 1
@@ -597,15 +604,33 @@ class FleetFrontend:
             s.polled += len(out)
         return out
 
-    def _map_deliveries(self, s: _FleetSession, got: list) -> list:
+    def _map_deliveries(self, s: _FleetSession, got: list,
+                        replica: Optional[ReplicaHandle] = None) -> list:
         """Replica deliveries → fleet deliveries: the fleet index rides
-        the slot tag (ZMQ-bridge style); the user's tag comes back out."""
+        the slot tag (ZMQ-bridge style); the user's tag comes back out.
+
+        Frame lineage crossing the hop is RE-BASED onto the front
+        door's clock (the replica's marks are wall-clock stamps on ITS
+        clock; ``clock_offset_s`` is the health-RPC midpoint estimate —
+        0 for in-process replicas) and then extended with the ``rpc``
+        component: replica delivery → this poll's pickup, so the
+        telescoping additivity (components sum to end-to-end latency)
+        survives a ProcessReplica boundary."""
+        offset = (replica.clock_offset_s if replica is not None else 0.0)
+        now = None
         mapped = []
         for d in got:
             if isinstance(d.tag, tuple) and len(d.tag) == 2:
                 fleet_idx, user_tag = d.tag
             else:  # untagged (shouldn't happen): fall back to replica idx
                 fleet_idx, user_tag = d.index, d.tag
+            lin = d.lineage
+            if lin is not None:
+                if offset:
+                    lin.rebase(-offset)
+                if now is None:
+                    now = time.time()
+                lin.mark("rpc", now)
             mapped.append(d._replace(index=fleet_idx, tag=user_tag))
         return mapped
 
@@ -843,7 +868,7 @@ class FleetFrontend:
                 pass
             try:
                 s.tail.extend(self._map_deliveries(
-                    s, old.poll(s.replica_sid, None)))
+                    s, old.poll(s.replica_sid, None), replica=old))
             except Exception:  # noqa: BLE001
                 pass
             orphan = not self.config.migrate
@@ -913,6 +938,50 @@ class FleetFrontend:
         """Merge every replica's trace into one Perfetto file on one
         aligned clock (``obs.trace.merge_tracer_snapshots``)."""
         return merge_tracer_snapshots(self.trace_snapshots(), out_path)
+
+    def explain(self) -> dict:
+        """Fleet-wide latency attribution: every reachable replica's
+        ``explain`` decomposition (lineage-armed replicas only — arm
+        with ``ServeConfig.lineage``), keyed by replica id. One stats
+        RPC per process replica; a busy or dead replica costs its row.
+        Always the p99 decomposition — the per-replica rows ride the
+        stats RPC, which computes at the attribution default.
+
+        Freshness-cached (attach_fleet_provider's discipline): a stats
+        RPC briefly holds each replica's serial channel lock against
+        its submit hot path, so a curl loop on ``/explain`` must
+        coalesce onto one fan-out per second, not multiply it. The
+        fan-out runs OUTSIDE the cache lock: a busy fleet's refresh
+        can take seconds (bounded channel-lock waits per replica), and
+        concurrent callers must get the stale cache, not a pile-up."""
+        with self._explain_cache_lock:
+            if time.monotonic() - self._explain_cache_t < 1.0:
+                return self._explain_cache
+        if not self._explain_refresh_lock.acquire(blocking=False):
+            # Another caller is mid-fan-out: serve the (possibly stale,
+            # at worst empty-first-call) cache rather than queueing.
+            with self._explain_cache_lock:
+                return self._explain_cache
+        try:
+            out: dict = {"lineage": bool(self.config.serve.lineage),
+                         "replicas": {}}
+            for rid, r in list(self._replicas.items()):
+                if r.state != HEALTHY:
+                    continue
+                try:
+                    export = r.stats_full()
+                except Exception:  # noqa: BLE001 — never throws
+                    continue
+                attr = ((export or {}).get("stats")
+                        or {}).get("attribution")
+                if attr and attr.get("explain"):
+                    out["replicas"][rid] = attr["explain"]
+            with self._explain_cache_lock:
+                self._explain_cache = out
+                self._explain_cache_t = time.monotonic()
+        finally:
+            self._explain_refresh_lock.release()
+        return out
 
     def signals(self) -> dict:
         """RPC-free front-door signal row (the fleet telemetry ring's

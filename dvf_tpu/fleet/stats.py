@@ -66,4 +66,10 @@ def replica_row(handle, export: Optional[dict], sessions: int) -> dict:
             faults=st.get("faults", {}).get("by_kind", {}),
             aggregate=st.get("aggregate"),
         )
+        attr = st.get("attribution")
+        if attr is not None:
+            # Lineage-armed replicas: the per-replica latency
+            # attribution rides the same stats RPC — the fleet-wide
+            # half of "where did my p99 go" (explain() fans this out).
+            row["attribution"] = attr
     return row

@@ -12,3 +12,10 @@ from dvf_tpu.obs.export import (  # noqa: F401
     attach_signal_provider,
     samples_from_signals,
 )
+from dvf_tpu.obs.lineage import (  # noqa: F401
+    AttributionAggregate,
+    AttributionPlane,
+    FrameLineage,
+    load_stage_profile,
+    save_stage_profile,
+)

@@ -226,10 +226,14 @@ class MetricsExporter:
         host: str = "127.0.0.1",
         health_fn: Optional[Callable[[], dict]] = None,
         ring: Optional[TimeSeriesRing] = None,
+        explain_fn: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.health_fn = health_fn
         self.ring = ring
+        self.explain_fn = explain_fn  # latency-attribution explain
+        #   surface (``ServeFrontend.explain``); ``/explain`` 404s
+        #   without one
         self.requests = 0
         self.request_errors = 0
         self._stat_lock = threading.Lock()  # handler threads are
@@ -288,8 +292,26 @@ class MetricsExporter:
             if self.ring is None:
                 req.send_error(404, explain="no telemetry ring attached")
                 return
+            since = None
+            raw = parse_qs(query).get("since")
+            if raw:
+                try:
+                    since = float(raw[0])
+                except ValueError:
+                    req.send_error(400, explain=f"bad since={raw[0]!r} "
+                                                f"(wall-clock seconds)")
+                    return
             self._reply(req, 200, "application/json",
-                        json.dumps(jsonable(self.ring.series())))
+                        json.dumps(jsonable(self.ring.series(
+                            since=since))))
+        elif path == "/explain":
+            if self.explain_fn is None:
+                req.send_error(404, explain="no explain surface attached "
+                                            "(lineage-armed serve/fleet "
+                                            "tiers expose one)")
+                return
+            self._reply(req, 200, "application/json",
+                        json.dumps(jsonable(self.explain_fn())))
         else:
             req.send_error(404)
 
@@ -382,6 +404,7 @@ class FlightRecorder:
         ring: Optional[TimeSeriesRing] = None,
         jax_profile_s: float = 0.0,
         max_total_bytes: Optional[int] = None,
+        lineage_fn: Optional[Callable[[], dict]] = None,
     ):
         self.out_dir = out_dir
         self.label = label
@@ -397,6 +420,12 @@ class FlightRecorder:
         self.trace_fn = trace_fn
         self.stats_fn = stats_fn
         self.ring = ring
+        self.lineage_fn = lineage_fn  # AttributionPlane.snapshot on a
+        #   lineage-armed owner: the dump then carries ``lineage.json``
+        #   — aggregates, the explain decomposition, and the FULL
+        #   lineages of the SLO-breaching / slowest exemplar frames, so
+        #   an SLO-burn post-mortem names the guilty stage instead of
+        #   shrugging
         self.jax_profile_s = jax_profile_s
         self.dumps: List[str] = []
         self.suppressed = 0
@@ -524,6 +553,9 @@ class FlightRecorder:
         if self.ring is not None:
             best_effort("timeseries", lambda: self._json(
                 dump_dir, "timeseries.json", self.ring.series()))
+        if self.lineage_fn is not None:
+            best_effort("lineage", lambda: self._json(
+                dump_dir, "lineage.json", self.lineage_fn()))
         return wrote
 
     @staticmethod

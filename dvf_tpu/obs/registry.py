@@ -87,7 +87,7 @@ DYNAMIC_KEY_PARENTS = frozenset({
     "sessions", "by_kind", "by_replica", "last", "replicas", "recoveries",
     "faults", "heartbeat_ages_s", "chaos", "rules", "fired", "polled",
     "rates", "series", "configs", "rounds", "trials", "buckets",
-    "warm_replicas", "by_signature",
+    "warm_replicas", "by_signature", "by_bucket", "by_session",
 })
 
 
@@ -493,6 +493,15 @@ class TimeSeriesRing:
                          or finite_or_none(v) is not None)})
         with self._lock:
             prev = self._rows[-1] if self._rows else None
+            if prev is not None and row["t"] <= prev["t"]:
+                # Row stamps are the ?since= cursor, whose semantics
+                # are strictly-after: two rows sharing one wall-clock
+                # value (coarse clock, back-to-back sample_once) would
+                # make the later one invisible to an incremental
+                # scraper forever. Keep ``t`` a strict total order.
+                import math
+
+                row["t"] = math.nextafter(prev["t"], math.inf)
             self._rows.append(row)
         if self.on_sample is not None:
             try:
@@ -507,10 +516,25 @@ class TimeSeriesRing:
         with self._lock:
             return dict(self._rows[-1]) if self._rows else None
 
-    def series(self) -> dict:
-        """The ``/timeseries`` document: row-oriented, bounded."""
+    def series(self, since: Optional[float] = None) -> dict:
+        """The ``/timeseries`` document: row-oriented, bounded.
+
+        ``since`` is the incremental-scrape cursor (``?since=<ts>`` on
+        the endpoint): only rows with ``t`` STRICTLY greater than it are
+        returned, so an external scraper polls the delta instead of
+        re-pulling the full window each time. ``cursor`` in the reply is
+        the newest retained row's wall-clock ``t`` — pass it back as the
+        next ``since``. Semantics pinned in tests/test_obs.py: the
+        cursor reflects the full window even when the filtered ``rows``
+        are empty (no new data ⇒ same cursor back), and a ``since``
+        older than the window's tail simply returns the whole bounded
+        window (rows already evicted are gone — the ring is a sliding
+        window, not a log)."""
         with self._lock:
             rows = [dict(r) for r in self._rows]
+        cursor = rows[-1]["t"] if rows else None
+        if since is not None:
+            rows = [r for r in rows if r["t"] > since]
         return {
             "interval_s": self.interval_s,
             "capacity": self.capacity,
@@ -520,6 +544,7 @@ class TimeSeriesRing:
             # pinned in tests/test_obs.py (a dead sampler would blind
             # every controller and the flight recorder at once).
             "hook_errors_total": self.hook_errors,
+            "cursor": cursor,
             "rows": rows,
         }
 

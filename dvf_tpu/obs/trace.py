@@ -334,18 +334,35 @@ def merge_tracer_snapshots(
         base = i * LANE_STRIDE
         off_us = int((float(s["start_time"]) - epoch0) * 1e6)
         name = s.get("process_name") or f"tracer{i}"
-        tracks = set()
+        # Track ids are arbitrary ints (pipeline stage ids, but also
+        # device ids / profiler pids from merged device traces): an id
+        # outside [0, LANE_STRIDE) would land in ANOTHER snapshot's pid
+        # block and interleave two processes' lanes in the Perfetto UI
+        # — so out-of-range tracks CLAMP into this snapshot's last lane
+        # (LANE_STRIDE − 1; negatives to 0). Within-process folding
+        # loses lane separation for the oversized ids only; the
+        # cross-process block invariant — the thing the merge exists
+        # for — always holds. Folds are counted in the provenance.
+        lane_tracks: Dict[int, set] = {}
+        folded = 0
         for e in s["events"]:
             e = dict(e)
             track = int(e.get("pid", 0))
-            tracks.add(track)
-            e["pid"] = base + track
+            lane = min(max(track, 0), LANE_STRIDE - 1)
+            if lane != track:
+                folded += 1
+            lane_tracks.setdefault(lane, set()).add(track)
+            e["pid"] = base + lane
             e["ts"] = int(e.get("ts", 0)) + off_us
             events.append(e)
-        for track in sorted(tracks):
+        for lane in sorted(lane_tracks):
+            raw = sorted(lane_tracks[lane])
+            label = (f"{name}/{raw[0]}" if len(raw) == 1 and raw[0]
+                     else name if len(raw) == 1
+                     else f"{name}/{'+'.join(map(str, raw))}")
             meta.append({
-                "name": "process_name", "ph": "M", "pid": base + track,
-                "args": {"name": f"{name}/{track}" if track else name},
+                "name": "process_name", "ph": "M", "pid": base + lane,
+                "args": {"name": label},
             })
         lanes.append({
             "process_name": name,
@@ -353,6 +370,7 @@ def merge_tracer_snapshots(
             "pid": s.get("pid"),
             "epoch_offset_us": off_us,
             "events": len(s["events"]),
+            "folded_tracks": folded,
             "dropped": int(s.get("dropped", 0)),
         })
     if len(events) > max_events:

@@ -108,6 +108,11 @@ class PipelineConfig:
     #   alongside the host-side frame-lifecycle trace (obs.trace) in one
     #   UI; with trace=True the merged host+device export
     #   (dvf_merged_timing.pftrace) also lands in this dir
+    flight_dir: Optional[str] = None  # flight recorder (obs.export): a
+    #   watchdog trip or hard pipeline failure dumps the bounded
+    #   post-mortem (trace window + stats) here — the single-stream
+    #   tier's spelling of serve/fleet --flight-dir. None = off.
+    flight_min_interval_s: float = 10.0  # dump rate limit
 
 
 class Pipeline:
@@ -191,6 +196,15 @@ class Pipeline:
         # adapts signals() (delivered/dropped/faults/overlap) at scrape.
         self.registry = MetricsRegistry()
         attach_signal_provider(self.registry, "pipeline", self.signals)
+        self.flight = None
+        if self.config.flight_dir:
+            from dvf_tpu.obs.export import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.config.flight_dir, label="pipeline",
+                min_interval_s=self.config.flight_min_interval_s,
+                trace_fn=lambda: [self.tracer.snapshot()],
+                stats_fn=self.stats)
         _ti = self.config.telemetry_interval_s
         self._capture_rate = RateLogger("capture", _ti if _ti > 0 else 5.0,
                                         quiet=_ti <= 0,
@@ -268,9 +282,21 @@ class Pipeline:
                     pass
 
     def _fail(self, e: BaseException) -> None:
-        if self._error is None:
+        first = self._error is None
+        if first:
             self._error = e
         self._abort.set()
+        if first and self.flight is not None:
+            # Hard failure: the post-mortem moment (serve's discipline —
+            # off-thread, rate-limited in the recorder).
+            self.flight.trigger_async(f"pipeline failed: {e!r}")
+
+    def _flight_trip(self, reason: str) -> None:
+        """Supervisor on_trip tap: dump the black box before recovery
+        tears the evidence down (off-thread — a disk write must not
+        extend the stall it records)."""
+        if self.flight is not None:
+            self.flight.trigger_async(reason)
 
     def _contain(self, e: BaseException, where: str) -> bool:
         """Resilient mode: drop, count, continue (the reference's
@@ -696,7 +722,8 @@ class Pipeline:
         if self.config.stall_timeout_s > 0:
             self._supervisor = Supervisor(
                 self.config.stall_timeout_s, on_stall=self._on_stall,
-                name="dvf-pipeline-supervisor").start()
+                name="dvf-pipeline-supervisor",
+                on_trip=self._flight_trip).start()
         try:
             for t in threads:
                 t.start()

@@ -73,6 +73,11 @@ class BatchPlan:
     #   behind THEIR device time, which would contaminate the bucket's
     #   per-program tick-cost EWMA (the EDF/cost denominator) toward the
     #   shared pipeline latency instead of this program's cost
+    lin_marks: Any = None  # lineage-armed frontends: the BATCH-level
+    #   (component, wall_ts) marks shared by every slot in this batch —
+    #   assemble_h2d at dispatch, device/d2h at collect; the router
+    #   extends each slot's FrameLineage with them before demux (one
+    #   stamp per batch, not per frame). None = lineage off.
 
 
 class ContinuousBatcher:

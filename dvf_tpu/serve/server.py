@@ -59,6 +59,11 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter, FilterChain
 from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
+from dvf_tpu.obs.lineage import (
+    AttributionPlane,
+    load_stage_profile,
+    save_stage_profile,
+)
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
 from dvf_tpu.obs.registry import (
     COUNTER,
@@ -191,6 +196,20 @@ class ServeConfig:
     control_config: Any = None    # control.ControlConfig; None = defaults
     default_tier: int = 1         # tier for open_stream(tier=None):
     #   0 interactive (sheds last), 1 standard, 2 batch (sheds first)
+    lineage: bool = False         # arm frame-lineage attribution
+    #   (obs.lineage): every frame carries a span context through
+    #   ingress → bucket queue → assemble/H2D → device → D2H → deliver,
+    #   each delivered frame's components summing to its end-to-end
+    #   latency; aggregates behind stats()['attribution'], signals()
+    #   attr_*, and the explain() surface; SLO-breaching frames retain
+    #   full lineage as flight-dump exemplars (--lineage on the CLI)
+    lineage_exemplars: int = 64   # exemplar retention bound (breaches +
+    #   slowest-K-per-window records kept for post-mortems)
+    profile_dir: Optional[str] = None  # persist per-signature stage-cost
+    #   profiles here (sibling of the compile cache): measured
+    #   per-component costs written at bucket retirement/stop, loaded at
+    #   bucket creation to seed tick-cost estimates and annotate
+    #   control-plane decisions. None = no persistence.
 
 
 class _Bucket:
@@ -256,6 +275,14 @@ class _Bucket:
         self.fetcher: Optional[ShardedBatchFetcher] = None
         self.egress_stats: Optional[EgressStats] = None
         self._tick_cost_ms: Optional[float] = None  # live EWMA
+        self._label_cache: Optional[str] = None
+        self._label_key: Optional[SignatureKey] = None
+        self.stage_profile: Optional[dict] = None  # persisted
+        #   per-signature stage-cost profile (obs.lineage), loaded at
+        #   creation when the frontend has a profile_dir: measured
+        #   component costs from PREVIOUS runs — seeds the tick-cost
+        #   estimate before the first live sample and annotates
+        #   control-plane decisions
         self._pooled = False  # engine leased/adopted in the ProgramPool
 
     # -- scheduling ------------------------------------------------------
@@ -268,7 +295,14 @@ class _Bucket:
         if self._tick_cost_ms is not None:
             return self._tick_cost_ms
         cal = getattr(self.engine, "step_block_ms", None)
-        return cal if cal else 1.0
+        if cal:
+            return cal
+        prof = self.stage_profile
+        if prof and prof.get("tick_cost_ms"):
+            # A previous run's MEASURED cost beats the 1 ms guess for
+            # the window before this run's first live sample.
+            return float(prof["tick_cost_ms"])
+        return 1.0
 
     def observe_tick(self, wall_ms: float, sample: bool = True,
                      valid: Optional[int] = None) -> None:
@@ -325,8 +359,15 @@ class _Bucket:
         return not self.sessions and self.inflight_batches == 0
 
     def label(self) -> str:
-        return self.key.render() if self.key is not None else \
-            f"{self.op_chain}|unpinned"
+        # Cached: label() sits on per-frame paths (attribution fold,
+        # router row accounting) and a render is a string build.
+        key = self.key
+        if key is not None:
+            if self._label_cache is None or self._label_key is not key:
+                self._label_cache = key.render()
+                self._label_key = key
+            return self._label_cache
+        return f"{self.op_chain}|unpinned"
 
     # -- observability ---------------------------------------------------
 
@@ -438,6 +479,11 @@ class ServeFrontend:
         attach_signal_provider(
             self.registry, "serve", self.signals,
             labels={"replica": label} if label else None)
+        # -- frame-lineage attribution plane (obs.lineage) -----------------
+        self.attribution: Optional[AttributionPlane] = None
+        if self.config.lineage:
+            self.attribution = AttributionPlane(
+                exemplar_capacity=self.config.lineage_exemplars)
         # -- load-adaptive control plane (dvf_tpu.control) ----------------
         # Built BEFORE the ring so the ring cadence can come from the
         # control config; the plane's decisions ride the ring's
@@ -496,13 +542,22 @@ class ServeFrontend:
                 trace_fn=lambda: [self.tracer.snapshot()],
                 stats_fn=self.stats,
                 ring=self.telemetry,
-                jax_profile_s=self.config.flight_profile_s)
+                jax_profile_s=self.config.flight_profile_s,
+                lineage_fn=(self.attribution.snapshot
+                            if self.attribution is not None else None))
         self.registry.register_provider(self._bucket_samples)
         #   per-bucket queue depth / p99 + the compile-cache counters
         #   (dvf_compile_cache_hits_total / _misses_total,
         #   dvf_pool_evictions_total) — unprefixed provider, so the
         #   series names are fleet-wide, not per-tier
         self._draining = False       # fleet drain hook: open_stream refuses
+        self._retired_bucket_costs: Dict[str, Optional[float]] = {}
+        #   label → tick_cost_ms of buckets retired for headroom —
+        #   their measured costs must still persist at stop
+        #   (profile_dir); recorded at retirement (no I/O under the
+        #   admission lock), flushed by _persist_stage_profiles.
+        #   Keyed by label (last retirement wins), so a churning server
+        #   stays bounded by its distinct-signature count.
         self.recoveries = 0          # supervised engine rebuilds
         # Frontend-level budget = the default bucket's (fault budgets
         # attribute PER BUCKET — a broken signature's faults must not
@@ -607,8 +662,59 @@ class ServeFrontend:
         self.pool.close()
         for b in buckets:
             b.engine.free()
+        if self.config.profile_dir:
+            # Persist this run's measured per-signature stage costs
+            # (sibling of the compile cache): the next run's buckets —
+            # and the topology planner — start from MEASURED numbers.
+            self._persist_stage_profiles(buckets)
         if self._error is not None:
             raise self._error
+
+    def _persist_stage_profiles(self, live_buckets) -> None:
+        """Best-effort stage-cost persistence at stop: one profile per
+        signature measured THIS run — live buckets plus buckets retired
+        for headroom along the way (their tick costs were recorded at
+        retirement; their attribution windows survive in the plane,
+        keyed by label). Deduped by label (a re-admitted signature's
+        window must not merge twice); a live bucket's newer tick cost
+        wins over a retired record's. Never raises — profiles are
+        optimization state, not worth failing a shutdown over."""
+        with self._lock:
+            pending: Dict[str, Optional[float]] = dict(
+                self._retired_bucket_costs)
+        for b in live_buckets:
+            if b.key is None:
+                continue
+            tick = b._tick_cost_ms
+            if tick is None:
+                tick = getattr(b.engine, "step_block_ms", None)
+            pending[b.key.render()] = tick
+        for label, tick in pending.items():
+            comps: dict = {}
+            count = 0
+            if self.attribution is not None:
+                doc = self.attribution.bucket_profile_doc(label)
+                if doc is not None:
+                    comps = doc["components"]
+                    count = doc["count"]
+            if comps or tick:
+                save_stage_profile(self.config.profile_dir, label,
+                                   comps, tick_cost_ms=tick, count=count)
+
+    def _bucket_stage_cost(self, bucket: "_Bucket") -> Optional[dict]:
+        """Measured mean per-component cost for one bucket: the live
+        attribution window when lineage is running, else the persisted
+        profile from a previous run — what control-plane decisions are
+        annotated with."""
+        if self.attribution is not None:
+            live = self.attribution.bucket_stage_cost_ms(bucket.label())
+            if live:
+                return live
+        prof = bucket.stage_profile
+        if prof and prof.get("components_ms"):
+            return {k: round(float(v.get("mean_ms", 0.0)), 4)
+                    for k, v in prof["components_ms"].items()}
+        return None
 
     def __enter__(self) -> "ServeFrontend":
         return self.start()
@@ -771,9 +877,25 @@ class ServeFrontend:
             out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
         if egr is not None:
             out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        if self.attribution is not None:
+            # Frame-lineage attribution: per-component p99 over the
+            # window (attr_<component>_p99_ms) + lineage counters —
+            # the "where did my p99 go" row, scrapeable per second.
+            out.update(self.attribution.signals())
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
+
+    def explain(self, q: float = 99.0) -> dict:
+        """The latency-attribution ``explain`` surface: which components
+        the slowest frames actually spent their time in, frontend-wide
+        and per bucket — "p99 = 62% queue_bucket, 21% device, …". Empty
+        when lineage is not armed (``ServeConfig.lineage``)."""
+        if self.attribution is None:
+            return {"lineage": False,
+                    "hint": "arm ServeConfig.lineage / --lineage to "
+                            "collect frame-lineage attribution"}
+        return {"lineage": True, **self.attribution.explain(q)}
 
     def _bucket_samples(self) -> List[MetricSample]:
         """Registry provider: the per-bucket load/latency series
@@ -1035,6 +1157,9 @@ class ServeFrontend:
                 default.frame_shape = tuple(key.geometry)
                 default.frame_dtype = key.np_dtype
                 default.key = key
+                if self.config.profile_dir:
+                    default.stage_profile = load_stage_profile(
+                        self.config.profile_dir, key.render())
                 self._bucket_by_key[key] = default
                 return default, None
             if pinned == (tuple(key.geometry), key.np_dtype):
@@ -1054,6 +1179,7 @@ class ServeFrontend:
             raise ServeError(f"session id {sid!r} already exists")
         s = StreamSession(sid, cfg, sink=sink)
         s.bucket = bucket
+        s.attribution = self.attribution  # None when lineage is off
         self._sessions[sid] = s
         bucket.sessions[sid] = s
         return sid
@@ -1083,6 +1209,12 @@ class ServeFrontend:
         b = _Bucket(self.config, engine.filter, key.op_chain, engine,
                     key=key)
         b._pooled = True  # leased through self.pool by _acquire_program
+        if self.config.profile_dir:
+            # One small JSON read at bucket creation (a path that just
+            # paid a compile): a previous run's measured stage costs
+            # seed the tick-cost estimate and the control annotations.
+            b.stage_profile = load_stage_profile(
+                self.config.profile_dir, key.render())
         self._buckets.append(b)
         self._bucket_by_key[key] = b
         return b
@@ -1100,6 +1232,13 @@ class ServeFrontend:
         if bucket.key is not None:
             if self._bucket_by_key.get(bucket.key) is bucket:
                 del self._bucket_by_key[bucket.key]
+            if self.config.profile_dir:
+                # Record (no disk I/O under this lock) so stop() still
+                # persists a churned-out signature's measured costs.
+                tick = bucket._tick_cost_ms
+                if tick is None:
+                    tick = getattr(bucket.engine, "step_block_ms", None)
+                self._retired_bucket_costs[bucket.label()] = tick
             if getattr(bucket, "_pooled", False):
                 self.pool.release(bucket.key)
         a, bucket.assembler = bucket.assembler, None
@@ -1206,6 +1345,11 @@ class ServeFrontend:
                 # Highest-priority tenant tier (the resize stall-guard:
                 # a bucket hosting tier 0 never shrink-resizes).
                 "min_tier": min_tier,
+                # Measured mean per-component latency (live lineage
+                # window, else the persisted stage profile): what the
+                # controllers annotate their decisions with. None until
+                # something has been measured.
+                "stage_cost_ms": self._bucket_stage_cost(b),
             })
         s_rows = []
         for sid, s in sessions:
@@ -1666,9 +1810,13 @@ class ServeFrontend:
         key = bucket.engine.signature_key
         if key is None:
             return
+        prof = (load_stage_profile(self.config.profile_dir, key.render())
+                if self.config.profile_dir else None)
         with self._lock:
             if bucket.key is None:
                 bucket.key = key
+            if prof is not None and bucket.stage_profile is None:
+                bucket.stage_profile = prof
             self._bucket_by_key.setdefault(key, bucket)
         try:
             self.pool.adopt(key, bucket.engine)
@@ -2024,6 +2172,16 @@ class ServeFrontend:
                 q = self._inflight
                 t0 = time.time()
                 bucket = plan.bucket
+                if self.attribution is not None:
+                    # Lineage hop: bucket queue wait ends as staging
+                    # begins (one stamp per batch, fanned to the chosen
+                    # slots); the batch-level marks list then collects
+                    # assemble_h2d here and device/d2h on the collect
+                    # side — the router extends each slot's lineage.
+                    for slot in plan.slots:
+                        if slot.lin is not None:
+                            slot.lin.mark("queue_bucket", t0)
+                    plan.lin_marks = []
                 # A tick-cost sample is trustworthy only when nothing
                 # else is in flight at submit: otherwise submit→
                 # materialize includes queue wait behind OTHER batches'
@@ -2042,6 +2200,10 @@ class ServeFrontend:
                     engine = bucket.engine
                     result = (engine.submit_resident(batch)
                               if resident else engine.submit(batch))
+                    if plan.lin_marks is not None:
+                        # Batch assembly + H2D ends at submit return
+                        # (async dispatch: the device now owns the batch).
+                        plan.lin_marks.append(("assemble_h2d", time.time()))
                     # Start the D2H now — per output shard on the streamed
                     # egress path — so the collect side only waits, never
                     # initiates (runtime/egress.py).
@@ -2074,6 +2236,18 @@ class ServeFrontend:
 
     def _collect(self, gen: int = 0) -> None:
         chaos = self.config.chaos
+        block_until_ready = None
+        if self.attribution is not None:
+            # Lineage needs the device/D2H split: block_until_ready
+            # marks "device compute done, data still on device"; the
+            # fetch that follows is then pure D2H+scatter. Without
+            # lineage the fetch blocks on both at once (no extra sync).
+            try:
+                import jax
+
+                block_until_ready = jax.block_until_ready
+            except ImportError:  # pragma: no cover — jax is a hard dep
+                pass
         q = self._inflight  # generation-pinned: recovery installs a fresh
         #   queue before starting the replacement thread, so a superseded
         #   thread can never pop (and then wrongly discard) a
@@ -2098,6 +2272,13 @@ class ServeFrontend:
                     continue
                 bucket = plan.bucket
                 fetcher = bucket.fetcher if bucket is not None else None
+                if plan.lin_marks is not None and block_until_ready is not None:
+                    try:
+                        block_until_ready(result)
+                        plan.lin_marks.append(("device", time.time()))
+                    except Exception:  # noqa: BLE001 — a poisoned batch
+                        pass  # raises again in fetch below, where the
+                        #   containment ladder owns it
                 try:
                     # Streamed egress: shard host copies into the slot's
                     # preallocated slab (D2H issued at submit); fallback:
@@ -2108,6 +2289,8 @@ class ServeFrontend:
                     # later.
                     out = (fetcher.fetch(result, seq) if fetcher is not None
                            else np.asarray(result))
+                    if plan.lin_marks is not None:
+                        plan.lin_marks.append(("d2h", time.time()))
                 except Exception as e:  # noqa: BLE001 — poisoned batch
                     if self._collect_gen != gen:
                         # Superseded mid-wait: make sure the plan's
@@ -2211,6 +2394,8 @@ class ServeFrontend:
             **({"trace": {"events": len(self.tracer),
                           "dropped_total": self.tracer.dropped}}
                if self.tracer.enabled else {}),
+            **({"attribution": self.attribution.summary()}
+               if self.attribution is not None else {}),
             **({"flight": self.flight.stats()}
                if self.flight is not None else {}),
             **({"control": {
@@ -2296,6 +2481,11 @@ class ZmqStreamBridge:
         # order. Raw mode rides the same plane as zero-copy memoryviews.
         self.plane = AsyncCodecPlane(self.codec, jpeg=(wire != "raw"),
                                      depth=encode_depth)
+        # Lineage extension past delivery (lineage-armed frontends): the
+        # bridge marks encode/send on each delivery's FrameLineage and
+        # folds the wire components back into the frontend's attribution
+        # plane — "21% encode" in explain() comes from here.
+        self._attr = frontend.attribution
         self.use_jpeg = wire != "raw"
         self.raw_size = raw_size
         self.poll_ms = poll_ms
@@ -2385,6 +2575,7 @@ class ZmqStreamBridge:
                     self.plane.submit([d.frame for d in fresh], fresh)
                 for batch in self.plane.ready(
                         block=len(self.plane) > self.plane.depth):
+                    enc_t = time.time()
                     for d, payload, err in batch:
                         if err is not None:
                             self.errors += 1  # one bad frame: dropped
@@ -2393,6 +2584,9 @@ class ZmqStreamBridge:
                                   f"(dropping frame): {err!r}",
                                   file=sys.stderr)
                             continue
+                        if self._attr is not None \
+                                and d.lineage is not None:
+                            d.lineage.mark("encode", enc_t)
                         out_pending.append((d, payload))
                 while out_pending:
                     d, payload = out_pending[0]
@@ -2404,6 +2598,9 @@ class ZmqStreamBridge:
                     except self._zmq.Again:
                         break  # peer stalled: keep the tail, retry later
                     out_pending.popleft()
+                    if self._attr is not None and d.lineage is not None:
+                        d.lineage.mark("send")
+                        self._attr.observe_wire(d.lineage)
                     served += 1
                     in_send = False
                 if max_frames is not None and served >= max_frames:

@@ -206,6 +206,16 @@ class TpuZmqWorker:
         # Metrics registry for the worker's --metrics-port endpoint.
         self.registry = MetricsRegistry()
         attach_signal_provider(self.registry, "worker", self.signals)
+        # Batch-level latency attribution (obs.lineage): the worker has
+        # no per-session lineage (one stream, batch-synchronous loop),
+        # but every batch stamps its assemble_h2d/device/d2h hops into a
+        # bounded window — stats()['attribution'] + attr_* signals
+        # answer "where did the worker's latency go" the same way the
+        # serve tier's frame lineage does. Always on: four clock reads
+        # per BATCH, not per frame.
+        from dvf_tpu.obs.lineage import AttributionAggregate
+
+        self.attribution = AttributionAggregate(1024)
         self.faults = FaultStats()
         self.fault_budget = fault_budget
         self.fault_window_s = fault_window_s
@@ -545,6 +555,7 @@ class TpuZmqWorker:
             time.sleep(self.delay_s)
         result = (self.engine.submit_resident(batch) if resident
                   else self.engine.submit(batch))
+        t_sub = time.time()  # decode+assemble+H2D end / device start
         # Device-side change detection (delta wire): the per-tile
         # max-abs-diff reduction is queued right behind the filter
         # program by async dispatch; only the few-hundred-byte bitmap
@@ -566,11 +577,30 @@ class TpuZmqWorker:
         fetcher = self._fetcher_for()
         if fetcher is not None:
             fetcher.prefetch(result)
+        t_ready = None
+        try:
+            # Device/D2H attribution split: the fetch below blocks on
+            # compute AND transfer at once; this sync (which the fetch
+            # would pay anyway) marks where compute ended.
+            import jax as _jax
+
+            _jax.block_until_ready(result)
+            t_ready = time.time()
+        except Exception:  # noqa: BLE001 — attribution must never turn
+            pass           # a poisoned batch into a new failure mode
+        if fetcher is not None:
             out = fetcher.fetch(result, self._egress_seq)
         else:
             out = np.asarray(result)
         self._egress_seq += 1
         t1 = time.time()
+        comps = {"assemble_h2d": (t_sub - t0) * 1e3}
+        if t_ready is not None:
+            comps["device"] = (t_ready - t_sub) * 1e3
+            comps["d2h"] = (t1 - t_ready) * 1e3
+        else:
+            comps["device"] = (t1 - t_sub) * 1e3
+        self.attribution.observe((t1 - t0) * 1e3, comps)
         self.tracer.complete("batch_complete", t0, t1, 0,
                              frames=valid, batch=self.batches)
         plane = self._plane_for()
@@ -797,6 +827,9 @@ class TpuZmqWorker:
             out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
         if egr is not None:
             out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        attr = self.attribution.summary()
+        for comp, row in (attr.get("components") or {}).items():
+            out[f"attr_{comp}_p99_ms"] = row["p99_ms"]
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
@@ -814,6 +847,15 @@ class TpuZmqWorker:
                           "device_probe": self._probe is not None}}
                if self.wire == "delta" else {}),
             "faults": self.faults.summary(),
+            # Batch-level hop attribution (per-frame lineage is the
+            # serve tier's; encode/send costs live in "egress" below —
+            # they run asynchronously on the codec plane, so folding
+            # them into the batch's additive walls would double-count).
+            "attribution": {
+                **self.attribution.summary(),
+                **({"explain": self.attribution.explain()}
+                   if self.attribution.count else {}),
+            },
             **({"ingest": self._ingest_stats.summary()}
                if self._ingest_stats is not None else {}),
             **({"egress": self._egress_stats.summary()}

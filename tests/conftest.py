@@ -91,6 +91,12 @@ def pytest_configure(config):
                    "CPU backend, deterministic controller replay, quality "
                    "downshift/recovery, priority tiers — run in tier-1; "
                    "select with -m control)")
+    config.addinivalue_line(
+        "markers", "lineage: frame-lineage tracing & latency attribution "
+                   "tests (additive decomposition, exemplar capture, "
+                   "stage-cost profiles, trace-view — CPU backend, "
+                   "bounded wall time; run in tier-1, select with "
+                   "-m lineage)")
 
 
 @pytest.fixture(scope="session", autouse=True)

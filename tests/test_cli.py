@@ -290,3 +290,30 @@ def test_platform_flag_forces_backend(capsys, monkeypatch):
     assert calls["env"] == "cpu"
     import os
     assert os.environ.get("DVF_FORCE_PLATFORM") is None
+
+
+def test_observability_flags_consistent_across_tiers(capsys):
+    """Satellite audit pin: every CLI tier that accepts --metrics-port
+    also accepts --trace and a flight flag with the SAME spelling
+    (--flight-dir), and documents them in --help. serve doubles as the
+    single-stream pipeline tier (--sessions 1 runs Pipeline, which
+    honors --flight-dir via PipelineConfig.flight_dir)."""
+    import pytest as _pytest
+
+    for tier in ("serve", "fleet", "worker"):
+        with _pytest.raises(SystemExit) as ei:
+            main([tier, "--help"])
+        assert ei.value.code == 0
+        text = capsys.readouterr().out
+        assert "--metrics-port" in text, tier
+        assert "--trace" in text, tier
+        assert "--flight-dir" in text, tier
+
+
+def test_trace_view_in_help(capsys):
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as ei:
+        main(["--help"])
+    assert ei.value.code == 0
+    assert "trace-view" in capsys.readouterr().out
