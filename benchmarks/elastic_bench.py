@@ -1,0 +1,429 @@
+"""Elastic-fleet bench: the fleet that grows itself, measured.
+
+Three claims, one document (``benchmarks/ELASTIC_BENCH.json``):
+
+**Spawn A/B** — the warm standby pool is what makes scale-out a control
+action instead of an operator errand: ``spawn_to_first_served_frame``
+(spawn_replica() → a fresh session's first delivery off the NEW
+replica) measured with a warm standby vs a cold spawn (process fork +
+jax init + AOT compile). Acceptance: standby ≥ 10× faster.
+
+**Step-overload soak** — a fleet armed with ``--autoscale 1:N`` takes a
+step burst of session churn it cannot admit at one replica: the
+admission-refusal counters (the controller's leading signal) drive
+scale-out through the standby pool, the burst's sessions land on the
+spawned replicas, and after the burst sustained calm drains them back
+to one replica with sessions migrated gracefully. Acceptance:
+interactive-tier p99 stays within SLO through EVERY phase (pre /
+burst / post), zero hard failures (admission refusals are graceful
+shed by contract — they retry and land), the fleet demonstrably scaled
+1 → peak ≥ 2 → back to 1.
+
+**Deterministic replay** — the elastic plane records every composed
+telemetry row and every emitted action; re-running a FRESH
+``FleetElasticityController`` over the recorded rows must reproduce
+the action list byte-identically (the PR 10 controller discipline at
+fleet tier: a scaling incident is reproducible from its window).
+
+CPU-runnable; ``quick=True`` shrinks everything to seconds for the
+tier-1 schema test (local-mode replicas, loose claims — this
+hypervisor-oversubscribed CI box drifts with steal; the RATIOS and the
+replay bit are the claims, not absolute fps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+def _mk_fleet(mode, chain, shape, batch, max_sessions, slo_ms,
+              autoscale=None, standby_warm=0, elastic=None,
+              queue_size=256):
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.runtime.signature import build_filter
+    from dvf_tpu.serve import ServeConfig
+
+    serve = ServeConfig(
+        batch_size=batch, queue_size=queue_size, out_queue_size=1024,
+        slo_ms=slo_ms, max_sessions=max_sessions)
+    cfg = FleetConfig(
+        replicas=1, mode=mode,
+        filter_spec=("chain", {"specs": chain.split("|")}),
+        serve=serve, autoscale=autoscale, standby_warm=standby_warm,
+        elastic=elastic, health_poll_s=0.1,
+        precompile=[{"op_chain": chain, "frame_shape": list(shape)}],
+        startup_timeout_s=180.0)
+    filt = None if mode == "process" else build_filter(chain)
+    return FleetFrontend(filt, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Spawn A/B
+# ---------------------------------------------------------------------------
+
+
+def measure_spawn(mode, chain, shape, batch, standby: bool,
+                  timeout_s=120.0):
+    """spawn_replica() → first served frame off the NEW replica, ms."""
+    fleet = _mk_fleet(mode, chain, shape, batch, max_sessions=8,
+                      slo_ms=60_000.0, standby_warm=1 if standby else 0)
+    frame = np.zeros(shape, np.uint8)
+    with fleet:
+        # Occupy r0 so the post-spawn open places on the new replica.
+        anchor = fleet.open_stream(op_chain=chain, frame_shape=shape)
+        fleet.submit(anchor, frame)
+        if standby:
+            deadline = time.time() + timeout_s
+            while fleet.standby.warm_count < 1 \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert fleet.standby.warm_count >= 1, "standby never warmed"
+        t0 = time.perf_counter()
+        rid = fleet.spawn_replica()
+        sid = fleet.open_stream(op_chain=chain, frame_shape=shape)
+        placed = fleet.stats()["sessions"][sid]["replica"]
+        fleet.submit(sid, frame)
+        got = []
+        deadline = time.time() + timeout_s
+        while not got and time.time() < deadline:
+            got = fleet.poll(sid, meta_only=True)
+            time.sleep(0.002)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        assert got, "spawned replica never served"
+    return {"ms": dt_ms, "replica": rid, "placed_on": placed,
+            "warm": standby}
+
+
+# ---------------------------------------------------------------------------
+# Step-overload soak
+# ---------------------------------------------------------------------------
+
+
+def run_soak(mode, chain, shape, batch, *, max_sessions, slo_ms,
+             pre_s, burst_s, post_s, n_persistent, persistent_fps,
+             churn_slots, churn_fps, churn_life_s, elastic):
+    """Calm → step burst of churn → calm; autoscale 1:max under it."""
+    from dvf_tpu.serve import AdmissionError
+
+    fleet = _mk_fleet(
+        mode, chain, shape, batch, max_sessions=max_sessions,
+        slo_ms=slo_ms,
+        autoscale=(elastic.min_replicas, elastic.max_replicas),
+        standby_warm=1, elastic=elastic)
+    stop = threading.Event()
+    burst_on = threading.Event()
+    lock = threading.Lock()
+    lat = []     # (wall_t, latency_ms) — interactive tier only
+    counts = {"hard_failures": 0, "churn_opened": 0,
+              "churn_refusals": 0, "churn_delivered": 0}
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, shape, dtype=np.uint8)
+
+    def persistent(idx):
+        period = 1.0 / persistent_fps
+        try:
+            sid = fleet.open_stream(op_chain=chain, frame_shape=shape,
+                                    tier=0)
+        except Exception:  # noqa: BLE001 — interactive refused IS a
+            with lock:     # hard failure: they shed last
+                counts["hard_failures"] += 1
+            return
+        nxt = time.perf_counter()
+        try:
+            while not stop.is_set():
+                fleet.submit(sid, frame)
+                now = time.time()
+                for d in fleet.poll(sid, meta_only=True):
+                    with lock:
+                        lat.append((now, d.latency_ms))
+                nxt += period
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            fleet.close(sid, drain=True)
+            t_tail, idle = time.time() + 5.0, 0
+            while time.time() < t_tail and idle < 5:
+                got = fleet.poll(sid, meta_only=True)
+                now = time.time()
+                with lock:
+                    lat.extend((now, d.latency_ms) for d in got)
+                idle = 0 if got else idle + 1
+                time.sleep(0.02)
+        except Exception:  # noqa: BLE001 — a live interactive session
+            with lock:     # erroring is THE failure this bench rules out
+                counts["hard_failures"] += 1
+
+    def churn(slot_idx):
+        rng_s = np.random.default_rng(10_007 + slot_idx)
+        period = 1.0 / churn_fps
+        while not stop.is_set():
+            if not burst_on.is_set():
+                time.sleep(0.05)
+                continue
+            try:
+                sid = fleet.open_stream(op_chain=chain,
+                                        frame_shape=shape, tier=1)
+            except AdmissionError:
+                with lock:
+                    counts["churn_refusals"] += 1
+                time.sleep(0.15)   # graceful shed: retry after backoff
+                continue
+            except Exception:  # noqa: BLE001
+                with lock:
+                    counts["hard_failures"] += 1
+                time.sleep(0.25)
+                continue
+            with lock:
+                counts["churn_opened"] += 1
+            served = 0
+            t_end = time.time() + churn_life_s * (0.7
+                                                  + 0.6 * rng_s.random())
+            nxt = time.perf_counter()
+            try:
+                while time.time() < t_end and not stop.is_set():
+                    fleet.submit(sid, frame)
+                    served += len(fleet.poll(sid, meta_only=True))
+                    nxt += period
+                    dt = nxt - time.perf_counter()
+                    if dt > 0:
+                        time.sleep(dt)
+                fleet.close(sid, drain=True)
+            except Exception:  # noqa: BLE001
+                with lock:
+                    counts["hard_failures"] += 1
+                return
+            with lock:
+                counts["churn_delivered"] += served
+
+    with fleet:
+        threads = [threading.Thread(target=persistent, args=(i,),
+                                    daemon=True)
+                   for i in range(n_persistent)]
+        threads += [threading.Thread(target=churn, args=(i,),
+                                     daemon=True)
+                    for i in range(churn_slots)]
+        for t in threads:
+            t.start()
+        t0 = time.time()
+        time.sleep(pre_s)
+        t_burst = time.time()
+        burst_on.set()
+        time.sleep(burst_s)
+        burst_on.clear()
+        t_post = time.time()
+        # Post phase: wait out the scale-in (or the window, whichever
+        # is longer) so the committed run shows the fleet back at min.
+        deadline = time.time() + post_s
+        while time.time() < deadline:
+            if (time.time() - t_post > post_s / 2
+                    and fleet.signals()["replicas_live"]
+                    <= elastic.min_replicas):
+                break
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        sig = fleet.signals()
+        st = fleet.stats()
+        ring = fleet.telemetry.series()["rows"]
+        replay = fleet.elastic.replay_window()
+        t1 = time.time()
+
+    def phase_p(xs, a, b, q):
+        return _pct([v for t, v in xs if a <= t < b], q)
+
+    with lock:
+        lat_rows = list(lat)
+    phases = {
+        "pre": {"t0_s": 0.0, "t1_s": round(t_burst - t0, 2)},
+        "burst": {"t0_s": round(t_burst - t0, 2),
+                  "t1_s": round(t_post - t0, 2)},
+        "post": {"t0_s": round(t_post - t0, 2),
+                 "t1_s": round(t1 - t0, 2)},
+    }
+    for name, (a, b) in (("pre", (t0, t_burst)),
+                         ("burst", (t_burst, t_post)),
+                         ("post", (t_post, t1 + 1))):
+        xs = [v for t, v in lat_rows if a <= t < b]
+        phases[name].update(
+            delivered_total=len(xs),
+            interactive_p50_ms=_pct(xs, 0.50),
+            interactive_p99_ms=_pct(xs, 0.99))
+    timeline = [{"t_s": round(r["t"] - t0, 2),
+                 "replicas_live": r.get("replicas_live"),
+                 "replicas_desired": r.get("replicas_desired"),
+                 "standby_warm": r.get("standby_warm"),
+                 "admission_refusals_total":
+                     r.get("admission_refusals_total")}
+                for r in ring]
+    live_vals = [r["replicas_live"] for r in timeline
+                 if r["replicas_live"] is not None]
+    p99s = [phases[n]["interactive_p99_ms"] for n in phases
+            if phases[n]["interactive_p99_ms"] is not None]
+    return {
+        "slo_ms": slo_ms,
+        "offered": {
+            "persistent_interactive": n_persistent,
+            "persistent_fps": persistent_fps,
+            "churn_slots": churn_slots,
+            "churn_fps": churn_fps,
+            "churn_life_s": churn_life_s,
+            "max_sessions_per_replica": max_sessions,
+        },
+        "phases": phases,
+        "hard_failures_total": counts["hard_failures"],
+        "churn_opened_total": counts["churn_opened"],
+        "churn_refusals_total": counts["churn_refusals"],
+        "churn_delivered_total": counts["churn_delivered"],
+        "admission_refusals_total": int(
+            sig["admission_refusals_total"]),
+        "scale_out_total": int(sig["scale_out_total"]),
+        "scale_in_total": int(sig["scale_in_total"]),
+        "standby_adoptions_total": int(sig["standby_adoptions_total"]),
+        "replicas_peak": int(max(live_vals)) if live_vals else None,
+        "replicas_final": int(sig["replicas_live"]),
+        "migrated_sessions_total": st["migrated_sessions"],
+        "order_violations_total": st["order_violations"],
+        "interactive_p99_worst_ms": max(p99s) if p99s else None,
+        "interactive_p99_within_slo": (bool(max(p99s) <= slo_ms)
+                                       if p99s else None),
+        "timeline": timeline,
+        "_replay": replay,   # stripped before the JSON lands
+    }
+
+
+def check_replay(replay, elastic) -> dict:
+    """A FRESH controller over the recorded composed rows must emit the
+    recorded action list byte-identically."""
+    from dvf_tpu.control.fleet_elastic import FleetElasticityController
+
+    ctl = FleetElasticityController(elastic)
+    prev = None
+    replayed = []
+    for row in replay["rows"]:
+        for a in ctl.step(dict(row), prev):
+            replayed.append((a.kind, a.target, a.value, a.reason))
+        prev = row
+    recorded = [tuple(a) for a in replay["actions"]]
+    return {
+        "rows": len(replay["rows"]),
+        "actions": len(recorded),
+        "match": replayed == recorded,
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick=False):
+    import jax
+
+    from dvf_tpu.control.fleet_elastic import ElasticConfig
+
+    if quick:
+        mode, chain, shape, batch = "local", "invert", (32, 32, 3), 2
+        max_sessions, slo_ms = 3, 30_000.0
+        elastic = ElasticConfig(
+            min_replicas=1, max_replicas=3, interval_s=0.1,
+            out_after=2, out_cooldown=4, in_after=8, in_cooldown=3,
+            in_occupancy_frac=0.6)
+        soak_kw = dict(pre_s=1.5, burst_s=5.0, post_s=12.0,
+                       n_persistent=1, persistent_fps=20.0,
+                       churn_slots=4, churn_fps=10.0, churn_life_s=0.6)
+    else:
+        mode = "process"
+        # Plain registry names: the spec crosses the ProcessReplica
+        # wire as ("chain", {"specs": [...]}) — kwarg'd member specs
+        # are a build_filter affordance the registry spelling lacks.
+        chain, shape, batch = "gaussian_blur|invert", (96, 96, 3), 4
+        max_sessions, slo_ms = 4, 4_000.0
+        elastic = ElasticConfig(
+            min_replicas=1, max_replicas=3, interval_s=0.25,
+            out_after=2, out_cooldown=8, in_after=24, in_cooldown=8,
+            in_occupancy_frac=0.6)
+        soak_kw = dict(pre_s=6.0, burst_s=20.0, post_s=40.0,
+                       n_persistent=2, persistent_fps=10.0,
+                       churn_slots=8, churn_fps=8.0, churn_life_s=1.5)
+
+    cold = measure_spawn(mode, chain, shape, batch, standby=False)
+    warm = measure_spawn(mode, chain, shape, batch, standby=True)
+    ratio = (cold["ms"] / warm["ms"]) if warm["ms"] else None
+
+    soak = run_soak(mode, chain, shape, batch,
+                    max_sessions=max_sessions, slo_ms=slo_ms,
+                    elastic=elastic, **soak_kw)
+    replay = check_replay(soak.pop("_replay"), elastic)
+
+    return {
+        "schema": "dvf.elastic_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "device_count": jax.device_count(),
+        "quick": bool(quick),
+        "mode": mode,
+        "op_chain": chain,
+        "frame_shape": list(shape),
+        "batch": batch,
+        "spawn": {
+            "cold_spawn_to_first_frame_ms": round(cold["ms"], 2),
+            "standby_spawn_to_first_frame_ms": round(warm["ms"], 2),
+            "speedup_ratio": round(ratio, 2) if ratio else None,
+            "target_speedup_ratio": 10.0,
+            "cold_placed_on_spawned": cold["placed_on"] == cold["replica"],
+            "warm_placed_on_spawned": warm["placed_on"] == warm["replica"],
+        },
+        "soak": soak,
+        "replay": replay,
+    }
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    out_path = os.path.join(_HERE, "ELASTIC_BENCH.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, default=float)
+        f.write("\n")
+    sp, sk, rp = doc["spawn"], doc["soak"], doc["replay"]
+
+    def _f(x, spec=".2f"):
+        return format(x, spec) if isinstance(x, (int, float)) else "n/a"
+
+    print(f"[elastic_bench] spawn cold "
+          f"{_f(sp['cold_spawn_to_first_frame_ms'], '.0f')} ms vs "
+          f"standby {_f(sp['standby_spawn_to_first_frame_ms'], '.0f')} "
+          f"ms = {_f(sp['speedup_ratio'], '.1f')}x (target >= 10x); "
+          f"soak: scaled 1->{sk['replicas_peak']}->"
+          f"{sk['replicas_final']} "
+          f"(out {sk['scale_out_total']}, in {sk['scale_in_total']}, "
+          f"adoptions {sk['standby_adoptions_total']}), interactive "
+          f"p99 worst {_f(sk['interactive_p99_worst_ms'], '.0f')} ms "
+          f"vs SLO {_f(sk['slo_ms'], '.0f')} ms, hard failures "
+          f"{sk['hard_failures_total']}; replay match {rp['match']} "
+          f"({rp['actions']} actions over {rp['rows']} rows); "
+          f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

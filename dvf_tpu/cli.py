@@ -734,11 +734,23 @@ def cmd_fleet(args) -> int:
         lineage=args.lineage,
         profile_dir=args.profile_dir,
     )
+    autoscale = None
+    if args.autoscale:
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            autoscale = (int(lo), int(hi))
+        except ValueError:
+            raise SystemExit(
+                f"error: bad --autoscale {args.autoscale!r} "
+                f"(want MIN:MAX, e.g. 1:4)")
     config = FleetConfig(
         replicas=args.replicas,
         mode=args.mode,
         serve=serve_cfg,
         filter_spec=filter_spec,
+        autoscale=autoscale,
+        standby_warm=args.standby_warm,
+        multihost_hosts=args.multihost_hosts,
         health_poll_s=args.health_poll,
         chaos=fleet_chaos,
         chaos_spec=serve_chaos_spec,
@@ -785,15 +797,26 @@ def cmd_fleet(args) -> int:
     try:
         with fleet:
             sids = []
+            open_deadline = time.time() + 120.0
             for _ in range(n):
-                try:
-                    sids.append(fleet.open_stream(
-                        slo_ms=args.slo_ms,
-                        frame_shape=(args.height, args.width, 3),
-                        tier=args.tier))
-                except AdmissionError as e:
-                    print(f"error: admission refused: {e}", file=sys.stderr)
-                    return 2
+                while True:
+                    try:
+                        sids.append(fleet.open_stream(
+                            slo_ms=args.slo_ms,
+                            frame_shape=(args.height, args.width, 3),
+                            tier=args.tier))
+                        break
+                    except AdmissionError as e:
+                        # Under --autoscale a refusal is the controller's
+                        # scale-out SIGNAL (graceful shed by contract):
+                        # retry with backoff and land on the replica the
+                        # refusal just caused to spawn.
+                        if not args.autoscale \
+                                or time.time() > open_deadline:
+                            print(f"error: admission refused: {e}",
+                                  file=sys.stderr)
+                            return 2
+                        time.sleep(0.2)
             drivers = [
                 threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
                 for i, (sid, rate) in enumerate(zip(sids, rates))
@@ -844,6 +867,11 @@ def cmd_fleet(args) -> int:
         "faults": stats["faults"]["by_kind"],
         "faults_by_replica": stats["faults"].get("by_replica", {}),
         "recoveries": stats["recoveries"],
+        "replicas_live": stats["replicas_live"],
+        "replicas_desired": stats["replicas_desired"],
+        "standby_warm": stats["standby_warm"],
+        "scale_outs": stats["scale_outs"],
+        "scale_ins": stats["scale_ins"],
     }
     print(json.dumps(out, default=float))
     return 0
@@ -1693,6 +1721,31 @@ def main(argv=None) -> int:
     fl.add_argument("--tier", type=int, default=None,
                     help="priority tier for the demo's streams (0 "
                          "interactive, 1 standard, 2 batch; default 1)")
+    fl.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="arm controller-driven elasticity: the fleet "
+                         "grows/shrinks itself between MIN and MAX "
+                         "replicas from the merged telemetry ring "
+                         "(admission-refusal rate, per-replica "
+                         "occupancy/queue, shed and SLO-miss counters). "
+                         "Scale-out adopts from the warm standby pool "
+                         "when one is armed; scale-in drains and "
+                         "migrates sessions before terminating. "
+                         "--replicas (clamped into the bounds) is the "
+                         "starting count")
+    fl.add_argument("--standby-warm", type=int, default=0,
+                    help="warm standby pool size: replicas pre-spawned "
+                         "and AOT-precompiled (via --precompile + the "
+                         "persistent compile cache) so a scale-out is "
+                         "session-rebind time, not a cold spawn; a "
+                         "background thread refills taken standbys")
+    fl.add_argument("--multihost-hosts", type=int, default=0,
+                    help=">=2 arms the bigger-replica scaling axis: "
+                         "scale-outs may spawn ONE replica spanning "
+                         "this many jax.distributed processes (one "
+                         "pjit program across the group), pinned to "
+                         "the first --precompile manifest signature; "
+                         "the elasticity controller chooses the axis "
+                         "from measured --profile-dir stage costs")
 
     cp = sub.add_parser(
         "camera",  # host-only (no jax): the --platform flag would be a no-op

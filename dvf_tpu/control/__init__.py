@@ -25,6 +25,13 @@ from dvf_tpu.control.controllers import (
     TierAdmissionController,
     is_pressure,
 )
+from dvf_tpu.control.fleet_elastic import (
+    FLAVOR_DEFAULT,
+    FLAVOR_MULTIHOST,
+    ElasticConfig,
+    FleetElasticityController,
+    fleet_pressure,
+)
 from dvf_tpu.control.plane import ControlPlane
 
 __all__ = [
@@ -32,11 +39,16 @@ __all__ = [
     "BatchTickController",
     "ControlConfig",
     "ControlPlane",
+    "ElasticConfig",
+    "FLAVOR_DEFAULT",
+    "FLAVOR_MULTIHOST",
+    "FleetElasticityController",
     "QualityController",
     "TierAdmissionController",
     "TIER_BATCH",
     "TIER_INTERACTIVE",
     "TIER_NAMES",
     "TIER_STANDARD",
+    "fleet_pressure",
     "is_pressure",
 ]

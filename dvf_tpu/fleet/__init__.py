@@ -11,6 +11,12 @@ per-host ingest/egress shards feeding one pjit program.
 """
 
 from dvf_tpu.fleet.admission import SpilloverAdmission
+from dvf_tpu.fleet.elastic import (
+    ElasticFleetPlane,
+    StandbyPool,
+    live_standby_handles,
+)
+from dvf_tpu.fleet.multihost import MultiHostReplica
 from dvf_tpu.fleet.multiproc import MultiHostEngine
 from dvf_tpu.fleet.replica import (
     DEAD,
@@ -27,15 +33,19 @@ from dvf_tpu.fleet.router import FLEET_MODES, FleetConfig, FleetFrontend
 __all__ = [
     "DEAD",
     "DRAINING",
+    "ElasticFleetPlane",
     "FLEET_MODES",
     "FleetConfig",
     "FleetFrontend",
     "HEALTHY",
     "LocalReplica",
     "MultiHostEngine",
+    "MultiHostReplica",
     "ProcessReplica",
     "RESTARTING",
     "ReplicaHandle",
     "ReplicaLostError",
     "SpilloverAdmission",
+    "StandbyPool",
+    "live_standby_handles",
 ]

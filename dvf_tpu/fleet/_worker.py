@@ -139,15 +139,20 @@ def main(argv=None) -> int:
                     out = frontend.release(op[1])
                 elif kind == "drain":
                     out = frontend.drain(timeout=op[1])
+                elif kind == "begin_drain":
+                    out = frontend.begin_drain()
                 elif kind == "health":
                     import time as _time
 
                     # wall_time_s: the parent's clock-offset probe for
                     # per-frame lineage re-basing (ProcessReplica.health
-                    # estimates offset from the RPC midpoint).
+                    # estimates offset from the RPC midpoint). load: the
+                    # cheap per-replica load row the fleet monitor
+                    # caches for its elastic view.
                     out = dict(frontend.health(),
                                submit_errors=submit_errors,
-                               wall_time_s=_time.time())
+                               wall_time_s=_time.time(),
+                               load=frontend.load_row())
                 elif kind == "stats":
                     out = {"stats": frontend.stats(),
                            "latency": frontend.latency_snapshot(),

@@ -45,6 +45,11 @@ class SpilloverAdmission:
         self.warm_placements = 0  # opens routed by signature warmth
         self.tier_rejections = 0  # low-tier opens refused by the fleet
         #   capacity guard (graceful shed, not a failure)
+        self.rejections_by_tier: Dict[int, int] = {}  # every fleet-level
+        #   refusal keyed by the refused open's tier — the elasticity
+        #   controller's key input was previously visible only in
+        #   rejection STRINGS; these counters put it on the telemetry
+        #   ring (fleet signals() flattens them per tier name)
 
     def candidates(
         self,
@@ -102,13 +107,18 @@ class SpilloverAdmission:
         with self._lock:
             self.spillovers += n
 
-    def record_rejection(self) -> None:
+    def record_rejection(self, tier: Optional[int] = None) -> None:
         with self._lock:
             self.rejections += 1
+            if tier is not None:
+                t = int(tier)
+                self.rejections_by_tier[t] = (
+                    self.rejections_by_tier.get(t, 0) + 1)
 
     def stats(self) -> dict:
         with self._lock:
             return {"spillovers": self.spillovers,
                     "rejections": self.rejections,
                     "warm_placements": self.warm_placements,
-                    "tier_rejections": self.tier_rejections}
+                    "tier_rejections": self.tier_rejections,
+                    "rejections_by_tier": dict(self.rejections_by_tier)}

@@ -164,7 +164,17 @@ class ReplicaHandle:
     def drain(self, timeout: float = 30.0) -> bool:
         raise NotImplementedError
 
+    def begin_drain(self) -> None:
+        """Replica-side admission off (``ServeFrontend.begin_drain``):
+        the first half of a graceful retire — the fleet stops placing
+        there anyway (state flips out of HEALTHY), but the replica's own
+        gate closing too means a raced direct open cannot slip in."""
+        raise NotImplementedError
+
     def health(self) -> dict:
+        """Liveness + the replica's cheap ``load`` row (queue depth,
+        occupancy, monotone counters, p99 — ``ServeFrontend.load_row``):
+        what the fleet monitor caches for the RPC-free elastic view."""
         raise NotImplementedError
 
     def stats_full(self) -> dict:
@@ -259,8 +269,12 @@ class LocalReplica(ReplicaHandle):
     def drain(self, timeout: float = 30.0) -> bool:
         return self._fe().drain(timeout=timeout)
 
+    def begin_drain(self) -> None:
+        self._fe().begin_drain()
+
     def health(self) -> dict:
-        return self._fe().health()
+        fe = self._fe()
+        return dict(fe.health(), load=fe.load_row())
 
     def stats_full(self) -> dict:
         fe = self._fe()
@@ -317,6 +331,28 @@ class ProcessReplica(ReplicaHandle):
             env.update(self._env)
         return env
 
+    def _launch(self, port: int) -> subprocess.Popen:
+        """Spawn the worker process(es); returns the one that dials the
+        parent RPC listener. The seam the multi-host flavor overrides
+        (`fleet.multihost.MultiHostReplica` spawns a whole
+        jax.distributed group and returns its leader)."""
+        return subprocess.Popen(
+            [sys.executable, "-m", "dvf_tpu.fleet._worker",
+             "--port", str(port), "--replica-id", self.id],
+            env=self._child_env(),
+            stdout=subprocess.DEVNULL,
+            stderr=(None
+                    if os.environ.get("DVF_FLEET_WORKER_STDERR") == "1"
+                    else subprocess.DEVNULL),
+            # close_fds=False keeps posix_spawn eligible: a restart
+            # from a large parent (a loaded test suite, a long-lived
+            # server) must not have to FORK the whole address space
+            # just to exec a worker — observed as transient respawn
+            # failures under memory pressure. The worker dials its
+            # own socket and ignores inherited fds.
+            close_fds=False,
+        )
+
     def start(self) -> "ProcessReplica":
         listener = socket.socket()
         try:
@@ -324,22 +360,7 @@ class ProcessReplica(ReplicaHandle):
             listener.listen(1)
             listener.settimeout(self._startup_timeout_s)
             port = listener.getsockname()[1]
-            self._proc = subprocess.Popen(
-                [sys.executable, "-m", "dvf_tpu.fleet._worker",
-                 "--port", str(port), "--replica-id", self.id],
-                env=self._child_env(),
-                stdout=subprocess.DEVNULL,
-                stderr=(None
-                        if os.environ.get("DVF_FLEET_WORKER_STDERR") == "1"
-                        else subprocess.DEVNULL),
-                # close_fds=False keeps posix_spawn eligible: a restart
-                # from a large parent (a loaded test suite, a long-lived
-                # server) must not have to FORK the whole address space
-                # just to exec a worker — observed as transient respawn
-                # failures under memory pressure. The worker dials its
-                # own socket and ignores inherited fds.
-                close_fds=False,
-            )
+            self._proc = self._launch(port)
             _LIVE_PROCS.add(self._proc)
             try:
                 self._sock, _ = listener.accept()
@@ -500,6 +521,9 @@ class ProcessReplica(ReplicaHandle):
 
     def drain(self, timeout: float = 30.0) -> bool:
         return self._rpc(("drain", timeout), timeout=timeout + 10.0)
+
+    def begin_drain(self) -> None:
+        self._rpc(("begin_drain",), timeout=5.0, lock_timeout=5.0)
 
     def health(self) -> dict:
         # Short timeouts on BOTH the socket and the channel lock: the

@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dvf_tpu.control.controllers import TIER_BATCH
+from dvf_tpu.control.controllers import TIER_BATCH, TIER_NAMES
 from dvf_tpu.fleet.admission import SpilloverAdmission
 from dvf_tpu.fleet.replica import (
     DEAD,
@@ -143,6 +143,27 @@ class FleetConfig:
     #   compiles these at start — and again at RESPAWN, where the
     #   persistent compilation cache turns it into deserializes — so
     #   each signature's first real admission fleet-wide is a pool hit
+    autoscale: Optional[Tuple[int, int]] = None  # (min, max) replicas:
+    #   arms the elasticity loop (CLI --autoscale min:max) — a
+    #   FleetElasticityController over the fleet telemetry ring drives
+    #   spawn_replica()/retire_replica() between these bounds. The
+    #   initial replica count is ``replicas`` clamped into the bounds.
+    #   None = the fleet stays at ``replicas`` unless told otherwise.
+    elastic: Any = None           # control.fleet_elastic.ElasticConfig
+    #   overriding the controller knobs (min/max still come from
+    #   ``autoscale`` when both are set); None = defaults
+    standby_warm: int = 0         # warm standby pool size: replicas
+    #   pre-spawned and AOT-precompiled (fleet.elastic.StandbyPool) so
+    #   a scale-out is session-rebind time, not a cold spawn. Works
+    #   with or without autoscale (manual spawn_replica() takes from
+    #   the pool too). 0 = no pool, spawns are cold.
+    multihost_hosts: int = 0      # >= 2 arms the BIGGER-replica axis:
+    #   a spawn_replica(flavor="multihost") builds one replica whose
+    #   worker is a MultiHostEngine process group of this many hosts
+    #   (jax.distributed, one pjit program across the group's devices),
+    #   pinned to the first --precompile manifest signature (the group
+    #   compiles ONE program — the manifest names it). 0 = the
+    #   controller's two-axis choice always picks more-replicas.
 
 
 class _FleetSession:
@@ -213,14 +234,25 @@ class FleetFrontend:
         self.orphaned_sessions = 0
         self.order_violations = 0         # should stay 0: the affinity +
         #   migration protocol guarantees per-session index monotonicity
+        self.scale_outs = 0               # applied spawn_replica calls
+        self.scale_ins = 0                # applied retire_replica calls
+        self.standby_adoptions = 0        # scale-outs served warm (the
+        #   standby pool had a pre-spawned replica ready)
         self._replicas: "Dict[str, ReplicaHandle]" = {}
         self._load: Dict[str, int] = {}
+        self._replica_load: Dict[str, dict] = {}  # per-replica load rows
+        #   (ServeFrontend.load_row via the health RPC), cached by the
+        #   monitor so signals()/elastic_view() stay RPC-free
+        self._retiring: set = set()       # replica ids mid-retire (the
+        #   scale-in path owns their lifecycle; the loss monitor must
+        #   not race a second drain/restart onto them)
         self._sessions: Dict[str, _FleetSession] = {}
         self._retired: Dict[str, _FleetSession] = {}
         self._ids = itertools.count()
         self._lock = threading.Lock()       # session/load registries
         self._open_lock = threading.Lock()  # serializes placements
         self._loss_lock = threading.Lock()  # serializes loss handling
+        self._scale_lock = threading.Lock()  # serializes spawn/retire
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -234,15 +266,50 @@ class FleetFrontend:
                              process_name="fleet")
         self.registry = MetricsRegistry()
         attach_fleet_provider(self.registry, self)
+        # -- elasticity plane (ISSUE 12): controller + standby pool. The
+        # plane must exist before the ring so the ring's on_sample hook
+        # can point at it; an armed autoscale implies the ring (the
+        # controller is blind without a window), at the elastic cadence
+        # unless something armed a faster one already.
+        self.desired = self.config.replicas
+        self.elastic = None
+        elastic_cfg = None
+        if self.config.autoscale is not None:
+            from dvf_tpu.control.fleet_elastic import ElasticConfig
+            from dvf_tpu.fleet.elastic import ElasticFleetPlane
+
+            lo, hi = (int(self.config.autoscale[0]),
+                      int(self.config.autoscale[1]))
+            if not 1 <= lo <= hi:
+                raise ValueError(
+                    f"autoscale bounds must satisfy 1 <= min <= max, "
+                    f"got {self.config.autoscale!r}")
+            base = self.config.elastic or ElasticConfig()
+            elastic_cfg = dataclasses.replace(
+                base, min_replicas=lo, max_replicas=hi)
+            self.desired = min(max(self.config.replicas, lo), hi)
+            self.elastic = ElasticFleetPlane(self, elastic_cfg)
         self.telemetry: Optional[TimeSeriesRing] = None
         sample_s = self.config.telemetry_sample_s or (
             1.0 if self.config.flight_dir else 0.0)  # serve's rule: an
         #   armed flight recorder implies the window it dumps
+        if elastic_cfg is not None:
+            # The controller's sample-count knobs (out_after, in_after,
+            # cooldowns) assume its cadence: a slower ring (the flight
+            # recorder's 1 Hz default) would silently rescale them all,
+            # so the elastic interval puts a CEILING on the period. An
+            # explicitly faster telemetry_sample_s stays (documented on
+            # ElasticConfig.interval_s — one ring, fastest consumer
+            # wins).
+            sample_s = (elastic_cfg.interval_s if sample_s <= 0
+                        else min(sample_s, elastic_cfg.interval_s))
         if sample_s > 0:
             self.telemetry = TimeSeriesRing(
                 self.signals,
                 interval_s=sample_s,
-                name="dvf-fleet-telemetry")
+                name="dvf-fleet-telemetry",
+                on_sample=(self.elastic.on_sample
+                           if self.elastic is not None else None))
         self.flight: Optional[FlightRecorder] = None
         if self.config.flight_dir:
             self.flight = FlightRecorder(
@@ -276,10 +343,58 @@ class FleetFrontend:
         self._explain_cache_t = float("-inf")
         self._explain_cache_lock = threading.Lock()
         self._explain_refresh_lock = threading.Lock()
-        for i in range(self.config.replicas):
+        for i in range(self.desired):
             rid = f"r{i}"
             self._replicas[rid] = self._make_replica(rid, i)
             self._load[rid] = 0
+        self._rid_counter = itertools.count(self.desired)
+        # Warm standby pool: pre-spawned AOT-warm replicas so a
+        # scale-out is adoption, not a cold spawn (fleet.elastic).
+        self.standby = None
+        if self.config.standby_warm > 0:
+            from dvf_tpu.fleet.elastic import StandbyPool
+
+            self.standby = StandbyPool(self._spawn_standby,
+                                       warm_target=self.config.standby_warm)
+        # Two-axis inputs, loaded ONCE at construction (the controller
+        # is deterministic — no file reads inside the decision loop):
+        # the dominant signature the multihost flavor would pin to (the
+        # first --precompile manifest entry) and its measured device
+        # cost from the PR 11 stage profiles (--profile-dir).
+        self._multihost_key = None
+        self._profile_device_ms: Optional[float] = None
+        if self.config.precompile:
+            try:
+                from dvf_tpu.runtime.signature import parse_manifest
+
+                entries = parse_manifest(self.config.precompile)
+            except (ValueError, TypeError):
+                entries = []
+            if entries and self.config.multihost_hosts >= 2:
+                self._multihost_key = entries[0]["key"]
+            if entries and self.config.serve.profile_dir:
+                from dvf_tpu.obs.lineage import load_stage_profile
+
+                device_ms = []
+                for e in entries:
+                    prof = load_stage_profile(
+                        self.config.serve.profile_dir, e["key"].render())
+                    comp = ((prof or {}).get("components_ms")
+                            or {}).get("device") or {}
+                    if comp.get("mean_ms") is not None:
+                        device_ms.append(float(comp["mean_ms"]))
+                if device_ms:
+                    self._profile_device_ms = max(device_ms)
+
+    def _next_rid(self) -> str:
+        return f"r{next(self._rid_counter)}"
+
+    def _spawn_standby(self) -> ReplicaHandle:
+        """StandbyPool's spawn hook: allocate the next replica id and
+        build an UNSTARTED default-flavor handle (the pool's refill
+        thread pays the start + precompile)."""
+        rid = self._next_rid()
+        return self._make_replica(rid, int(rid[1:]))
 
     # -- replica construction -------------------------------------------
 
@@ -377,6 +492,10 @@ class FleetFrontend:
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="dvf-fleet-health", daemon=True)
         self._monitor.start()
+        if self.standby is not None:
+            self.standby.start()
+        if self.elastic is not None:
+            self.elastic.start()
         if self.telemetry is not None:
             self.telemetry.start()
         return self
@@ -386,12 +505,24 @@ class FleetFrontend:
         self._wake.set()
         if self.telemetry is not None:
             self.telemetry.stop()
+        if self.elastic is not None:
+            self.elastic.stop()
         if self._monitor is not None:
             self._monitor.join(timeout=timeout)
             self._monitor = None
-        threads = [threading.Thread(target=r.stop, args=(timeout,),
-                                    name=f"dvf-fleet-stop-{r.id}")
-                   for r in self._replicas.values()]
+        if self.standby is not None:
+            # Before the serving replicas: a standby outliving the
+            # fleet is a leaked child (the conftest guard's contract).
+            self.standby.stop(timeout=timeout)
+        with self._scale_lock:
+            # Exclude an in-flight spawn/retire: spawn_replica holds
+            # this lock across its stop-check + insert, so by the time
+            # we snapshot, the spawn either aborted on _stop or its
+            # replica is in the dict for the sweep — no worker can
+            # slip in between snapshot and join and outlive shutdown.
+            threads = [threading.Thread(target=r.stop, args=(timeout,),
+                                        name=f"dvf-fleet-stop-{r.id}")
+                       for r in list(self._replicas.values())]
         for t in threads:
             t.start()
         for t in threads:
@@ -438,13 +569,18 @@ class FleetFrontend:
                 # Tier-aware capacity guard: refuse batch tier while the
                 # fleet is near capacity — the remaining slots are
                 # reserved headroom for higher-priority arrivals.
-                healthy = sum(1 for r in self._replicas.values()
+                # list() snapshot: the elastic apply thread inserts/pops
+                # replicas concurrently (one C-level call, GIL-atomic —
+                # a bare generator over .values() would raise mid-scan).
+                healthy = sum(1 for r in list(self._replicas.values())
                               if r.state == HEALTHY)
                 cap = healthy * self.config.serve.max_sessions
                 if cap and sum(load.values()) >= \
                         self.config.tier_guard_frac * cap:
                     self.admission.record_tier_rejection()
-                    self.admission.record_rejection()
+                    self.admission.record_rejection(
+                        tier=tier if tier is not None
+                        else self.config.serve.default_tier)
                     raise AdmissionError(
                         f"tier {tier} not admitted: fleet at "
                         f"{sum(load.values())}/{cap} bound sessions "
@@ -455,7 +591,9 @@ class FleetFrontend:
                 list(self._replicas.values()), load,
                 warm=warm, key=key_render, prefer_packed=low_tier)
             if not cands:
-                self.admission.record_rejection()
+                self.admission.record_rejection(
+                    tier=tier if tier is not None
+                    else self.config.serve.default_tier)
                 raise AdmissionError("no healthy replicas in the fleet")
             hops = 0
             last_refusal: Optional[AdmissionError] = None
@@ -501,7 +639,9 @@ class FleetFrontend:
                     # handing the client a permanently stranded sid.
                     self._migrate(s, r, reachable=False)
                 return sid
-            self.admission.record_rejection()
+            self.admission.record_rejection(
+                tier=tier if tier is not None
+                else self.config.serve.default_tier)
             raise AdmissionError(
                 f"every healthy replica refused this stream "
                 f"({len(cands)} tried; last refusal: {last_refusal}); "
@@ -555,7 +695,13 @@ class FleetFrontend:
                 # frames forever.
                 s.frame_shape = tuple(frame.shape)
                 s.frame_dtype = frame.dtype
-            r = self._replicas[s.replica_id]
+            r = self._replicas.get(s.replica_id)
+            if r is None:
+                # Binding raced a replica removal (scale-in edge): the
+                # frame is dropped at-most-once; the next submit sees
+                # the migrated binding.
+                s.lost += 1
+                return idx
             try:
                 r.submit(s.replica_sid, frame, ts=ts, tag=(idx, tag))
             except ReplicaLostError as e:
@@ -587,14 +733,19 @@ class FleetFrontend:
             want = None if max_items is None else max_items - len(out)
             if want is None or want > 0:
                 if not s.orphaned:
-                    r = self._replicas[s.replica_id]
-                    try:
-                        got = r.poll(s.replica_sid, want,
-                                     meta_only=meta_only)
-                    except (ReplicaLostError, KeyError) as e:
-                        if isinstance(e, ReplicaLostError):
-                            self._note_loss(r, e)
-                        got = []
+                    # .get: a retired session may outlive its replica
+                    # (scale-in removed it) — its salvaged tail above is
+                    # all there is.
+                    r = self._replicas.get(s.replica_id)
+                    got = []
+                    if r is not None:
+                        try:
+                            got = r.poll(s.replica_sid, want,
+                                         meta_only=meta_only)
+                        except (ReplicaLostError, KeyError) as e:
+                            if isinstance(e, ReplicaLostError):
+                                self._note_loss(r, e)
+                            got = []
                     out.extend(self._map_deliveries(s, got, replica=r))
             for d in out:
                 if d.index <= s.last_index:
@@ -640,12 +791,13 @@ class FleetFrontend:
             s.closed = True
             self._uncount_load(s)
             if not s.orphaned:
-                r = self._replicas[s.replica_id]
-                try:
-                    r.close(s.replica_sid, drain=drain)
-                except (ReplicaLostError, KeyError) as e:
-                    if isinstance(e, ReplicaLostError):
-                        self._note_loss(r, e)
+                r = self._replicas.get(s.replica_id)
+                if r is not None:
+                    try:
+                        r.close(s.replica_sid, drain=drain)
+                    except (ReplicaLostError, KeyError) as e:
+                        if isinstance(e, ReplicaLostError):
+                            self._note_loss(r, e)
         self._retire(session_id, s)
 
     def _retire(self, session_id: str, s: _FleetSession) -> None:
@@ -672,11 +824,12 @@ class FleetFrontend:
                     f"session {session_id!r} is still open; close() first")
             s.tail.clear()
             if not s.orphaned:
-                r = self._replicas[s.replica_id]
-                try:
-                    r.release(s.replica_sid)
-                except (ReplicaLostError, KeyError, ServeError):
-                    pass
+                r = self._replicas.get(s.replica_id)
+                if r is not None:
+                    try:
+                        r.release(s.replica_sid)
+                    except (ReplicaLostError, KeyError, ServeError):
+                        pass
 
     def open_count(self) -> int:
         with self._lock:
@@ -718,8 +871,10 @@ class FleetFrontend:
             for r in list(self._replicas.values()):
                 if self._stop.is_set():
                     return
-                if r.state in (RESTARTING, DEAD):
-                    continue
+                if r.state in (RESTARTING, DEAD) or r.id in self._retiring:
+                    continue  # a mid-retire replica's lifecycle belongs
+                    #   to retire_replica (its death there is at-most-
+                    #   once salvage, not a loss to re-handle)
                 if chaos is not None:
                     try:
                         chaos.fire("replica")
@@ -754,6 +909,13 @@ class FleetFrontend:
                 if warm is not None:
                     with self._lock:
                         self._warm[r.id] = list(warm)
+                # Cache the replica's cheap load row: what keeps the
+                # fleet signals()/elastic_view() RPC-free — the
+                # elasticity controller reads THIS, one health poll old.
+                load_row = h.get("load")
+                if isinstance(load_row, dict):
+                    with self._lock:
+                        self._replica_load[r.id] = load_row
                 # Replica-side watchdog trips surface in the health
                 # export's stalls counter; a rising watermark is the
                 # fleet-level flight trigger — the replica recovered on
@@ -774,6 +936,8 @@ class FleetFrontend:
         of HEALTHY, so admission skips it), migrate or close its
         sessions, then restart and rejoin within the restart budget."""
         with self._loss_lock:
+            if r.id in self._retiring:
+                return  # scale-in owns this replica's teardown
             if r.state not in (HEALTHY, DRAINING):
                 return  # already handled (or permanently dead)
             r.state = DRAINING
@@ -814,6 +978,7 @@ class FleetFrontend:
                         self._stalls_seen.pop(r.id, None)
                         with self._lock:
                             self._delivered_seen.pop(r.id, None)
+                            self._replica_load.pop(r.id, None)
                             # Fresh frontend, empty pool: nothing is
                             # warm there until health says otherwise.
                             self._warm.pop(r.id, None)
@@ -846,13 +1011,23 @@ class FleetFrontend:
             return list(self._sessions.values())
 
     def _migrate(self, s: _FleetSession, old: ReplicaHandle,
-                 reachable: bool) -> None:
+                 reachable: bool, graceful: bool = False) -> None:
         """Move one session off a lost/draining replica. Monotonicity
         argument: the binding swaps under ``s.lock``, the same lock every
         submit/poll holds for its whole replica round-trip — so the tail
         salvage below sees everything the old replica will ever deliver
         for this session, and every frame submitted after the swap
-        carries a fleet index larger than anything salvaged."""
+        carries a fleet index larger than anything salvaged.
+
+        ``graceful`` is the scale-in variant (retire_replica): the
+        replica is HEALTHY and draining by choice, so the session
+        closes with ``drain=True`` (queued + in-flight frames still
+        serve) and the salvage POLLS UNTIL QUIET instead of one shot —
+        zero frame loss on the happy path. The client's submit blocks
+        on ``s.lock`` for the drain window (backpressure, not loss); a
+        replica that dies mid-drain degrades to the loss path's
+        at-most-once salvage (the SIGKILL-during-scale-in chaos test
+        pins exactly this)."""
         with s.lock:
             if s.closed or s.orphaned or s.replica_id != old.id:
                 return
@@ -863,9 +1038,31 @@ class FleetFrontend:
             # replica whose ENGINE failed still serves its out-queues
             # (a dead process replica just raises immediately here).
             try:
-                old.close(s.replica_sid, drain=False)
+                old.close(s.replica_sid, drain=graceful)
             except Exception:  # noqa: BLE001 — salvage best-effort
                 pass
+            if graceful:
+                # Drain-to-quiet: keep polling while the retiring
+                # replica serves the session's queued tail; stop after
+                # a quiet window (nothing new for a few probes) or the
+                # drain budget. All under s.lock — the survivor's
+                # deliveries cannot interleave ahead of the tail, so
+                # per-session index monotonicity is preserved by
+                # construction.
+                deadline = time.monotonic() + self.config.drain_timeout_s
+                idle = 0
+                while time.monotonic() < deadline and idle < 5:
+                    try:
+                        got = old.poll(s.replica_sid, None)
+                    except Exception:  # noqa: BLE001 — died mid-drain:
+                        break          # at-most-once from here on
+                    if got:
+                        s.tail.extend(self._map_deliveries(
+                            s, got, replica=old))
+                        idle = 0
+                    else:
+                        idle += 1
+                        time.sleep(0.02)
             try:
                 s.tail.extend(self._map_deliveries(
                     s, old.poll(s.replica_sid, None), replica=old))
@@ -914,6 +1111,214 @@ class FleetFrontend:
             self._uncount_load(s)
         if orphan:
             self._retire(s.sid, s)
+
+    # -- elasticity actuator seams (control.fleet_elastic) ----------------
+    # The ElasticFleetPlane's apply thread calls these; manual callers
+    # (benches, an operator REPL) get the same semantics. Spawn/retire
+    # serialize on _scale_lock — elasticity is a slow loop by design and
+    # two concurrent scale actions would race the registries.
+
+    def set_desired_replicas(self, n: int) -> None:
+        """Record scale INTENT (the elastic plane calls this at action
+        enqueue, before the spawn/retire lands): the controller reads
+        ``replicas_desired`` next sample and must see its own pending
+        action instead of double-firing into the apply gap."""
+        with self._lock:
+            self.desired = max(1, int(n))
+
+    def rollback_desired(self, delta: int) -> None:
+        """Undo intent after a failed apply (spawn raised / retire
+        refused), so the controller may re-decide on a later window."""
+        with self._lock:
+            self.desired = max(1, self.desired + delta)
+
+    def spawn_replica(self, flavor: Optional[str] = None) -> str:
+        """Scale out by one replica; returns its id. Default flavor
+        takes a WARM STANDBY when the pool has one (adoption: a dict
+        insert — the spawn-to-first-served-frame time the elastic bench
+        measures) and cold-spawns otherwise (seconds: fork + jax init +
+        precompile; this call blocks for it, which is why the elastic
+        plane applies off-thread). ``flavor="multihost"`` builds the
+        BIGGER-replica shape instead: a MultiHostEngine process group
+        (``FleetConfig.multihost_hosts`` hosts, one pjit program) pinned
+        to the first precompile-manifest signature — falls back to the
+        default flavor when the multihost leg is not configured."""
+        with self._scale_lock:
+            if self._stop.is_set():
+                raise ServeError("fleet is stopping: no scale-out")
+            warm = False
+            if flavor == "multihost" and self._multihost_key is not None:
+                rid = self._next_rid()
+                h = self._make_multihost_replica(rid)
+                h.start()
+            else:
+                h = self.standby.take() if self.standby is not None else None
+                if h is not None:
+                    rid = h.id
+                    warm = True
+                else:
+                    rid = self._next_rid()
+                    h = self._make_replica(rid, int(rid[1:]))
+                    h.start()
+            if self._stop.is_set():
+                # stop() ran while the (seconds-long cold) spawn was in
+                # flight: its replica sweep snapshotted _replicas before
+                # this insert, so adopting now would leak a live worker
+                # past shutdown — tear it down here instead.
+                try:
+                    h.stop(timeout=10.0)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                raise ServeError("fleet stopped during spawn")
+            with self._lock:
+                self._replicas[rid] = h
+                self._load.setdefault(rid, 0)
+            # Seed the placement map NOW (one health probe at adoption):
+            # a precompiled standby is warm for the manifest signatures,
+            # and the very next open should route onto the fresh replica
+            # instead of waiting a health-poll period to learn that.
+            try:
+                warm_sigs = (h.health() or {}).get("warm_signatures")
+                if warm_sigs:
+                    with self._lock:
+                        self._warm[rid] = list(warm_sigs)
+            except Exception:  # noqa: BLE001 — the monitor converges it
+                pass
+            self.scale_outs += 1
+            if warm:
+                self.standby_adoptions += 1
+            with self._lock:
+                self.desired = max(self.desired, self._live_count_locked())
+            self.tracer.instant("scale_out", track=0, replica=rid,
+                                warm=warm, flavor=flavor or "default")
+            self._wake.set()  # monitor: learn its warm signatures now
+            return rid
+
+    def _live_count_locked(self) -> int:
+        return sum(1 for r in self._replicas.values() if r.state != DEAD)
+
+    def _make_multihost_replica(self, rid: str):
+        from dvf_tpu.fleet.multihost import MultiHostReplica
+
+        key = self._multihost_key
+        if key is None:
+            raise ServeError(
+                "multihost flavor needs multihost_hosts >= 2 and a "
+                "--precompile manifest naming the signature the group "
+                "compiles")
+        return MultiHostReplica(
+            rid,
+            op_chain=key.op_chain,
+            frame_shape=tuple(key.geometry),
+            frame_dtype=str(key.np_dtype),
+            hosts=self.config.multihost_hosts,
+            batch_size=self.config.serve.batch_size,
+            slo_ms=self.config.serve.slo_ms,
+            queue_size=self.config.serve.queue_size,
+            out_queue_size=self.config.serve.out_queue_size,
+            startup_timeout_s=self.config.startup_timeout_s,
+            rpc_timeout_s=self.config.rpc_timeout_s,
+        )
+
+    def retire_replica(self, rid: str) -> bool:
+        """Scale in by draining one replica: admission off (state flips
+        to DRAINING + replica-side ``begin_drain``), every bound session
+        gracefully migrated to a survivor (drain-to-quiet salvage, then
+        rebind — affinity and the fleet index space survive, exactly
+        the loss path's machinery minus the loss), then terminate and
+        forget the replica. False = no such healthy replica (it died,
+        retired, or was never there — the controller re-decides on a
+        later window)."""
+        with self._scale_lock:
+            with self._loss_lock:
+                r = self._replicas.get(rid)
+                if r is None or r.state != HEALTHY:
+                    return False
+                self._retiring.add(rid)
+                r.state = DRAINING
+            try:
+                try:
+                    r.begin_drain()
+                except Exception:  # noqa: BLE001 — a dead/busy replica
+                    pass           # drains via migration regardless
+                with self._open_lock:
+                    # Placement barrier: an open holds this lock from
+                    # candidate pick through fleet-side registration,
+                    # so once we pass it, every open that chose this
+                    # (then-HEALTHY) replica is registered and lands in
+                    # the snapshot below; later opens see DRAINING and
+                    # place elsewhere. (The post-registration
+                    # incarnation check in open_stream covers the same
+                    # window for the LOSS path — this makes the retire
+                    # argument local.)
+                    pass
+                bound = [s for s in self._snapshot_sessions()
+                         if s.replica_id == rid and not s.orphaned]
+                for s in bound:
+                    self._migrate(s, r, reachable=True, graceful=True)
+                try:
+                    r.stop(timeout=self.config.drain_timeout_s)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                with self._lock:
+                    self._replicas.pop(rid, None)
+                    self._load.pop(rid, None)
+                    self._warm.pop(rid, None)
+                    self._delivered_seen.pop(rid, None)
+                    self._replica_load.pop(rid, None)
+                self._stalls_seen.pop(rid, None)
+                self.scale_ins += 1
+                with self._lock:
+                    self.desired = min(self.desired,
+                                       max(1, self._live_count_locked()))
+                self.tracer.instant("scale_in", track=0, replica=rid,
+                                    migrated=len(bound))
+                return True
+            finally:
+                self._retiring.discard(rid)
+
+    def flight_trip(self, reason: str) -> None:
+        """Elastic-plane observability tap (scale saturation: pressure
+        with every replica spawned): same off-thread fleet flight dump
+        as the loss/stall paths."""
+        self.tracer.instant("scale_saturated", track=0, reason=reason)
+        self._dump_async(reason)
+
+    def elastic_view(self) -> dict:
+        """The structured half of a fleet control row — what the
+        elastic plane composes with each flat ring sample before the
+        controller's decision step. RPC-free by construction: per-
+        replica queue/p99 come from the monitor's cached health-RPC
+        load rows (one poll period old), never from a live fan-out on
+        the sampler thread."""
+        with self._lock:
+            load = dict(self._load)
+            cached = {rid: dict(v) for rid, v in self._replica_load.items()}
+            replicas = [(rid, r.state) for rid, r in self._replicas.items()]
+            desired = self.desired
+        live = sum(1 for _, state in replicas if state == HEALTHY)
+        rows = []
+        for rid, state in replicas:
+            if state != HEALTHY:
+                continue
+            lr = cached.get(rid) or {}
+            rows.append({"rid": rid,
+                         "sessions": float(load.get(rid, 0)),
+                         "queue_depth": lr.get("queue_depth"),
+                         "p99_ms": lr.get("p99_ms")})
+        return {
+            "replicas_live": float(live),
+            "replicas_desired": float(desired),
+            "standby_warm": (float(self.standby.warm_count)
+                             if self.standby is not None else 0.0),
+            "capacity_sessions": float(
+                live * self.config.serve.max_sessions),
+            "bound_sessions": float(sum(load.values())),
+            "slo_ms": float(self.config.serve.slo_ms),
+            "replica_rows": rows,
+            "multihost_available": self._multihost_key is not None,
+            "profile_device_ms": self._profile_device_ms,
+        }
 
     # -- observability ---------------------------------------------------
 
@@ -985,14 +1390,31 @@ class FleetFrontend:
 
     def signals(self) -> dict:
         """RPC-free front-door signal row (the fleet telemetry ring's
-        sample: never blocks on a replica channel)."""
+        sample: never blocks on a replica channel). Since the elastic
+        fleet this is also the controller's flat input: the
+        admission-refusal counters (total AND per tier — previously
+        only visible in rejection strings), the cached per-replica load
+        aggregates (queue depth, worst p99, shed/SLO-miss/delivered
+        sums — one health-poll period old), and the scale gauges."""
         with self._lock:
             open_sessions = sum(1 for s in self._sessions.values()
                                 if not s.closed)
-        return {
+            cached = [dict(v) for rid, v in self._replica_load.items()
+                      if rid in self._replicas]
+            desired = self.desired
+            # Snapshot under the lock: spawn/retire mutate _replicas
+            # from the elastic apply thread.
+            replicas = list(self._replicas.values())
+        healthy = sum(1 for r in replicas if r.state == HEALTHY)
+
+        def agg(key, fold):
+            vals = [float(v[key]) for v in cached
+                    if v.get(key) is not None]
+            return fold(vals) if vals else None
+
+        out = {
             "open_sessions": float(open_sessions),
-            "healthy_replicas": float(sum(
-                1 for r in self._replicas.values() if r.state == HEALTHY)),
+            "healthy_replicas": float(healthy),
             "replica_losses_total": float(self.replica_losses),
             "migrated_sessions_total": float(self.migrated_sessions),
             "orphaned_sessions_total": float(self.orphaned_sessions),
@@ -1000,13 +1422,46 @@ class FleetFrontend:
             "tier_rejections_total": float(
                 self.admission.tier_rejections),
             "replica_restarts_total": float(sum(
-                r.restarts for r in self._replicas.values())),
+                r.restarts for r in replicas)),
+            # -- elastic fleet: scale gauges + the controller inputs --
+            "replicas_live": float(healthy),
+            "replicas_desired": float(desired),
+            "standby_warm": (float(self.standby.warm_count)
+                             if self.standby is not None else 0.0),
+            "scale_out_total": float(self.scale_outs),
+            "scale_in_total": float(self.scale_ins),
+            "standby_adoptions_total": float(self.standby_adoptions),
+            "admission_refusals_total": float(self.admission.rejections),
+            # Cached per-replica load aggregates (RPC-free; summed
+            # counters dip on a replica restart/retire — the idiomatic
+            # counter reset, and a non-advancing delta reads as calm).
+            "fleet_queue_depth": agg("queue_depth", sum),
+            "fleet_p99_ms": agg("p99_ms", max),
+            "fleet_shed_total": agg("shed_total", sum),
+            "fleet_slo_miss_total": agg("slo_miss_total", sum),
+            "fleet_delivered_total": agg("delivered_total", sum),
         }
+        # stats() hands back a locked snapshot — record_rejection may be
+        # inserting a first-of-its-tier key on an open_stream thread.
+        by_tier = self.admission.stats()["rejections_by_tier"]
+        for t, n in sorted(by_tier.items()):
+            name = TIER_NAMES.get(t, f"tier{t}")
+            out[f"admission_refusals_{name}_total"] = float(n)
+        if self.elastic is not None:
+            for k, v in self.elastic.signals().items():
+                out.setdefault(k, v)   # plane extras (errors,
+                #   saturations); applied-scale counters stay the
+                #   fleet's own
+        return out
 
     def stats(self) -> dict:
         """The fleet view: per-replica rows + merged latency/faults."""
+        # One snapshot for the whole export: the elastic apply thread
+        # inserts/pops replicas concurrently (pre-elastic this dict was
+        # construction-time-fixed and bare iteration was safe).
+        replica_items = list(self._replicas.items())
         exports: Dict[str, Optional[dict]] = {}
-        for rid, r in self._replicas.items():
+        for rid, r in replica_items:
             try:
                 exports[rid] = r.stats_full() if r.state == HEALTHY else None
             except ReplicaLostError as e:
@@ -1019,7 +1474,7 @@ class FleetFrontend:
             load = dict(self._load)
             warm = {rid: list(keys) for rid, keys in self._warm.items()}
         replica_rows = {}
-        for rid, r in self._replicas.items():
+        for rid, r in replica_items:
             row = replica_row(r, exports.get(rid), load.get(rid, 0))
             d = row.get("delivered_total")
             with self._lock:
@@ -1061,6 +1516,19 @@ class FleetFrontend:
             # Per-replica warm-signature map (the placement input): what
             # each replica's pool serves without a compile.
             "warm_replicas": warm,
+            # -- elastic fleet: live/desired/standby + scale counters --
+            "replicas_live": sum(1 for _, r in replica_items
+                                 if r.state == HEALTHY),
+            "replicas_desired": self.desired,
+            "standby_warm": (self.standby.warm_count
+                             if self.standby is not None else 0),
+            "scale_outs": self.scale_outs,
+            "scale_ins": self.scale_ins,
+            "standby_adoptions": self.standby_adoptions,
+            **({"standby": self.standby.stats()}
+               if self.standby is not None else {}),
+            **({"elastic": self.elastic.stats()}
+               if self.elastic is not None else {}),
             **self.admission.stats(),
             "faults": merge_fault_summaries(
                 self.faults.summary(),
@@ -1071,7 +1539,7 @@ class FleetFrontend:
                 for rid, e in exports.items()
             },
             "replica_restarts": sum(r.restarts
-                                    for r in self._replicas.values()),
+                                    for _, r in replica_items),
             "aggregate": merge_latency_snapshots(
                 {rid: (e or {}).get("latency")
                  for rid, e in exports.items()}),

@@ -155,7 +155,28 @@ def fleet_samples(fleet) -> List[MetricSample]:
         "rejections_total": st.get("rejections"),
         "tier_rejections_total": st.get("tier_rejections"),
         "replica_restarts_total": st.get("replica_restarts"),
+        # Elastic fleet: how many replicas are serving vs wanted vs
+        # pre-warmed, and the scale actions applied so far — the
+        # autoscaler's observable surface (dvf_fleet_replicas_live /
+        # _desired / dvf_fleet_standby_warm gauges, dvf_fleet_scale_*
+        # counters).
+        "replicas_live": st.get("replicas_live"),
+        "replicas_desired": st.get("replicas_desired"),
+        "standby_warm": st.get("standby_warm"),
+        "scale_out_total": st.get("scale_outs"),
+        "scale_in_total": st.get("scale_ins"),
+        "standby_adoptions_total": st.get("standby_adoptions"),
     }, prefix="fleet")
+    if st.get("rejections_by_tier"):
+        # One tier vocabulary across surfaces: the ring/signals names
+        # use TIER_NAMES ("standard"), so the label must too.
+        from dvf_tpu.control.controllers import TIER_NAMES
+
+        for tier, n in st["rejections_by_tier"].items():
+            label = TIER_NAMES.get(tier, f"tier{tier}")
+            out.append(MetricSample(
+                "fleet_admission_refusals_total", float(n),
+                (("tier", label),), COUNTER))
     faults = st.get("faults") or {}
     for kind, n in (faults.get("by_kind") or {}).items():
         out.append(MetricSample("fleet_faults_total", float(n),

@@ -779,6 +779,34 @@ class ServeFrontend:
             "warm_signatures": self._warm_signatures(),
         }
 
+    def load_row(self) -> dict:
+        """The per-replica load summary the fleet monitor caches for
+        its ELASTIC view (rides the health RPC, one row per poll):
+        queue depth, occupancy, the monotone delivery/shed/refusal
+        counters, and one weighted percentile merge — ``signals()``'s
+        only aggregate cost, at ``health()``'s cadence. Everything the
+        fleet elasticity controller reads per replica, nothing more."""
+        with self._lock:
+            live = list(self._sessions.values())
+            retired = list(self._retired.values())
+            floor = dict(self._evicted_totals)
+        every = retired + live
+        agg = LatencyStats.merged([s.latency for s in every])
+        p99 = agg.get("p99_ms")
+        return {
+            "open_sessions": float(len(live)),
+            "queue_depth": float(sum(
+                len(s.ingress) + len(s.pending) for s in live)),
+            "p99_ms": p99 if (p99 is not None and p99 == p99) else None,
+            "delivered_total": float(floor["delivered"] + sum(
+                s.delivered for s in every)),
+            "shed_total": float(floor["shed"] + sum(
+                s.shed for s in every)),
+            "slo_miss_total": float(floor["slo_miss"] + sum(
+                s.slo_miss for s in every)),
+            "admission_rejections_total": float(self.admission_rejections),
+        }
+
     def latency_snapshot(self) -> dict:
         """All sessions' latency samples as ONE mergeable snapshot
         (``LatencyStats.combined``) — the per-replica half of the fleet

@@ -97,6 +97,12 @@ def pytest_configure(config):
                    "stage-cost profiles, trace-view — CPU backend, "
                    "bounded wall time; run in tier-1, select with "
                    "-m lineage)")
+    config.addinivalue_line(
+        "markers", "elastic: controller-driven fleet autoscaling tests "
+                   "(deterministic scale-decision replay, warm standby "
+                   "pool, spawn/retire actuators, SIGKILL-during-scale-in "
+                   "chaos — CPU backend, bounded wall time; run in "
+                   "tier-1, select with -m elastic)")
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -119,6 +125,20 @@ def _fleet_resources_released():
         assert not leaked, (
             f"fleet worker processes leaked (FleetFrontend.stop not "
             f"called?): pids {[p.pid for p in leaked]}")
+    # Standby-pool workers are replicas that exist BEFORE any session
+    # does (pre-forked, AOT-warm): one outliving FleetFrontend.stop()
+    # is a leaked child the process guard above may miss in local mode
+    # (a local standby is a live frontend + engine, not a subprocess).
+    mod_el = _sys.modules.get("dvf_tpu.fleet.elastic")
+    if mod_el is not None:
+        standby = mod_el.live_standby_handles()
+        while standby and time.time() < deadline:
+            time.sleep(0.1)
+            standby = mod_el.live_standby_handles()
+        assert not standby, (
+            f"warm standby replicas leaked (StandbyPool.stop not called "
+            f"— FleetFrontend.stop sweeps its pool?): "
+            f"{[h.id for h in standby]}")
     fleet_threads = {t for t in threading.enumerate()
                     if t.name.startswith("dvf-fleet") and t.is_alive()}
     while fleet_threads and time.time() < deadline:
