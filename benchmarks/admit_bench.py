@@ -43,6 +43,8 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 
+from benchtools import sentinel_record  # noqa: E402
+
 
 def _median(xs):
     return statistics.median(xs) if xs else None
@@ -315,6 +317,19 @@ def run(quick=False):
             "measured_mixed_over_solo_ratio":
                 mixed.get("mixed_over_solo_ratio"),
         },
+        "sentinel": sentinel_record("admit_bench", {
+            # Steal-cancelled ratios only (benchtools.sentinel_record):
+            # the speedup is cold/warm on the SAME host moments apart,
+            # the mixed ratio a same-run A/B — absolute fps never gates.
+            "warm_admit_speedup": {
+                "value": admission.get("warm_vs_cold_speedup"),
+                "better": "higher", "band_frac": None, "hard_min": 10.0,
+            },
+            "mixed_over_solo_ratio": {
+                "value": mixed.get("mixed_over_solo_ratio"),
+                "better": "higher", "band_frac": None, "hard_min": 0.8,
+            },
+        }),
     }
 
 

@@ -48,6 +48,8 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 
+from benchtools import sentinel_record  # noqa: E402
+
 OVERHEAD_BUDGET_FRAC = 0.03
 
 
@@ -212,6 +214,16 @@ def run(quick=False):
             "within_budget": (overhead is not None
                               and overhead <= OVERHEAD_BUDGET_FRAC),
         },
+        "sentinel": sentinel_record("attr_bench", {
+            "attr_overhead_frac": {
+                "value": (round(overhead, 4)
+                          if overhead is not None else None),
+                "better": "lower",
+                "band_frac": 1.0,      # near-zero fraction: absolute
+                "abs_band": 0.05,      # drift is the meaningful band
+                "hard_max": OVERHEAD_BUDGET_FRAC if not quick else 0.2,
+            },
+        }),
     }
 
 

@@ -1,0 +1,458 @@
+"""Continuous perf-regression sentinel: nonzero exit = perf regressed.
+
+The repo's perf claims live in committed bench JSON (ADMIT / ATTR /
+ELASTIC / SOAK / LEDGER / the anchored head-to-head). Nothing re-reads
+them, so a change can quietly regress the very numbers the ROADMAP
+cites. This sentinel is the CI gate that re-reads — and re-measures:
+
+1. **Baseline gates** (always, free): every committed baseline must
+   still satisfy its own pinned acceptance (speedup ≥ target, overhead
+   ≤ budget, zero hard failures, anchored ratio ≥ 3×). A PR that
+   regenerates a baseline with worse-than-target numbers fails here.
+
+2. **Fresh probe** (``--quick`` and default): one bounded concurrent
+   A/B — a real serve frontend whose deliveries are JPEG-encoded
+   through the codec pool, raced against a pure-numpy REFERENCE leg on
+   the same wall window. The serve/reference ratio is the
+   steal-cancelling trick from ATTR_BENCH turned into a regression
+   detector: hypervisor steal and scheduler noise hit both legs
+   (common mode), while a code change that slows the serve path moves
+   only the numerator. The fresh ratio is diffed against the committed
+   ``SENTINEL_BASELINE.json`` with a wide noise band — wide enough for
+   a steal-drifted host, narrow enough that a real slowdown (e.g. a
+   sleep in the codec pool: ``--inject-slowdown-ms``, the self-test
+   tier-1 pins) trips it by an order of magnitude.
+
+3. **Fresh bench diffs** (``--full``): quick-mode re-runs of the
+   normalized-record writers (attr_bench, ledger_bench, admit_bench)
+   diffed metric-by-metric against the committed records
+   (``benchtools.sentinel_record`` — ratios and overhead fractions
+   only, never absolute fps).
+
+Exit codes: 0 clean, 1 regression (report on stdout), 2 harness error.
+``scripts/ci_tier1.sh`` runs ``sentinel.py --quick`` after the tier-1
+suite, so CI fails on test OR perf regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+BASELINE_PATH = os.path.join(_HERE, "SENTINEL_BASELINE.json")
+
+# Fresh-probe noise band. Measured on this steal-drifted 2-vCPU host:
+# clean best-of-rounds ratios span ~3× across runs (the serve leg is
+# multi-threaded, so steal hits it asymmetrically — worst clean best
+# observed ~21 vs baseline 77), while an injected 25 ms/frame codec
+# sleep collapses the ratio to ~2 — the 90% one-sided band (floor
+# baseline×0.1 ≈ 7.7) sits ~3× from both, so neither side is a coin
+# flip. A real CI runner with dedicated cores can tighten this.
+PROBE_BAND_FRAC = 0.9
+
+
+def _load(name):
+    path = os.path.join(_HERE, name)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: committed-baseline gates
+# ---------------------------------------------------------------------------
+
+
+def baseline_gates():
+    """[(bench, metric, ok, detail), ...] — every committed baseline
+    re-checked against its own pinned acceptance."""
+    out = []
+
+    def gate(bench, metric, ok, detail):
+        out.append({"bench": bench, "metric": metric, "ok": bool(ok),
+                    "detail": detail})
+
+    doc = _load("ADMIT_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("warm_admit_speedup_measured"),
+                acc.get("warm_admit_speedup_target", 10.0))
+        gate("ADMIT_BENCH", "warm_admit_speedup",
+             m is not None and m >= t, f"{m} >= {t}")
+        m, t = (acc.get("measured_mixed_over_solo_ratio"),
+                acc.get("target_mixed_over_solo_ratio", 0.8))
+        gate("ADMIT_BENCH", "mixed_over_solo_ratio",
+             m is not None and m >= t, f"{m} >= {t}")
+    doc = _load("ATTR_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("measured_overhead_frac"),
+                acc.get("overhead_budget_frac", 0.03))
+        gate("ATTR_BENCH", "attr_overhead_frac",
+             m is not None and m <= t, f"{m} <= {t}")
+    doc = _load("LEDGER_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("measured_overhead_frac"),
+                acc.get("overhead_budget_frac", 0.02))
+        gate("LEDGER_BENCH", "ledger_overhead_frac",
+             m is not None and m <= t, f"{m} <= {t}")
+    doc = _load("ELASTIC_BENCH.json")
+    if doc is not None:
+        spawn = doc.get("spawn", {})
+        m, t = (spawn.get("speedup_ratio"),
+                spawn.get("target_speedup_ratio", 10.0))
+        gate("ELASTIC_BENCH", "standby_spawn_speedup",
+             m is not None and m >= t, f"{m} >= {t}")
+        soak = doc.get("soak", {})
+        gate("ELASTIC_BENCH", "soak_interactive_p99_within_slo",
+             bool(soak.get("interactive_p99_within_slo")),
+             f"worst {soak.get('interactive_p99_worst_ms')} ms vs SLO "
+             f"{soak.get('slo_ms')} ms")
+        gate("ELASTIC_BENCH", "soak_hard_failures",
+             soak.get("hard_failures_total") == 0,
+             f"{soak.get('hard_failures_total')} == 0")
+        gate("ELASTIC_BENCH", "soak_order_violations",
+             soak.get("order_violations_total") == 0,
+             f"{soak.get('order_violations_total')} == 0")
+    doc = _load("SOAK_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m = acc.get("controlled_interactive_p99_over_baseline_ratio")
+        t = acc.get("target_controlled_interactive_p99_over_baseline_ratio",
+                    2.0)
+        gate("SOAK_BENCH", "controlled_interactive_p99_ratio",
+             m is not None and m <= t, f"{m} <= {t}")
+        gate("SOAK_BENCH", "controlled_hard_failures",
+             acc.get("controlled_hard_failures_total") == 0,
+             f"{acc.get('controlled_hard_failures_total')} == 0")
+    doc = _load("REFERENCE_HEADTOHEAD.json")
+    if doc is not None:
+        m = doc.get("speedup_same_codec_low_motion_delta_anchored")
+        gate("REFERENCE_HEADTOHEAD", "anchored_same_codec_speedup",
+             m is not None and m >= 3.0, f"{m} >= 3.0")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: fresh concurrent-A/B probe (serve+codec vs numpy reference)
+# ---------------------------------------------------------------------------
+
+
+def _serve_leg(duration_s, inject_ms, out):
+    """Closed-loop serve + codec-pool encode of every delivery —
+    the workload under test."""
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+    from dvf_tpu.transport.codec import JpegCodec
+
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    codec = JpegCodec(quality=85, threads=2)
+    if inject_ms > 0:
+        # The synthetic slowdown the self-test injects: a sleep in the
+        # codec pool's per-frame encode — exactly the class of hot-path
+        # regression the sentinel exists to catch.
+        orig = codec.encode
+
+        def slow_encode(f):
+            time.sleep(inject_ms / 1e3)
+            return orig(f)
+
+        codec.encode = slow_encode
+    fe = ServeFrontend(
+        get_filter("invert"),
+        ServeConfig(batch_size=4, queue_size=4000, out_queue_size=16384,
+                    slo_ms=60_000.0, telemetry_sample_s=0.0)).start()
+    sid = fe.open_stream()
+    try:
+        # Warm (compile + first batch) outside the clock.
+        fe.submit(sid, frame)
+        deadline_warm = time.time() + 20.0
+        while not fe.poll(sid) and time.time() < deadline_warm:
+            time.sleep(0.002)
+        out["start"].wait()
+        served = 0
+        submitted = polled = 0
+        window = 12
+        deadline = time.perf_counter() + duration_s
+        while time.perf_counter() < deadline:
+            if submitted - polled < window:
+                fe.submit(sid, frame)
+                submitted += 1
+            got = fe.poll(sid)
+            if got:
+                polled += len(got)
+                codec.encode_batch([d.frame for d in got])
+                served += len(got)
+            else:
+                time.sleep(0.0005)
+        out["serve_fps"] = served / duration_s
+    finally:
+        fe.stop()
+        codec.close()
+
+
+def _reference_leg(duration_s, out):
+    """Pure-numpy reference workload: same wall window, zero dvf code —
+    the common-mode denominator."""
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    scratch = np.empty_like(arr)
+    out["start"].wait()
+    ops = 0
+    deadline = time.perf_counter() + duration_s
+    while time.perf_counter() < deadline:
+        np.subtract(255, arr, out=scratch)
+        _ = int(scratch.sum())
+        ops += 1
+    out["ref_kops"] = ops / duration_s / 1e3
+
+
+def probe(rounds=3, duration_s=2.0, inject_ms=0):
+    """Median serve/reference ratio over ``rounds`` concurrent rounds."""
+    ratios = []
+    rows = []
+    for i in range(rounds):
+        out = {"start": threading.Event()}
+        ts = threading.Thread(target=_serve_leg,
+                              args=(duration_s, inject_ms, out))
+        tr = threading.Thread(target=_reference_leg,
+                              args=(duration_s, out))
+        ts.start()
+        tr.start()
+        time.sleep(0.05)
+        out["start"].set()
+        ts.join()
+        tr.join()
+        serve_fps = out.get("serve_fps", 0.0)
+        ref_kops = out.get("ref_kops", 0.0)
+        ratio = serve_fps / ref_kops if ref_kops else None
+        if ratio:
+            ratios.append(ratio)
+        rows.append({"round": i, "serve_fps": round(serve_fps, 1),
+                     "ref_kops_per_s": round(ref_kops, 2),
+                     "serve_over_ref_ratio": (round(ratio, 4)
+                                              if ratio else None)})
+    return {
+        "rounds": {str(r["round"]): r for r in rows},
+        "duration_s": duration_s,
+        "inject_slowdown_ms": inject_ms,
+        # BEST of rounds, not median: hypervisor steal only ever makes
+        # a leg slower, so the max ratio is the stable estimator of the
+        # code's speed — a regression lowers every round, including the
+        # best one.
+        "ratio_best": (round(max(ratios), 4) if ratios else None),
+        "ratio_median": (round(statistics.median(ratios), 4)
+                         if ratios else None),
+    }
+
+
+def probe_regressions(fresh, baseline):
+    out = []
+    bp = (baseline or {}).get("probe") or {}
+    base = bp.get("ratio_best", bp.get("ratio_median"))
+    m = fresh.get("ratio_best", fresh.get("ratio_median"))
+    if base is None:
+        return out, "no committed SENTINEL_BASELINE.json probe ratio"
+    band = ((baseline or {}).get("probe") or {}).get(
+        "band_frac", PROBE_BAND_FRAC)
+    floor = base * (1.0 - band)
+    if m is None or m < floor:
+        out.append({"bench": "sentinel_probe",
+                    "metric": "serve_over_ref_ratio",
+                    "ok": False,
+                    "detail": f"fresh {m} < floor {floor:.4f} "
+                              f"(baseline {base}, band {band:g})"})
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# Leg 3 (--full): fresh quick-mode bench diffs vs committed records
+# ---------------------------------------------------------------------------
+
+
+def _extract_record(doc, bench):
+    """The committed doc's normalized record — its own ``sentinel`` key
+    when the writer emits one, else reconstructed from acceptance (docs
+    committed before the record existed)."""
+    if doc is None:
+        return None
+    if doc.get("sentinel"):
+        return doc["sentinel"]
+    acc = doc.get("acceptance", {})
+    if bench == "attr_bench":
+        return {"bench": bench, "metrics": {"attr_overhead_frac": {
+            "value": acc.get("measured_overhead_frac"), "better": "lower",
+            "band_frac": 1.0, "abs_band": 0.05,
+            "hard_max": acc.get("overhead_budget_frac", 0.03)}}}
+    if bench == "admit_bench":
+        return {"bench": bench, "metrics": {
+            "warm_admit_speedup": {
+                "value": acc.get("warm_admit_speedup_measured"),
+                "better": "higher", "band_frac": None,
+                "hard_min": acc.get("warm_admit_speedup_target", 10.0)},
+            "mixed_over_solo_ratio": {
+                "value": acc.get("measured_mixed_over_solo_ratio"),
+                "better": "higher", "band_frac": None,
+                "hard_min": acc.get("target_mixed_over_solo_ratio", 0.8)},
+        }}
+    return None
+
+
+def diff_records(committed, fresh, bench):
+    """Metric-by-metric diff of two normalized records; a metric
+    regresses when it moved in the worse direction beyond
+    max(band_frac·|base|, abs_band), or crossed a hard gate."""
+    out = []
+    if not committed or not fresh:
+        return out
+    for name, base_spec in (committed.get("metrics") or {}).items():
+        fresh_spec = (fresh.get("metrics") or {}).get(name) or {}
+        fv = fresh_spec.get("value")
+        bv = base_spec.get("value")
+        better = base_spec.get("better", "higher")
+        if fv is None:
+            out.append({"bench": bench, "metric": name, "ok": False,
+                        "detail": "fresh run produced no value"})
+            continue
+        hard_min = base_spec.get("hard_min")
+        hard_max = base_spec.get("hard_max")
+        # The fresh (quick) run's own hard gates are looser where the
+        # writer says so — prefer them for the fresh value.
+        if fresh_spec.get("hard_min") is not None:
+            hard_min = fresh_spec["hard_min"]
+        if fresh_spec.get("hard_max") is not None:
+            hard_max = fresh_spec["hard_max"]
+        if hard_min is not None and fv < hard_min:
+            out.append({"bench": bench, "metric": name, "ok": False,
+                        "detail": f"fresh {fv} < hard_min {hard_min}"})
+            continue
+        if hard_max is not None and fv > hard_max:
+            out.append({"bench": bench, "metric": name, "ok": False,
+                        "detail": f"fresh {fv} > hard_max {hard_max}"})
+            continue
+        band = base_spec.get("band_frac")
+        if bv is None or band is None:
+            continue  # absolute gates only
+        allowed = max(abs(float(bv)) * float(band),
+                      float(base_spec.get("abs_band", 0.0)))
+        drift = (float(bv) - float(fv) if better == "higher"
+                 else float(fv) - float(bv))
+        if drift > allowed:
+            out.append({"bench": bench, "metric": name, "ok": False,
+                        "detail": f"fresh {fv} vs committed {bv} "
+                                  f"drifted {drift:.4f} worse "
+                                  f"(> allowed {allowed:.4f})"})
+    return out
+
+
+def fresh_bench_diffs():
+    """Quick-mode re-runs of the record-emitting writers, diffed
+    against the committed baselines (--full leg)."""
+    import importlib
+
+    out = []
+    for mod_name, json_name, bench in (
+            ("attr_bench", "ATTR_BENCH.json", "attr_bench"),
+            ("ledger_bench", "LEDGER_BENCH.json", "ledger_bench"),
+            ("admit_bench", "ADMIT_BENCH.json", "admit_bench")):
+        committed = _extract_record(_load(json_name), bench)
+        if committed is None:
+            continue
+        mod = importlib.import_module(mod_name)
+        fresh_doc = mod.run(quick=True)
+        fresh = fresh_doc.get("sentinel")
+        out.extend(diff_records(committed, fresh, bench))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--quick", action="store_true",
+                   help="baseline gates + fresh probe only (the CI / "
+                        "tier-1 mode; seconds)")
+    p.add_argument("--full", action="store_true",
+                   help="also re-run the quick benches and diff their "
+                        "normalized records against the committed "
+                        "baselines")
+    p.add_argument("--skip-probe", action="store_true",
+                   help="baseline gates only (no measurement)")
+    p.add_argument("--inject-slowdown-ms", type=float, default=0.0,
+                   help="self-test: sleep this long in the codec pool's "
+                        "per-frame encode — the sentinel must exit "
+                        "nonzero")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="measure the probe (more rounds) and write "
+                        "SENTINEL_BASELINE.json")
+    p.add_argument("--rounds", type=int, default=None)
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    try:
+        if args.write_baseline:
+            doc = probe(rounds=args.rounds or 7, duration_s=2.5)
+            baseline = {
+                "schema": "dvf.sentinel_baseline.v1",
+                "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                              time.gmtime()),
+                "host_cpus": os.cpu_count(),
+                "probe": {"ratio_best": doc["ratio_best"],
+                          "ratio_median": doc["ratio_median"],
+                          "band_frac": PROBE_BAND_FRAC,
+                          "rounds": doc["rounds"]},
+            }
+            with open(BASELINE_PATH, "w") as f:
+                json.dump(baseline, f, indent=2)
+            print(f"wrote {BASELINE_PATH} "
+                  f"(ratio_best {doc['ratio_best']}, "
+                  f"median {doc['ratio_median']})")
+            return 0
+
+        failures = [g for g in baseline_gates() if not g["ok"]]
+        report = {"gates_failed": failures, "regressions": []}
+        if not args.skip_probe:
+            rounds = args.rounds or (2 if args.quick else 3)
+            fresh = probe(rounds=rounds,
+                          duration_s=1.5 if args.quick else 2.5,
+                          inject_ms=args.inject_slowdown_ms)
+            report["probe"] = fresh
+            regs, note = probe_regressions(fresh, _load(
+                "SENTINEL_BASELINE.json"))
+            if note:
+                report["probe_note"] = note
+            report["regressions"].extend(regs)
+        if args.full:
+            report["regressions"].extend(fresh_bench_diffs())
+    except Exception as e:  # noqa: BLE001 — harness error ≠ regression
+        print(f"sentinel harness error: {e!r}", file=sys.stderr)
+        return 2
+
+    bad = report["gates_failed"] + report["regressions"]
+    print(json.dumps(report, indent=2))
+    if bad:
+        print(f"PERF REGRESSION: {len(bad)} failing check(s)",
+              file=sys.stderr)
+        return 1
+    print("sentinel: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

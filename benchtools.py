@@ -129,6 +129,41 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def sentinel_record(bench: str, metrics: dict) -> dict:
+    """The NORMALIZED bench record every writer emits for the
+    perf-regression sentinel (benchmarks/sentinel.py).
+
+    ``metrics`` maps metric name → spec::
+
+        {"value": <measured>, "better": "higher"|"lower",
+         "band_frac": <tolerated relative drift>,
+         "hard_min"/"hard_max": <absolute gate, optional>}
+
+    The sentinel diffs a fresh quick-mode run's record against the
+    committed baseline's: a metric is a REGRESSION when it moved in the
+    "worse" direction by more than ``band_frac`` relative, or crossed
+    its absolute gate. Only steal-cancelled metrics belong here —
+    ratios from concurrent A/B legs, speedups, overhead fractions —
+    never absolute fps, which measures the hypervisor, not the code.
+    """
+    out = {}
+    for name, spec in metrics.items():
+        band = spec.get("band_frac", 0.25)
+        row = {"value": spec.get("value"),
+               "better": spec.get("better", "higher"),
+               # band_frac None = no relative banding (absolute gates
+               # only — e.g. a speedup whose magnitude varies 100×
+               # between quick and full legs but must stay over target)
+               "band_frac": float(band) if band is not None else None}
+        if spec.get("abs_band") is not None:
+            row["abs_band"] = float(spec["abs_band"])
+        for gate in ("hard_min", "hard_max"):
+            if spec.get(gate) is not None:
+                row[gate] = float(spec[gate])
+        out[name] = row
+    return {"bench": bench, "metrics": out}
+
+
 def load_reference_module(filename: str, ref_dir: str = "/root/reference"):
     """Import one of the reference's modules from its read-only checkout
     (never copied). Returns the loaded module."""

@@ -250,7 +250,7 @@ def _resolve_source(args, allow_shm: bool = True):
 
 
 def _start_exporter(args, registry, health_fn=None, ring=None,
-                    explain_fn=None):
+                    explain_fn=None, ledger_fn=None):
     """--metrics-port: start the pull-based scrape endpoint (obs.export)
     over this invocation's registry. Returns the started exporter (None
     when the flag is absent). Port 0 binds an ephemeral port; the bound
@@ -261,9 +261,11 @@ def _start_exporter(args, registry, health_fn=None, ring=None,
     from dvf_tpu.obs.export import MetricsExporter
 
     ex = MetricsExporter(registry, port=port, health_fn=health_fn,
-                         ring=ring, explain_fn=explain_fn).start()
+                         ring=ring, explain_fn=explain_fn,
+                         ledger_fn=ledger_fn).start()
     endpoints = "/metrics /healthz /timeseries" + (
-        " /explain" if explain_fn is not None else "")
+        " /explain" if explain_fn is not None else "") + (
+        " /ledger" if ledger_fn is not None else "")
     print(f"[metrics] {endpoints} on {ex.url}",
           file=sys.stderr, flush=True)
     return ex
@@ -371,7 +373,10 @@ def _cmd_serve_multi(args, filt, engine) -> int:
                                health_fn=frontend.health,
                                ring=frontend.telemetry,
                                explain_fn=(frontend.explain
-                                           if args.lineage else None))
+                                           if args.lineage else None),
+                               ledger_fn=(frontend.ledger.document
+                                          if frontend.ledger is not None
+                                          else None))
 
     # Spread the streams across ~0.4×..1.6× the base rate: genuinely
     # different per-tenant cadences, so batches interleave sessions
@@ -781,7 +786,10 @@ def cmd_fleet(args) -> int:
                                health_fn=fleet_health,
                                ring=fleet.telemetry,
                                explain_fn=(fleet.explain
-                                           if args.lineage else None))
+                                           if args.lineage else None),
+                               ledger_fn=(fleet.ledger.document
+                                          if fleet.ledger is not None
+                                          else None))
 
     def drive(sid: str, rate: float, seed: int) -> None:
         src = SyntheticSource(height=args.height, width=args.width,

@@ -185,7 +185,10 @@ class ControlPlane:
     def _apply(self, a: Action) -> None:
         act = self.actuator
         if a.kind == "resize":
-            act.request_batch_size(a.target, int(a.value))
+            # The decision rationale rides into the actuator so the
+            # reconfiguration ledger's batch_resize event records WHY
+            # ("why did the controller do that at 14:02" — one artifact).
+            act.request_batch_size(a.target, int(a.value), reason=a.reason)
             with self._lock:
                 self.batch_resizes_total += 1
         elif a.kind == "tick":
@@ -194,7 +197,8 @@ class ControlPlane:
                 self.tick_changes_total += 1
                 self.tick_s = float(a.value)
         elif a.kind in ("downshift", "upshift"):
-            ok = act.request_session_quality(a.target, int(a.value))
+            ok = act.request_session_quality(a.target, int(a.value),
+                                             reason=a.reason)
             with self._lock:
                 if not ok:
                     self.rejected_quality_total += 1

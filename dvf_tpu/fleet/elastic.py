@@ -293,7 +293,8 @@ class ElasticFleetPlane:
         if a.kind == "scale_out":
             flavor = None if a.target in (None, FLAVOR_DEFAULT) else a.target
             try:
-                fleet.spawn_replica(flavor=flavor)
+                fleet.spawn_replica(flavor=flavor, cause="autoscale",
+                                    reason=a.reason)
             except Exception:
                 with self._lock:
                     self.scale_errors_total += 1
@@ -304,7 +305,8 @@ class ElasticFleetPlane:
         elif a.kind == "scale_in":
             ok = False
             try:
-                ok = fleet.retire_replica(a.target)
+                ok = fleet.retire_replica(a.target, cause="autoscale",
+                                          reason=a.reason)
             finally:
                 if not ok:
                     fleet.rollback_desired(+1)

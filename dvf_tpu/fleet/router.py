@@ -65,6 +65,8 @@ from dvf_tpu.fleet.stats import (
     replica_row,
 )
 from dvf_tpu.obs.export import FlightRecorder, attach_fleet_provider
+from dvf_tpu.obs import ledger as ledger_mod
+from dvf_tpu.obs.ledger import ReconfigLedger
 from dvf_tpu.obs.registry import MetricsRegistry, TimeSeriesRing
 from dvf_tpu.obs.trace import Tracer, merge_tracer_snapshots
 from dvf_tpu.resilience.faults import FaultKind, FaultStats
@@ -266,6 +268,13 @@ class FleetFrontend:
                              process_name="fleet")
         self.registry = MetricsRegistry()
         attach_fleet_provider(self.registry, self)
+        # Fleet-tier reconfiguration ledger (obs.ledger): replica
+        # spawn/retire/restart land here with their causes and measured
+        # wall costs (the per-replica compile/resize events live in
+        # each replica's OWN ledger, which rides its stats_full RPC).
+        self.ledger: Optional[ReconfigLedger] = None
+        if self.config.serve.ledger:
+            self.ledger = ReconfigLedger(tracer=self.tracer, track=1)
         # -- elasticity plane (ISSUE 12): controller + standby pool. The
         # plane must exist before the ring so the ring's on_sample hook
         # can point at it; an armed autoscale implies the ring (the
@@ -318,7 +327,9 @@ class FleetFrontend:
                 max_total_bytes=self.config.flight_max_total_bytes,
                 trace_fn=self.trace_snapshots,
                 stats_fn=self.stats,
-                ring=self.telemetry)
+                ring=self.telemetry,
+                ledger_fn=(self.ledger.document
+                           if self.ledger is not None else None))
         self._stalls_seen: Dict[str, int] = {}
         # Per-replica warm-signature sets (canonical renders), fed by
         # the health monitor from each replica's health() export and
@@ -961,6 +972,7 @@ class FleetFrontend:
                     pass
             if r.restarts < self.config.max_restarts:
                 r.state = RESTARTING
+                t_restart = time.time()
                 last: Optional[BaseException] = None
                 for _ in range(2):  # one retry: a respawn that failed
                     # transiently (loaded host, slow accept) gets a
@@ -983,6 +995,14 @@ class FleetFrontend:
                             # warm there until health says otherwise.
                             self._warm.pop(r.id, None)
                         last = None
+                        if self.ledger is not None:
+                            self.ledger.record(
+                                ledger_mod.REPLICA_RESTART,
+                                cause=ledger_mod.CAUSE_RECOVERY,
+                                replica=r.id,
+                                migrated_sessions=len(bound),
+                                wall_ms=(time.time() - t_restart) * 1e3,
+                                reason=repr(exc), t0=t_restart)
                         break
                     except Exception as e:  # noqa: BLE001 — judged below
                         last = e
@@ -1132,7 +1152,9 @@ class FleetFrontend:
         with self._lock:
             self.desired = max(1, self.desired + delta)
 
-    def spawn_replica(self, flavor: Optional[str] = None) -> str:
+    def spawn_replica(self, flavor: Optional[str] = None,
+                      cause: str = ledger_mod.CAUSE_MANUAL,
+                      reason: Optional[str] = None) -> str:
         """Scale out by one replica; returns its id. Default flavor
         takes a WARM STANDBY when the pool has one (adoption: a dict
         insert — the spawn-to-first-served-frame time the elastic bench
@@ -1143,6 +1165,7 @@ class FleetFrontend:
         (``FleetConfig.multihost_hosts`` hosts, one pjit program) pinned
         to the first precompile-manifest signature — falls back to the
         default flavor when the multihost leg is not configured."""
+        t_spawn = time.time()
         with self._scale_lock:
             if self._stop.is_set():
                 raise ServeError("fleet is stopping: no scale-out")
@@ -1191,6 +1214,13 @@ class FleetFrontend:
                 self.desired = max(self.desired, self._live_count_locked())
             self.tracer.instant("scale_out", track=0, replica=rid,
                                 warm=warm, flavor=flavor or "default")
+            if self.ledger is not None:
+                self.ledger.record(
+                    ledger_mod.REPLICA_SPAWN, cause=cause,
+                    replica=rid, warm=warm, flavor=flavor or "default",
+                    wall_ms=(time.time() - t_spawn) * 1e3,
+                    cache="hit" if warm else "miss", reason=reason,
+                    t0=t_spawn)
             self._wake.set()  # monitor: learn its warm signatures now
             return rid
 
@@ -1220,7 +1250,9 @@ class FleetFrontend:
             rpc_timeout_s=self.config.rpc_timeout_s,
         )
 
-    def retire_replica(self, rid: str) -> bool:
+    def retire_replica(self, rid: str,
+                       cause: str = ledger_mod.CAUSE_MANUAL,
+                       reason: Optional[str] = None) -> bool:
         """Scale in by draining one replica: admission off (state flips
         to DRAINING + replica-side ``begin_drain``), every bound session
         gracefully migrated to a survivor (drain-to-quiet salvage, then
@@ -1229,6 +1261,7 @@ class FleetFrontend:
         forget the replica. False = no such healthy replica (it died,
         retired, or was never there — the controller re-decides on a
         later window)."""
+        t_retire = time.time()
         with self._scale_lock:
             with self._loss_lock:
                 r = self._replicas.get(rid)
@@ -1273,6 +1306,12 @@ class FleetFrontend:
                                        max(1, self._live_count_locked()))
                 self.tracer.instant("scale_in", track=0, replica=rid,
                                     migrated=len(bound))
+                if self.ledger is not None:
+                    self.ledger.record(
+                        ledger_mod.REPLICA_RETIRE, cause=cause,
+                        replica=rid, migrated_sessions=len(bound),
+                        wall_ms=(time.time() - t_retire) * 1e3,
+                        reason=reason, t0=t_retire)
                 return True
             finally:
                 self._retiring.discard(rid)
@@ -1447,6 +1486,8 @@ class FleetFrontend:
         for t, n in sorted(by_tier.items()):
             name = TIER_NAMES.get(t, f"tier{t}")
             out[f"admission_refusals_{name}_total"] = float(n)
+        if self.ledger is not None:
+            out.update(self.ledger.signals())
         if self.elastic is not None:
             for k, v in self.elastic.signals().items():
                 out.setdefault(k, v)   # plane extras (errors,
@@ -1543,6 +1584,8 @@ class FleetFrontend:
             "aggregate": merge_latency_snapshots(
                 {rid: (e or {}).get("latency")
                  for rid, e in exports.items()}),
+            **({"ledger": self.ledger.summary()}
+               if self.ledger is not None else {}),
             **({"chaos": self.config.chaos.summary()}
                if self.config.chaos is not None else {}),
             **({"flight": self.flight.stats()}

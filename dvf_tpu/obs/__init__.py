@@ -19,3 +19,9 @@ from dvf_tpu.obs.lineage import (  # noqa: F401
     load_stage_profile,
     save_stage_profile,
 )
+from dvf_tpu.obs.ledger import ReconfigLedger  # noqa: F401
+from dvf_tpu.obs.memory import (  # noqa: F401
+    LeakTrendWatch,
+    attach_memory_provider,
+    memory_summary,
+)

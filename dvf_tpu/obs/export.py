@@ -248,6 +248,7 @@ class MetricsExporter:
         health_fn: Optional[Callable[[], dict]] = None,
         ring: Optional[TimeSeriesRing] = None,
         explain_fn: Optional[Callable[[], dict]] = None,
+        ledger_fn: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.health_fn = health_fn
@@ -255,6 +256,9 @@ class MetricsExporter:
         self.explain_fn = explain_fn  # latency-attribution explain
         #   surface (``ServeFrontend.explain``); ``/explain`` 404s
         #   without one
+        self.ledger_fn = ledger_fn  # reconfiguration-ledger document
+        #   (``ReconfigLedger.document`` on a serve/fleet owner):
+        #   ``/ledger`` serves the bounded event window; 404s without one
         self.requests = 0
         self.request_errors = 0
         self._stat_lock = threading.Lock()  # handler threads are
@@ -333,6 +337,14 @@ class MetricsExporter:
                 return
             self._reply(req, 200, "application/json",
                         json.dumps(jsonable(self.explain_fn())))
+        elif path == "/ledger":
+            if self.ledger_fn is None:
+                req.send_error(404, explain="no reconfiguration ledger "
+                                            "attached (serve/fleet tiers "
+                                            "expose one)")
+                return
+            self._reply(req, 200, "application/json",
+                        json.dumps(jsonable(self.ledger_fn())))
         else:
             req.send_error(404)
 
@@ -426,6 +438,7 @@ class FlightRecorder:
         jax_profile_s: float = 0.0,
         max_total_bytes: Optional[int] = None,
         lineage_fn: Optional[Callable[[], dict]] = None,
+        ledger_fn: Optional[Callable[[], dict]] = None,
     ):
         self.out_dir = out_dir
         self.label = label
@@ -447,6 +460,11 @@ class FlightRecorder:
         #   lineages of the SLO-breaching / slowest exemplar frames, so
         #   an SLO-burn post-mortem names the guilty stage instead of
         #   shrugging
+        self.ledger_fn = ledger_fn  # ReconfigLedger.document on a
+        #   ledger-armed owner: the dump then carries ``ledger.json`` —
+        #   every compile/resize/rebuild/quality/scale event with its
+        #   cause, wall cost, and measured bucket stall, so "what
+        #   reconfigured right before the trip" is in the artifact
         self.jax_profile_s = jax_profile_s
         self.dumps: List[str] = []
         self.suppressed = 0
@@ -577,6 +595,9 @@ class FlightRecorder:
         if self.lineage_fn is not None:
             best_effort("lineage", lambda: self._json(
                 dump_dir, "lineage.json", self.lineage_fn()))
+        if self.ledger_fn is not None:
+            best_effort("ledger", lambda: self._json(
+                dump_dir, "ledger.json", self.ledger_fn()))
         return wrote
 
     @staticmethod

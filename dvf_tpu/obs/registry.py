@@ -88,7 +88,7 @@ DYNAMIC_KEY_PARENTS = frozenset({
     "faults", "heartbeat_ages_s", "chaos", "rules", "fired", "polled",
     "rates", "series", "configs", "rounds", "trials", "buckets",
     "warm_replicas", "by_signature", "by_bucket", "by_session",
-    "rejections_by_tier", "standby", "phases",
+    "rejections_by_tier", "standby", "phases", "by_cause",
 })
 
 

@@ -56,6 +56,15 @@ EGRESS_D2H = "egress_d2h"
 EGRESS_ENCODE = "egress_encode"
 EGRESS_SEND = "egress_send"
 
+# The reconfiguration ledger (obs/ledger.py) stamps every recorded
+# event onto its own dedicated lane as ``reconfig:<kind>`` spans (plus
+# ``reconfig_stall_closed`` instants when a bucket's measured stall
+# window closes) — so a merged Perfetto session shows compiles,
+# resizes, rebuilds, and scale actions INLINE with the dispatch/device
+# lanes they stalled. One place owns the prefix for consumers to match.
+RECONFIG_PREFIX = "reconfig:"
+RECONFIG_STALL_CLOSED = "reconfig_stall_closed"
+
 
 class Tracer:
     """Frame-lifecycle tracer with a BOUNDED event ring.

@@ -12,7 +12,12 @@ directory and renders the numbers a human reads first —
   timestamps — where the wall time actually went;
 - **slowest frame lineages** (dumps with ``lineage.json``): the
   exemplar frames' additive decompositions, worst first — the
-  per-frame "where did my p99 go" answer, offline.
+  per-frame "where did my p99 go" answer, offline;
+- **reconfiguration events** (the obs/ledger plane): a dump's
+  ``ledger.json`` — every compile / resize / rebuild / quality rebind /
+  scale action with its cause, wall cost, and MEASURED bucket stall —
+  rendered inline beside the lane utilization; a bare trace file shows
+  the same events from its ``reconfig:*`` lane spans.
 
 Everything returns plain dicts (the ``--json`` form); ``render_text``
 turns one summary into the human view.
@@ -25,6 +30,7 @@ import os
 from typing import Any, Dict, List, Optional
 
 from dvf_tpu.obs.lineage import component_order
+from dvf_tpu.obs.trace import RECONFIG_PREFIX
 
 
 def load_trace(path: str) -> dict:
@@ -107,6 +113,34 @@ def slowest_spans(doc: dict, k: int = 10) -> List[dict]:
     return out
 
 
+def trace_reconfigurations(doc: dict, k: int = 32) -> List[dict]:
+    """Reconfiguration events from a trace's dedicated ledger lane
+    (``reconfig:*`` spans, stamped at record time by obs.ledger) — the
+    most recent ``k``, newest last. Lets a bare ``.pftrace`` show the
+    ledger story even without a dump's ``ledger.json``."""
+    out = []
+    for e in doc.get("traceEvents", []):
+        name = str(e.get("name", ""))
+        if e.get("ph") != "X" or not name.startswith(RECONFIG_PREFIX):
+            continue
+        args = e.get("args") or {}
+        out.append({
+            "kind": name[len(RECONFIG_PREFIX):],
+            "ts_ms": round(int(e.get("ts", 0)) / 1e3, 3),
+            "dur_ms": round(int(e.get("dur", 0)) / 1e3, 3),
+            **{kk: args[kk] for kk in sorted(args)},
+        })
+    out.sort(key=lambda r: r["ts_ms"])
+    return out[-k:]
+
+
+def ledger_events(ledger_doc: dict, k: int = 32) -> List[dict]:
+    """The most recent ``k`` events of one ``ledger.json`` document,
+    oldest first — what a dump summary renders inline with the lanes."""
+    events = list(ledger_doc.get("events") or [])
+    return events[-k:]
+
+
 def summarize_trace(path: str, top: int = 10) -> dict:
     doc = load_trace(path)
     out = {
@@ -116,6 +150,9 @@ def summarize_trace(path: str, top: int = 10) -> dict:
         "lanes": lane_utilization(doc),
         "slowest_spans": slowest_spans(doc, top),
     }
+    reconf = trace_reconfigurations(doc)
+    if reconf:
+        out["reconfigurations"] = reconf
     if doc.get("dvfTraceLanes"):
         out["sources"] = doc["dvfTraceLanes"]
     return out
@@ -173,6 +210,20 @@ def summarize_dump(dump_dir: str, top: int = 10) -> dict:
             expl = (lin.get("explain") or {}).get("text")
             if expl:
                 out["explain"] = expl
+    led_path = os.path.join(dump_dir, "ledger.json")
+    if os.path.exists(led_path):
+        try:
+            with open(led_path) as f:
+                led = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            led = None
+        if led:
+            # The dump's authoritative event list (carries stall_ms the
+            # trace spans cannot) wins over the trace-lane extraction.
+            out["reconfigurations"] = ledger_events(led)
+            out["ledger"] = {k: led.get(k) for k in
+                             ("events_total", "stall_events_total",
+                              "stall_ms_total", "by_kind", "by_cause")}
     return out
 
 
@@ -213,6 +264,32 @@ def render_text(summary: dict) -> str:
         for s in spans:
             lines.append(f"  {s['dur_ms']:>9.2f} ms  {s['name']:<20} "
                          f"[{s['lane']}] @ {s['ts_ms']:.1f} ms")
+    reconf = summary.get("reconfigurations")
+    if reconf:
+        lines.append("")
+        led = summary.get("ledger") or {}
+        head = "reconfiguration events"
+        if led.get("events_total") is not None:
+            head += (f" ({led['events_total']} total, "
+                     f"{led.get('stall_events_total', 0)} with stalls, "
+                     f"{led.get('stall_ms_total', 0):.0f} ms stalled)")
+        lines.append(head + ":")
+        for ev in reconf:
+            kind = ev.get("kind", "?")
+            cause = ev.get("cause")
+            what = f"{kind}" + (f"/{cause}" if cause else "")
+            where = ev.get("bucket") or ev.get("signature") \
+                or ev.get("replica") or ""
+            bits = []
+            for key, unit in (("wall_ms", "ms"), ("compile_ms", "ms c"),
+                              ("stall_ms", "ms stall")):
+                v = ev.get(key)
+                if v is not None:
+                    bits.append(f"{v:.1f} {unit}")
+            cache = ev.get("cache")
+            if cache:
+                bits.append(f"cache {cache}")
+            lines.append(f"  {what:<28} {where:<32} {', '.join(bits)}")
     lineages = summary.get("lineages")
     if lineages:
         lines.append("")

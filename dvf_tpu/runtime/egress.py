@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -76,6 +77,21 @@ MIN_STREAM_D2H_MS = 2.0
 # slab copy would be a pure regression. Tests monkeypatch True to run
 # the streamed machinery on the CPU test backend.
 STREAM_ON_CPU = False
+
+# Host-slab accounting registry (obs.memory) — the egress mirror of
+# runtime.ingest._LIVE_ASSEMBLERS: scrape-time gauges and the conftest
+# session-end leak guard walk it; a released fetcher reports 0.
+_LIVE_FETCHERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_fetchers() -> List["ShardedBatchFetcher"]:
+    return list(_LIVE_FETCHERS)
+
+
+def occupied_slab_bytes() -> int:
+    """Total host delivery-slab bytes currently pinned by live fetchers
+    — the egress half of ``dvf_mem_host_slab_bytes``."""
+    return sum(f.slab_bytes() for f in live_fetchers())
 
 
 class ShardedBatchFetcher:
@@ -123,6 +139,15 @@ class ShardedBatchFetcher:
         self._pool: Optional[List[np.ndarray]] = None
         self.effective_mode = self._plan()
         self.stats.effective_mode = self.effective_mode
+        _LIVE_FETCHERS.add(self)
+
+    def slab_bytes(self) -> int:
+        """Host delivery-slab memory this fetcher currently pins — 0
+        after :meth:`release` (and always 0 on the monolithic path,
+        which allocates per batch instead of pooling)."""
+        if self._pool is None:
+            return 0
+        return sum(a.nbytes for a in self._pool)
 
     def _plan(self) -> str:
         if self.mode == "monolithic" or self.sharding is None:

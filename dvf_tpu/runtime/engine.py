@@ -110,6 +110,15 @@ class Engine:
         self.out_dtype = None                             # signature — what
         #   the egress fetcher sizes its host slabs from (set by compile())
         self._out_sharding = None
+        self.last_compile_ms: Optional[float] = None  # wall duration of
+        #   the most recent compile() (trace + XLA compile + warmup +
+        #   calibrations — the whole admission-visible cost): what the
+        #   reconfiguration ledger's compile events and the
+        #   dvf_compile_ms histogram record
+        self.state_bytes: int = 0  # measured device residency of the
+        #   filter state (summed leaf nbytes at compile) — the per-
+        #   engine half of the memory accounting; free() folds it into
+        #   the process-wide freed counter
 
     # ------------------------------------------------------------------
 
@@ -206,6 +215,7 @@ class Engine:
         sig = (tuple(batch_shape), np.dtype(dtype))
         if sig == self._signature:
             return
+        t_compile0 = time.perf_counter()
         self._sharding = batch_sharding(self.mesh, batch_shape)
         # Mesh-aware body swap first (e.g. style transfer → shard_map'd
         # Megatron TP forward when the mesh has a model axis) …
@@ -294,6 +304,8 @@ class Engine:
             self._state = fresh_state()
         else:
             self.step_block_ms = None
+        self.last_compile_ms = (time.perf_counter() - t_compile0) * 1e3
+        self.state_bytes = _tree_device_bytes(self._state)
 
     # ------------------------------------------------------------------
 
@@ -446,6 +458,7 @@ class Engine:
         self._state = None
         self._sharding = None
         self._out_sharding = None
+        _note_freed_bytes(self.state_bytes)
         _unregister_pool_engine(self)
 
     def reset_state(self) -> None:
@@ -473,6 +486,38 @@ class Engine:
 # program (plus its device state) per churned signature forever.
 _POOL_ENGINES: "set" = set()
 _POOL_ENGINES_LOCK = threading.Lock()
+
+# Donated/freed device-memory accounting (obs.memory): Engine.free()
+# folds the freed engine's measured state residency in here, so the
+# scrape-time gauges can report eviction traffic as a monotone counter.
+_FREED_DEVICE_BYTES = 0
+
+
+def _note_freed_bytes(n: int) -> None:
+    global _FREED_DEVICE_BYTES
+    with _POOL_ENGINES_LOCK:
+        _FREED_DEVICE_BYTES += int(n or 0)
+
+
+def freed_device_bytes_total() -> int:
+    """Monotone: device state bytes released by every ``Engine.free()``
+    so far (pool evictions, frontend stops, recovery replacements) —
+    the ``dvf_mem_engine_freed_bytes_total`` counter's source."""
+    with _POOL_ENGINES_LOCK:
+        return _FREED_DEVICE_BYTES
+
+
+def _tree_device_bytes(state) -> int:
+    """Summed leaf nbytes of a (possibly None) device-resident pytree —
+    the engine's measured state residency."""
+    if state is None:
+        return 0
+    try:
+        return int(sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(state)))
+    except Exception:  # noqa: BLE001 — accounting must never raise
+        return 0
 
 
 def _register_pool_engine(engine: "Engine") -> None:
@@ -523,13 +568,29 @@ class ProgramPool:
         self.misses = 0
         self.evictions = 0
         self.closed = False
+        self.observer: Optional[Callable] = None  # reconfiguration-
+        #   ledger tap (duck-typed: observer(kind, **fields)): the owner
+        #   wires it to record pool_acquire / compile / pool_evict
+        #   events. Always called OUTSIDE the pool lock; exceptions are
+        #   swallowed — accounting must never break a lease.
 
-    def acquire(self, key, build: Callable[[], "Engine"]) -> "Engine":
+    def _notify(self, kind: str, **fields) -> None:
+        obs = self.observer
+        if obs is None:
+            return
+        try:
+            obs(kind, **fields)
+        except Exception:  # noqa: BLE001 — see observer comment
+            pass
+
+    def acquire(self, key, build: Callable[[], "Engine"],
+                cause: Optional[str] = None) -> "Engine":
         """Lease the engine for ``key``: LRU hit (warm — milliseconds)
         or ``build()`` (cold — trace/compile; runs OUTSIDE the pool lock
         so one slow compile can't block every other bucket's lease, with
         a per-key latch so concurrent admits of the same signature
-        compile once)."""
+        compile once). ``cause`` labels the ledger event (admission /
+        quality / precompile / …)."""
         while True:
             with self._lock:
                 if self.closed:
@@ -539,12 +600,19 @@ class ProgramPool:
                     self._entries.move_to_end(key)
                     ent[1] += 1
                     self.hits += 1
-                    return ent[0]
+                    engine = ent[0]
+                    break
                 latch = self._building.get(key)
                 if latch is None:
                     self._building[key] = latch = threading.Event()
+                    engine = None
                     break
             latch.wait(timeout=300.0)  # builder finished (or died): re-check
+        if engine is not None:
+            self._notify("pool_acquire", cause=cause, key=key,
+                         cache="hit", engine=engine)
+            return engine
+        t_build = time.perf_counter()
         try:
             engine = build()
         except BaseException:
@@ -552,6 +620,7 @@ class ProgramPool:
                 self._building.pop(key, None)
             latch.set()
             raise
+        build_ms = (time.perf_counter() - t_build) * 1e3
         with self._lock:
             if self.closed:
                 # close() raced the build: the pool's free sweep already
@@ -571,8 +640,9 @@ class ProgramPool:
         if raced_close:
             engine.free()
             raise RuntimeError("program pool is closed")
-        for e in evicted:
-            e.free()
+        self._notify("compile", cause=cause, key=key, cache="miss",
+                     wall_ms=build_ms, engine=engine)
+        self._free_evicted(evicted)
         return engine
 
     def adopt(self, key, engine: "Engine") -> None:
@@ -594,8 +664,7 @@ class ProgramPool:
             self._entries.move_to_end(key)
             _register_pool_engine(engine)
             evicted = self._evict_over_capacity_locked()
-        for e in evicted:
-            e.free()
+        self._free_evicted(evicted)
 
     def release(self, key) -> None:
         """Drop one lease. The program STAYS warm (that is the point —
@@ -608,8 +677,7 @@ class ProgramPool:
                 return
             ent[1] = max(0, ent[1] - 1)
             evicted = self._evict_over_capacity_locked()
-        for e in evicted:
-            e.free()
+        self._free_evicted(evicted)
 
     def replace(self, key, engine: "Engine") -> None:
         """Swap the live engine under an existing lease (supervised
@@ -620,7 +688,7 @@ class ProgramPool:
         re-enters WARM (lease 0): nothing holds it, so capacity
         pressure may evict it immediately."""
         old = None
-        evicted: List["Engine"] = []
+        evicted: List[Tuple[Any, "Engine"]] = []
         with self._lock:
             if self.closed:
                 raced_close = True
@@ -638,26 +706,33 @@ class ProgramPool:
         if raced_close:
             engine.free()
             raise RuntimeError("program pool is closed")
-        for e in evicted:
-            e.free()
+        self._free_evicted(evicted)
         if old is not None and old is not engine:
             old.free()
 
-    def _evict_over_capacity_locked(self) -> List["Engine"]:
+    def _evict_over_capacity_locked(self) -> List[Tuple[Any, "Engine"]]:
         """Pop LRU un-leased entries while over capacity; leased entries
         are skipped (a live program can't be freed under its bucket), so
         the pool may transiently exceed capacity when every entry is
-        leased — bounded by the frontend's max_buckets."""
-        out: List["Engine"] = []
+        leased — bounded by the frontend's max_buckets. Returns
+        ``(key, engine)`` pairs for the caller to free (and ledger)
+        outside the lock."""
+        out: List[Tuple[Any, "Engine"]] = []
         if len(self._entries) <= self.capacity:
             return out
         for key in list(self._entries):
             if len(self._entries) <= self.capacity:
                 break
             if self._entries[key][1] == 0:
-                out.append(self._entries.pop(key)[0])
+                out.append((key, self._entries.pop(key)[0]))
                 self.evictions += 1
         return out
+
+    def _free_evicted(self, evicted: List[Tuple[Any, "Engine"]]) -> None:
+        for key, e in evicted:
+            e.free()
+            self._notify("pool_evict", cause="capacity", key=key,
+                         engine=e)
 
     def evict(self, key) -> bool:
         """Explicitly drop one un-leased entry (tests; manual cache
@@ -669,6 +744,7 @@ class ProgramPool:
             engine = self._entries.pop(key)[0]
             self.evictions += 1
         engine.free()
+        self._notify("pool_evict", cause="manual", key=key, engine=engine)
         return True
 
     def warm_keys(self) -> List:

@@ -53,6 +53,7 @@ and the burstiness of the H2D queue.
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -73,6 +74,24 @@ INGEST_MODES = ("streamed", "monolithic")
 # tunnel). Tests that exercise the streaming machinery at tiny sizes
 # monkeypatch this to 0.
 MIN_STREAM_H2D_MS = 2.0
+
+# Host-slab accounting registry (obs.memory): every live assembler is
+# weakly tracked so the scrape-time gauges — and the conftest
+# session-end guard asserting zero OCCUPIED slabs once every owner has
+# closed — can walk host staging memory without owners wiring anything.
+_LIVE_ASSEMBLERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_assemblers() -> List["ShardedBatchAssembler"]:
+    """Every assembler still referenced anywhere in the process (a
+    released one stays listed but reports 0 ``slab_bytes``)."""
+    return list(_LIVE_ASSEMBLERS)
+
+
+def occupied_slab_bytes() -> int:
+    """Total host staging bytes currently pinned by live assemblers —
+    the ingest half of ``dvf_mem_host_slab_bytes``."""
+    return sum(a.slab_bytes() for a in live_assemblers())
 
 
 def _span(slc: slice, dim: int) -> Tuple[int, int]:
@@ -155,6 +174,21 @@ class ShardedBatchAssembler:
         self.effective_mode = self._plan()
         self.stats.effective_mode = self.effective_mode
         self.stats.pool_allocs += 1
+        _LIVE_ASSEMBLERS.add(self)
+
+    def slab_bytes(self) -> int:
+        """Host staging memory this assembler currently pins (streamed
+        shard slabs, the monolithic pool, the decode scratch) — 0 after
+        :meth:`release`. The memory-accounting gauge's source."""
+        total = 0
+        for c in self._chunks:
+            for slot in c.slabs:
+                total += sum(a.nbytes for a in slot.values())
+        if self._mono_pool is not None:
+            total += sum(a.nbytes for a in self._mono_pool)
+        if self._scratch is not None:
+            total += self._scratch.nbytes
+        return total
 
     # -- layout planning -------------------------------------------------
 

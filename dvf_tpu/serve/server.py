@@ -59,10 +59,16 @@ import numpy as np
 
 from dvf_tpu.api.filter import Filter, FilterChain
 from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
+from dvf_tpu.obs import ledger as ledger_mod
+from dvf_tpu.obs.ledger import ReconfigLedger
 from dvf_tpu.obs.lineage import (
     AttributionPlane,
     load_stage_profile,
     save_stage_profile,
+)
+from dvf_tpu.obs.memory import (
+    LeakTrendWatch,
+    attach_memory_provider,
 )
 from dvf_tpu.obs.metrics import EgressStats, IngestStats, LatencyStats
 from dvf_tpu.obs.registry import (
@@ -106,7 +112,14 @@ from dvf_tpu.serve.session import (
 
 # Trace track ids (one lane per stage, the pipeline's convention):
 # dispatch staging, device span, per-shard H2D / D2H transfer lanes.
+# The reconfiguration ledger stamps its events on its own lane
+# (obs.ledger.TRACK_LEDGER = 6), clear of all of these.
 TRACK_DISPATCH, TRACK_DEVICE, TRACK_H2D, TRACK_D2H = 0, 1, 3, 4
+
+# dvf_compile_ms histogram bounds: serving compiles span sub-ms pool
+# hits through multi-second cold XLA runs.
+COMPILE_MS_BOUNDS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                     1000.0, 2500.0, 5000.0, 10000.0)
 
 
 @dataclasses.dataclass
@@ -210,6 +223,15 @@ class ServeConfig:
     #   per-component costs written at bucket retirement/stop, loaded at
     #   bucket creation to seed tick-cost estimates and annotate
     #   control-plane decisions. None = no persistence.
+    ledger: bool = True           # compile & reconfiguration ledger +
+    #   memory accounting (obs.ledger / obs.memory): every compile,
+    #   pool acquire/evict, batch resize, quality rebind, and engine
+    #   rebuild lands as a structured event (cause, wall cost, measured
+    #   bucket stall) in a bounded ring — stats()["ledger"], /ledger,
+    #   the dvf_compile_ms histogram, dvf_mem_* gauges, a dedicated
+    #   Perfetto lane, and flight-dump ledger.json. Default ON: events
+    #   are reconfiguration-rate, not frame-rate (overhead gated ≤2%
+    #   fps by benchmarks/LEDGER_BENCH.json). False = none of it.
 
 
 class _Bucket:
@@ -275,6 +297,10 @@ class _Bucket:
         self.fetcher: Optional[ShardedBatchFetcher] = None
         self.egress_stats: Optional[EgressStats] = None
         self._tick_cost_ms: Optional[float] = None  # live EWMA
+        self.last_dispatch_t: Optional[float] = None  # wall clock of
+        #   this bucket's most recent batch submit — the reconfiguration
+        #   ledger measures a bucket stall as the gap in these ticks
+        #   around an event (obs.ledger.ReconfigLedger.note_dispatch)
         self._label_cache: Optional[str] = None
         self._label_key: Optional[SignatureKey] = None
         self.stage_profile: Optional[dict] = None  # persisted
@@ -479,6 +505,21 @@ class ServeFrontend:
         attach_signal_provider(
             self.registry, "serve", self.signals,
             labels={"replica": label} if label else None)
+        # -- compile & reconfiguration ledger + memory accounting ----------
+        self.ledger: Optional[ReconfigLedger] = None
+        self.compile_hist = None
+        self._leak_watch: Optional[LeakTrendWatch] = None
+        if self.config.ledger:
+            self.ledger = ReconfigLedger(tracer=self.tracer)
+            # Every compile, labeled by canonical signature AND cause
+            # (admission/resize/quality/recovery/precompile) — the
+            # distribution the hot-swap work will be judged against.
+            self.compile_hist = self.registry.histogram(
+                "compile_ms", COMPILE_MS_BOUNDS)
+            self.pool.observer = self._on_pool_event
+            attach_memory_provider(self.registry,
+                                   bucket_rows_fn=self._memory_bucket_rows)
+            self._leak_watch = LeakTrendWatch()
         # -- frame-lineage attribution plane (obs.lineage) -----------------
         self.attribution: Optional[AttributionPlane] = None
         if self.config.lineage:
@@ -544,7 +585,9 @@ class ServeFrontend:
                 ring=self.telemetry,
                 jax_profile_s=self.config.flight_profile_s,
                 lineage_fn=(self.attribution.snapshot
-                            if self.attribution is not None else None))
+                            if self.attribution is not None else None),
+                ledger_fn=(self.ledger.document
+                           if self.ledger is not None else None))
         self.registry.register_provider(self._bucket_samples)
         #   per-bucket queue depth / p99 + the compile-cache counters
         #   (dvf_compile_cache_hits_total / _misses_total,
@@ -662,6 +705,18 @@ class ServeFrontend:
         self.pool.close()
         for b in buckets:
             b.engine.free()
+            # Release every bucket's host staging/delivery slabs
+            # eagerly (the retirement path already does; live buckets
+            # must too): the memory-accounting session-end guard pins
+            # that a closed frontend leaves ZERO occupied host slabs.
+            a, b.assembler = b.assembler, None
+            f, b.fetcher = b.fetcher, None
+            if a is not None:
+                a.release()
+            if f is not None:
+                f.release()
+            if self.ledger is not None:
+                self.ledger.abandon_stalls(b.label())
         if self.config.profile_dir:
             # Persist this run's measured per-signature stage costs
             # (sibling of the compile cache): the next run's buckets —
@@ -905,6 +960,13 @@ class ServeFrontend:
             out["ingest_overlap_efficiency"] = ing.overlap_efficiency()
         if egr is not None:
             out["egress_overlap_efficiency"] = egr.overlap_efficiency()
+        if self.ledger is not None:
+            out.update(self.ledger.signals())
+            # Occupied host staging/delivery slabs (cheap per-bucket
+            # sums) — also the leak-trend watch's input via the ring.
+            slab, state = self._slab_state_bytes(buckets)
+            out["mem_host_slab_bytes"] = float(slab)
+            out["mem_device_state_bytes"] = float(state)
         if self.attribution is not None:
             # Frame-lineage attribution: per-component p99 over the
             # window (attr_<component>_p99_ms) + lineage counters —
@@ -988,6 +1050,15 @@ class ServeFrontend:
                 # broken burn trigger must stay visible on the same
                 # containment counter a raising hook lands on.
                 self.telemetry.hook_errors += 1
+        if self._leak_watch is not None:
+            try:
+                trip = self._leak_watch.observe(
+                    cur.get("mem_host_slab_bytes"))
+                if trip is not None:
+                    self._flight_trip(trip)
+            except Exception:  # noqa: BLE001 — same containment rule as
+                if self.telemetry is not None:  # the burn check above
+                    self.telemetry.hook_errors += 1
         if self.control_plane is not None:
             self.control_plane.on_sample(prev, cur)
 
@@ -1018,6 +1089,124 @@ class ServeFrontend:
         not extend the stall it is recording."""
         if self.flight is not None:
             self.flight.trigger_async(reason)
+
+    # -- reconfiguration ledger + memory accounting ----------------------
+
+    def _on_pool_event(self, kind: str, cause=None, key=None, cache=None,
+                       wall_ms=None, engine=None, **_extra) -> None:
+        """ProgramPool observer: pool hits, cold compiles, and evictions
+        land in the ledger; compiles also feed the dvf_compile_ms
+        histogram. Called outside the pool lock; never raises into a
+        lease (the pool swallows, but stay cheap anyway)."""
+        led = self.ledger
+        if led is None:
+            return
+        sig = key.render() if hasattr(key, "render") else (
+            str(key) if key is not None else None)
+        cause = cause or ledger_mod.CAUSE_ADMISSION
+        if kind == "compile":
+            compile_ms = getattr(engine, "last_compile_ms", None)
+            if compile_ms is None:
+                compile_ms = wall_ms
+            led.record(ledger_mod.COMPILE, cause=cause, signature=sig,
+                       cache=cache, wall_ms=wall_ms,
+                       compile_ms=(round(float(compile_ms), 3)
+                                   if compile_ms is not None else None))
+            self._observe_compile(compile_ms, sig, cause)
+        elif kind == "pool_acquire":
+            led.record(ledger_mod.POOL_ACQUIRE, cause=cause,
+                       signature=sig, cache=cache, wall_ms=0.0)
+        elif kind == "pool_evict":
+            led.record(ledger_mod.POOL_EVICT, cause=cause, signature=sig,
+                       freed_bytes=getattr(engine, "state_bytes", None))
+
+    def _observe_compile(self, compile_ms, signature, cause) -> None:
+        if self.compile_hist is not None and compile_ms is not None:
+            self.compile_hist.observe(
+                float(compile_ms),
+                labels={"signature": signature or "unpinned",
+                        "cause": cause or "unknown"})
+
+    def _record_inline_compile(self, bucket: "_Bucket", before: int,
+                               cause: str) -> None:
+        """Ledger a compile that ran OUTSIDE the pool (the default
+        bucket's lazy first pin in ``_builder_for``, a resize's
+        recompile): ``before`` is the engine's compile_count before the
+        ``ensure_compiled`` call — unchanged means no compile ran."""
+        led = self.ledger
+        eng = bucket.engine
+        if led is None or eng.stats.compile_count == before:
+            return
+        sig = bucket.label()
+        compile_ms = eng.last_compile_ms
+        led.record(ledger_mod.COMPILE, cause=cause, signature=sig,
+                   bucket=sig, cache="miss",
+                   wall_ms=compile_ms,
+                   compile_ms=(round(float(compile_ms), 3)
+                               if compile_ms is not None else None))
+        self._observe_compile(compile_ms, sig, cause)
+
+    def _memory_bucket_rows(self) -> List[dict]:
+        """Per-bucket memory attribution for the dvf_mem_* gauges:
+        device-resident state (measured at compile) + occupied host
+        staging/delivery slabs. Scrape-time only."""
+        with self._lock:
+            buckets = list(self._buckets)
+        rows = []
+        for b in buckets:
+            a, f = b.assembler, b.fetcher
+            rows.append({
+                "bucket": b.label(),
+                "device_state_bytes": getattr(b.engine, "state_bytes", 0),
+                "host_slab_bytes": ((a.slab_bytes() if a is not None else 0)
+                                    + (f.slab_bytes()
+                                       if f is not None else 0)),
+            })
+        return rows
+
+    @staticmethod
+    def _slab_state_bytes(buckets) -> tuple:
+        """(host slab bytes, device state bytes) over an
+        already-snapshotted bucket list — ONE copy of the sum shared by
+        signals() and _host_slab_bytes. Fields are captured once per
+        bucket: a concurrent resize/recovery nulls b.assembler under
+        the frontend lock, and a check-then-call would race it."""
+        slab = state = 0
+        for b in buckets:
+            a, f = b.assembler, b.fetcher
+            if a is not None:
+                slab += a.slab_bytes()
+            if f is not None:
+                slab += f.slab_bytes()
+            state += getattr(b.engine, "state_bytes", 0) or 0
+        return slab, state
+
+    def _host_slab_bytes(self) -> int:
+        """This frontend's occupied host staging memory (cheap sums —
+        a handful of buckets), the signals()/leak-watch input."""
+        with self._lock:
+            buckets = list(self._buckets)
+        return self._slab_state_bytes(buckets)[0]
+
+    def _memory_stats(self) -> dict:
+        """The ``stats()['memory']`` row: per-bucket attributed host
+        slabs + device state. The process-wide jax live-buffer WALK is
+        deliberately absent here — it runs only on the /metrics scrape
+        (obs.memory.attach_memory_provider), never in a stats() poll
+        loop."""
+        rows = self._memory_bucket_rows()
+        return {
+            "host_slab_bytes": sum(r["host_slab_bytes"] for r in rows),
+            "device_state_bytes": sum(r["device_state_bytes"]
+                                      for r in rows),
+            "by_bucket": {r["bucket"]: {
+                "host_slab_bytes": r["host_slab_bytes"],
+                "device_state_bytes": r["device_state_bytes"],
+            } for r in rows},
+            "pool": {
+                "engines": len(self.pool),
+            },
+        }
 
     # -- client API ------------------------------------------------------
 
@@ -1245,6 +1434,11 @@ class ServeFrontend:
                 self.config.profile_dir, key.render())
         self._buckets.append(b)
         self._bucket_by_key[key] = b
+        if self.ledger is not None:
+            self.ledger.record(ledger_mod.BUCKET_CREATE,
+                               signature=key.render(),
+                               bucket=key.render(),
+                               open_buckets=len(self._buckets))
         return b
 
     def _retire_bucket_locked(self, bucket: "_Bucket") -> None:
@@ -1275,10 +1469,23 @@ class ServeFrontend:
             a.release()
         if f is not None:
             f.release()
+        if self.ledger is not None:
+            label = bucket.label()
+            # A retired bucket never dispatches again: close out any
+            # stall window it owned rather than let it dangle.
+            self.ledger.abandon_stalls(label)
+            self.ledger.record(ledger_mod.BUCKET_RETIRE, bucket=label,
+                               signature=(bucket.key.render()
+                                          if bucket.key is not None
+                                          else None),
+                               open_buckets=len(self._buckets))
 
-    def _acquire_program(self, key: SignatureKey) -> Engine:
+    def _acquire_program(self, key: SignatureKey,
+                         cause: str = ledger_mod.CAUSE_ADMISSION) -> Engine:
         """Lease (or AOT-compile) the program for ``key`` — the
-        admission-time compile that replaces the first-frame JIT stall."""
+        admission-time compile that replaces the first-frame JIT stall.
+        ``cause`` labels the ledger/histogram record (admission /
+        quality / precompile)."""
         def build() -> Engine:
             with self._lock:
                 filt = self._filters_by_chain.get(key.op_chain)
@@ -1299,7 +1506,7 @@ class ServeFrontend:
             return eng
 
         try:
-            return self.pool.acquire(key, build)
+            return self.pool.acquire(key, build, cause=cause)
         except AdmissionError:
             with self._lock:
                 self.admission_rejections += 1
@@ -1334,7 +1541,7 @@ class ServeFrontend:
         warmed = []
         for entry in parse_manifest(manifest):
             key = entry["key"]
-            self._acquire_program(key)
+            self._acquire_program(key, cause=ledger_mod.CAUSE_PRECOMPILE)
             self.pool.release(key)  # stays warm, un-leased
             warmed.append(key.render())
         return warmed
@@ -1402,12 +1609,15 @@ class ServeFrontend:
         f = 1 << (s.quality_level + 1)
         return len(shape) >= 2 and shape[0] % f == 0 and shape[1] % f == 0
 
-    def request_batch_size(self, bucket_label: str, n: int) -> bool:
+    def request_batch_size(self, bucket_label: str, n: int,
+                           reason: Optional[str] = None) -> bool:
         """Queue a per-bucket batch resize; the dispatch thread applies
         it once that bucket has nothing in flight (a resize recompiles
         the program — through the pool and the persistent cache, so a
         previously-seen size costs a deserialize). False = no such
-        bucket (it retired between decide and apply)."""
+        bucket (it retired between decide and apply). ``reason``
+        (the controller's decision rationale) rides into the ledger's
+        batch_resize event."""
         n = max(1, int(n))
         with self._lock:
             for b in self._buckets:
@@ -1415,7 +1625,7 @@ class ServeFrontend:
                     if n == b.batch_size:
                         self._pending_resizes.pop(b, None)
                     else:
-                        self._pending_resizes[b] = n
+                        self._pending_resizes[b] = (n, reason)
                     return True
         return False
 
@@ -1437,7 +1647,8 @@ class ServeFrontend:
         same off-thread flight dump as the watchdog/budget paths."""
         self._flight_trip(reason)
 
-    def request_session_quality(self, session_id: str, level: int) -> bool:
+    def request_session_quality(self, session_id: str, level: int,
+                                reason: Optional[str] = None) -> bool:
         """Move one session to quality ``level`` (0 = full). Builds or
         leases the downshift bucket's program HERE (apply thread — a
         compile must not stall sampling or dispatch), then hands the
@@ -1474,7 +1685,7 @@ class ServeFrontend:
             self._ensure_quality_bucket(key, base_chain, level)
         except AdmissionError:
             return False
-        self._pending_rebinds.put((session_id, key, level))
+        self._pending_rebinds.put((session_id, key, level, reason))
         return True
 
     def _quality_key(self, base_chain: str, shape: tuple, dtype,
@@ -1525,7 +1736,8 @@ class ServeFrontend:
 
         def warm():
             try:
-                self._acquire_program(key)
+                self._acquire_program(key,
+                                      cause=ledger_mod.CAUSE_QUALITY)
                 self.pool.release(key)
             except Exception:  # noqa: BLE001 — a failed warm only means
                 with self._lock:   # the first downshift pays the
@@ -1566,7 +1778,8 @@ class ServeFrontend:
                 self._register_quality_chain_locked(key, base_chain,
                                                     1 << level)
             self._check_bucket_headroom_locked(key)
-        engine = self._acquire_program(key)
+        engine = self._acquire_program(key,
+                                       cause=ledger_mod.CAUSE_QUALITY)
         owned = False
         try:
             with self._lock:
@@ -1588,7 +1801,7 @@ class ServeFrontend:
         controller re-decides from a later window."""
         while True:
             try:
-                sid, key, level = self._pending_rebinds.get_nowait()
+                sid, key, level, reason = self._pending_rebinds.get_nowait()
             except queue.Empty:
                 return
             with self._lock:
@@ -1601,15 +1814,30 @@ class ServeFrontend:
                     self.quality_rebinds_dropped += 1
                     continue
                 old = s.bucket if s.bucket is not None else self._buckets[0]
+                flushed = 0
                 if target is not old:
-                    self.quality_flushed_frames += s.flush_queued(
-                        count_shed=False)
+                    flushed = s.flush_queued(count_shed=False)
+                    self.quality_flushed_frames += flushed
                     old.sessions.pop(sid, None)
                     target.sessions[sid] = s
                     s.bucket = target
                 s.quality_level = level
                 s.quality_shifts += 1
                 self.quality_rebinds += 1
+                stall_from = (target.last_dispatch_t
+                              if target.last_dispatch_t is not None
+                              else time.time())
+            if self.ledger is not None:
+                # Stall window on the TARGET bucket: the gap until the
+                # downshifted program first serves — the tenant-visible
+                # cost of the move (its compile was ledgered separately
+                # under cause=quality when the bucket was built/warmed).
+                self.ledger.record(
+                    ledger_mod.QUALITY_REBIND,
+                    cause=ledger_mod.CAUSE_QUALITY,
+                    signature=key.render(), bucket=target.label(),
+                    session=sid, level=level, frames_flushed=flushed,
+                    reason=reason, stall_from=stall_from)
 
     def _apply_resizes_dispatch(self) -> None:
         """Dispatch-thread half of a batch resize: initiated only while
@@ -1624,7 +1852,7 @@ class ServeFrontend:
         ``_recover_lock``."""
         with self._lock:
             pending = list(self._pending_resizes.items())
-        for bucket, n in pending:
+        for bucket, (n, reason) in pending:
             with self._lock:
                 # Liveness checked HERE, under the same lock that
                 # retires buckets: a pre-loop snapshot could let a
@@ -1636,7 +1864,7 @@ class ServeFrontend:
                     continue
                 if bucket.resizing or bucket.inflight_batches != 0:
                     continue  # retry next tick
-                if self._pending_resizes.get(bucket) != n:
+                if self._pending_resizes.get(bucket) != (n, reason):
                     continue  # superseded since the snapshot above
                 self._pending_resizes.pop(bucket, None)
                 if bucket.frame_shape is None:
@@ -1644,23 +1872,40 @@ class ServeFrontend:
                     # to swap, the first batch compiles at the new one.
                     bucket.batch_size = n
                     bucket.assembler = None
+                    if self.ledger is not None:
+                        self.ledger.record(
+                            ledger_mod.BATCH_RESIZE,
+                            cause=ledger_mod.CAUSE_RESIZE,
+                            bucket=bucket.label(), batch_size=n,
+                            wall_ms=0.0, reason=reason)
                     continue
                 bucket.resizing = True
                 shape = (n, *bucket.frame_shape)
                 dtype = np.dtype(bucket.frame_dtype)
+                # The stall the ledger will charge this resize: from
+                # the bucket's last dispatch tick before it went
+                # quiescent to its first tick after the swap.
+                stall_from = (bucket.last_dispatch_t
+                              if bucket.last_dispatch_t is not None
+                              else time.time())
             threading.Thread(
-                target=self._resize_compile, args=(bucket, n, shape, dtype),
+                target=self._resize_compile,
+                args=(bucket, n, shape, dtype, stall_from, reason),
                 name="dvf-serve-resize", daemon=True).start()
 
     def _resize_compile(self, bucket: "_Bucket", n: int,
-                        shape: tuple, dtype) -> None:
+                        shape: tuple, dtype,
+                        stall_from: Optional[float] = None,
+                        reason: Optional[str] = None) -> None:
         """Off-dispatch half of a batch resize (see
         ``_apply_resizes_dispatch``): compile the bucket's program at
         the new batch shape while dispatch keeps the bucket quiescent,
         then swap the size in. Through the pool's persistent
         compilation cache a previously-seen size costs a deserialize.
         Failure is contained — the old size keeps serving."""
+        t0 = time.time()
         try:
+            before = bucket.engine.stats.compile_count
             with self._recover_lock:
                 bucket.engine.ensure_compiled(shape, dtype)
             self._adopt_bucket_key(bucket)  # takes self._lock itself
@@ -1669,9 +1914,34 @@ class ServeFrontend:
                 bucket.assembler = None  # staging re-derives from the
                 #   new program's sharding in _builder_for (which finds
                 #   the compile already done)
+            if self.ledger is not None:
+                compiled = bucket.engine.stats.compile_count != before
+                compile_ms = (bucket.engine.last_compile_ms
+                              if compiled else 0.0)
+                label = bucket.label()
+                self.ledger.record(
+                    ledger_mod.BATCH_RESIZE,
+                    cause=ledger_mod.CAUSE_RESIZE,
+                    signature=label, bucket=label, batch_size=n,
+                    wall_ms=(time.time() - t0) * 1e3,
+                    compile_ms=(round(float(compile_ms), 3)
+                                if compile_ms is not None else None),
+                    cache=("miss" if compiled else "hit"),
+                    reason=reason, t0=t0, stall_from=stall_from)
+                if compiled:
+                    self._observe_compile(compile_ms, label,
+                                          ledger_mod.CAUSE_RESIZE)
         except Exception:  # noqa: BLE001 — counted, never raised into
             with self._lock:               # the serving path
                 self.resize_compile_errors += 1
+            if self.ledger is not None:
+                self.ledger.record(
+                    ledger_mod.BATCH_RESIZE,
+                    cause=ledger_mod.CAUSE_RESIZE,
+                    bucket=bucket.label(), batch_size=n,
+                    wall_ms=(time.time() - t0) * 1e3,
+                    reason="resize compile failed (old size keeps "
+                           "serving)", t0=t0)
         finally:
             with self._lock:
                 bucket.resizing = False
@@ -1811,7 +2081,14 @@ class ServeFrontend:
         shape = (bucket.batch_size, *bucket.frame_shape)
         dtype = np.dtype(bucket.frame_dtype)
         if bucket.assembler is None or bucket.assembler.batch_shape != shape:
+            before = bucket.engine.stats.compile_count
             bucket.engine.ensure_compiled(shape, dtype)
+            # A compile that actually ran here is the legacy lazy pin
+            # (default bucket, first traffic) — ledger it as an
+            # admission-cause compile ON THE DISPATCH THREAD, which is
+            # exactly the JIT stall the AOT path exists to avoid.
+            self._record_inline_compile(bucket, before,
+                                        ledger_mod.CAUSE_ADMISSION)
             self._adopt_bucket_key(bucket)
             bucket.ingest_stats = IngestStats(
                 requested_mode=self.config.ingest,
@@ -2066,6 +2343,10 @@ class ServeFrontend:
                     all_buckets = list(self._buckets)
                 targets = affected or set(all_buckets)
                 for b in targets:
+                    t_rb = time.time()
+                    stall_from = (b.last_dispatch_t
+                                  if b.last_dispatch_t is not None
+                                  else t_rb)
                     b.engine = b.engine.rebuild()
                     if b._pooled and b.key is not None:
                         try:
@@ -2075,9 +2356,32 @@ class ServeFrontend:
                             # replace() freed the rebuilt engine — the
                             # frontend is past serving this bucket.
                             pass
-                    b.assembler = None
-                    b.fetcher = None  # re-derive from the fresh engine's
-                    #   re-calibrated d2h_block_ms
+                    a, b.assembler = b.assembler, None
+                    f, b.fetcher = b.fetcher, None  # re-derive from the
+                    #   fresh engine's re-calibrated d2h_block_ms; slabs
+                    #   released eagerly so the memory accounting never
+                    #   counts an abandoned pool as occupied
+                    if a is not None:
+                        a.release()
+                    if f is not None:
+                        f.release()
+                    if self.ledger is not None:
+                        label = b.label()
+                        compile_ms = b.engine.last_compile_ms
+                        self.ledger.record(
+                            ledger_mod.ENGINE_REBUILD,
+                            cause=ledger_mod.CAUSE_RECOVERY,
+                            signature=label, bucket=label,
+                            fault_kind=kind, reason=reason,
+                            wall_ms=(time.time() - t_rb) * 1e3,
+                            compile_ms=(round(float(compile_ms), 3)
+                                        if compile_ms is not None
+                                        else None),
+                            t0=t_rb, stall_from=stall_from)
+                        if compile_ms is not None:
+                            self._observe_compile(
+                                compile_ms, label,
+                                ledger_mod.CAUSE_RECOVERY)
                 # Second straggler sweep: a dispatch iteration that was
                 # mid-staging when the drain above ran (wedged past the
                 # park deadline) has had the whole engine rebuild to land
@@ -2256,6 +2560,15 @@ class ServeFrontend:
                 self._window.add(seq, plan)
                 bucket.adjust_inflight(1)
                 q.put((seq, plan, result, t0))
+                # Ledger stall accounting: this tick is the bucket's
+                # dispatch heartbeat — it closes any reconfiguration
+                # stall window open on the bucket (gap measured from
+                # the last tick before the event to THIS one). One
+                # attribute check when nothing is pending.
+                bucket.last_dispatch_t = t0
+                led = self.ledger
+                if led is not None and led.has_pending_stalls:
+                    led.note_dispatch(bucket.label(), t0)
                 seq += 1
         except BaseException as e:  # noqa: BLE001
             self._fail(e)
@@ -2424,6 +2737,9 @@ class ServeFrontend:
                if self.tracer.enabled else {}),
             **({"attribution": self.attribution.summary()}
                if self.attribution is not None else {}),
+            **({"ledger": self.ledger.summary(),
+                "memory": self._memory_stats()}
+               if self.ledger is not None else {}),
             **({"flight": self.flight.stats()}
                if self.flight is not None else {}),
             **({"control": {

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate: the full tier-1 test suite (ROADMAP.md's verify line)
+# PLUS the perf-regression sentinel (benchmarks/sentinel.py --quick).
+# Exit nonzero on a test failure OR a measured perf regression — the
+# same bar the GitHub Actions workflow (.github/workflows/ci.yml)
+# enforces on every push.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 test suite =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci_tier1: TEST FAILURE (pytest rc=$rc)" >&2
+    exit "$rc"
+fi
+
+echo "== perf-regression sentinel =="
+JAX_PLATFORMS=cpu python benchmarks/sentinel.py --quick
+src=$?
+if [ "$src" -ne 0 ]; then
+    echo "ci_tier1: PERF REGRESSION (sentinel rc=$src)" >&2
+    exit "$src"
+fi
+
+echo "ci_tier1: clean"

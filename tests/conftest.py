@@ -98,6 +98,13 @@ def pytest_configure(config):
                    "bounded wall time; run in tier-1, select with "
                    "-m lineage)")
     config.addinivalue_line(
+        "markers", "ledger: compile/reconfiguration ledger, memory "
+                   "accounting, and perf-regression sentinel tests "
+                   "(bounded event ring, measured bucket stalls, "
+                   "dvf_mem_* gauges, sentinel exit codes — CPU "
+                   "backend, bounded wall time; run in tier-1, select "
+                   "with -m ledger)")
+    config.addinivalue_line(
         "markers", "elastic: controller-driven fleet autoscaling tests "
                    "(deterministic scale-decision replay, warm standby "
                    "pool, spawn/retire actuators, SIGKILL-during-scale-in "
@@ -173,6 +180,56 @@ def _pool_engines_freed_on_close():
         f"program-pool engines leaked (frontend stop() not called, or no "
         f"longer freeing?): "
         f"{[getattr(e, 'op_chain', '?') for e in leaked]}")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _memory_accounting_clean_at_session_end():
+    """The obs.memory accounting must read ZERO once every owner has
+    closed: no residual pool-engine device state, no occupied host
+    staging/delivery slabs. Extends the pool-engine guard above with
+    the PR-13 memory plane — a stop path that stops releasing slabs
+    (or an engine whose free() stops dropping state) fails the build
+    here instead of growing a long-lived server's RSS forever. Only
+    consults registries for modules actually imported; gc first (test-
+    local frontends may still be reachable from frame locals until
+    collection), then a grace window like the sibling guards."""
+    yield
+    import gc
+    import sys as _sys
+
+    ing = _sys.modules.get("dvf_tpu.runtime.ingest")
+    egr = _sys.modules.get("dvf_tpu.runtime.egress")
+    eng = _sys.modules.get("dvf_tpu.runtime.engine")
+    if ing is None and egr is None and eng is None:
+        return
+    gc.collect()
+
+    def residual():
+        out = {}
+        if ing is not None:
+            b = ing.occupied_slab_bytes()
+            if b:
+                out["ingest_slab_bytes"] = b
+        if egr is not None:
+            b = egr.occupied_slab_bytes()
+            if b:
+                out["egress_slab_bytes"] = b
+        if eng is not None:
+            b = sum(getattr(e, "state_bytes", 0) or 0
+                    for e in eng.live_pool_engines())
+            if b:
+                out["pool_device_state_bytes"] = b
+        return out
+
+    deadline = time.time() + 5.0
+    leaked = residual()
+    while leaked and time.time() < deadline:
+        time.sleep(0.1)
+        gc.collect()
+        leaked = residual()
+    assert not leaked, (
+        f"memory accounting reads nonzero at session end (a stop() path "
+        f"stopped releasing slabs / freeing device state?): {leaked}")
 
 
 @pytest.fixture

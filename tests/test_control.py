@@ -65,14 +65,14 @@ class _FakeActuator:
     def control_view(self):
         return {}
 
-    def request_batch_size(self, label, n):
+    def request_batch_size(self, label, n, reason=None):
         self.calls.append(("resize", label, n))
         return True
 
     def set_tick_interval(self, t):
         self.calls.append(("tick", t))
 
-    def request_session_quality(self, sid, level):
+    def request_session_quality(self, sid, level, reason=None):
         self.calls.append(("quality", sid, level))
         return True
 
