@@ -24,7 +24,8 @@ cites. This sentinel is the CI gate that re-reads — and re-measures:
    tier-1 pins) trips it by an order of magnitude.
 
 3. **Fresh bench diffs** (``--full``): quick-mode re-runs of the
-   normalized-record writers (attr_bench, ledger_bench, admit_bench)
+   normalized-record writers (attr_bench, ledger_bench, audit_bench,
+   admit_bench)
    diffed metric-by-metric against the committed records
    (``benchtools.sentinel_record`` — ratios and overhead fractions
    only, never absolute fps).
@@ -111,6 +112,18 @@ def baseline_gates():
                 acc.get("overhead_budget_frac", 0.02))
         gate("LEDGER_BENCH", "ledger_overhead_frac",
              m is not None and m <= t, f"{m} <= {t}")
+    doc = _load("AUDIT_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("measured_overhead_frac"),
+                acc.get("overhead_budget_frac", 0.03))
+        gate("AUDIT_BENCH", "audit_overhead_frac",
+             m is not None and m <= t, f"{m} <= {t}")
+        gate("AUDIT_BENCH", "audit_zero_false_positives",
+             acc.get("replay_mismatches_total") == 0
+             and acc.get("swap_guard_mismatches_total") == 0,
+             f"replay {acc.get('replay_mismatches_total')} == 0, "
+             f"guard {acc.get('swap_guard_mismatches_total')} == 0")
     doc = _load("ELASTIC_BENCH.json")
     if doc is not None:
         spawn = doc.get("spawn", {})
@@ -370,6 +383,7 @@ def fresh_bench_diffs():
     for mod_name, json_name, bench in (
             ("attr_bench", "ATTR_BENCH.json", "attr_bench"),
             ("ledger_bench", "LEDGER_BENCH.json", "ledger_bench"),
+            ("audit_bench", "AUDIT_BENCH.json", "audit_bench"),
             ("admit_bench", "ADMIT_BENCH.json", "admit_bench")):
         committed = _extract_record(_load(json_name), bench)
         if committed is None:

@@ -250,7 +250,7 @@ def _resolve_source(args, allow_shm: bool = True):
 
 
 def _start_exporter(args, registry, health_fn=None, ring=None,
-                    explain_fn=None, ledger_fn=None):
+                    explain_fn=None, ledger_fn=None, audit_fn=None):
     """--metrics-port: start the pull-based scrape endpoint (obs.export)
     over this invocation's registry. Returns the started exporter (None
     when the flag is absent). Port 0 binds an ephemeral port; the bound
@@ -262,10 +262,11 @@ def _start_exporter(args, registry, health_fn=None, ring=None,
 
     ex = MetricsExporter(registry, port=port, health_fn=health_fn,
                          ring=ring, explain_fn=explain_fn,
-                         ledger_fn=ledger_fn).start()
+                         ledger_fn=ledger_fn, audit_fn=audit_fn).start()
     endpoints = "/metrics /healthz /timeseries" + (
         " /explain" if explain_fn is not None else "") + (
-        " /ledger" if ledger_fn is not None else "")
+        " /ledger" if ledger_fn is not None else "") + (
+        " /audit" if audit_fn is not None else "")
     print(f"[metrics] {endpoints} on {ex.url}",
           file=sys.stderr, flush=True)
     return ex
@@ -362,7 +363,15 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         default_tier=args.tier if args.tier is not None else 1,
         lineage=args.lineage,
         profile_dir=args.profile_dir,
+        audit=args.audit,
+        audit_sample_every=args.audit_sample,
     )
+    if args.audit_wire:
+        print("[serve] note: --audit-wire has no framed transport in the "
+              "multi-session demo (streams are in-process); the "
+              "wire-integrity envelope rides the worker tier, "
+              "single-stream --transport ring, and the library "
+              "ZmqStreamBridge(audit_wire=True)", file=sys.stderr)
     frontend = ServeFrontend(filt, config, engine=engine)
     manifest = _load_manifest(args.precompile)
     if manifest is not None:
@@ -376,7 +385,10 @@ def _cmd_serve_multi(args, filt, engine) -> int:
                                            if args.lineage else None),
                                ledger_fn=(frontend.ledger.document
                                           if frontend.ledger is not None
-                                          else None))
+                                          else None),
+                               audit_fn=(frontend.audit.document
+                                         if frontend.audit is not None
+                                         else None))
 
     # Spread the streams across ~0.4×..1.6× the base rate: genuinely
     # different per-tenant cadences, so batches interleave sessions
@@ -520,6 +532,21 @@ def cmd_serve(args) -> int:
               "profiles need the serving frontend); single-stream runs "
               "report stage costs via stats() — use --sessions N or "
               "the fleet tier", file=sys.stderr)
+    if args.audit:
+        # Parser-accepted-but-ignored is the failure mode the --flight-dir
+        # audit fixed (PR 11); say it loudly instead of silently serving
+        # unaudited while the operator believes the detector is armed.
+        print("[serve] note: --audit (shadow replay + swap guard) is a "
+              "multi-session feature — it arms the serving frontend's "
+              "audit plane; use --sessions N or the fleet tier. "
+              "Single-stream runs can still arm the wire-integrity "
+              "envelope with --transport ring --audit-wire",
+              file=sys.stderr)
+    if args.audit_wire and args.transport != "ring":
+        print("[serve] note: --audit-wire needs a framed transport — "
+              "single-stream serve stamps/verifies on --transport ring "
+              "(the worker tier envelopes its ZMQ wire; the library "
+              "ZmqStreamBridge takes audit_wire=)", file=sys.stderr)
 
     queue = None
     if args.transport == "ring":
@@ -534,6 +561,12 @@ def cmd_serve(args) -> int:
             codec_threads=args.codec_threads,
             delta_tile=args.delta_tile,
             delta_keyframe_interval=args.delta_keyframe_interval,
+            # Wire-integrity envelope on the ring hop (obs.audit):
+            # stamped at put, verified at decode into staging —
+            # mismatches classify as `integrity` faults in the
+            # pipeline's containment.
+            audit_wire=args.audit_wire,
+            chaos=config.chaos,
         )
         if args.wire in ("jpeg", "delta"):
             # Host-codec budget check (SURVEY §7 hard part 3): the JPEG
@@ -738,7 +771,14 @@ def cmd_fleet(args) -> int:
         control=args.control,
         lineage=args.lineage,
         profile_dir=args.profile_dir,
+        audit=args.audit,
+        audit_sample_every=args.audit_sample,
     )
+    if args.audit_wire:
+        print("[fleet] note: --audit-wire has no framed transport at the "
+              "fleet front door (replica RPCs are length-prefixed "
+              "pickle, demo streams are in-process); arm it on worker "
+              "tiers / bridges at the edges", file=sys.stderr)
     autoscale = None
     if args.autoscale:
         try:
@@ -762,6 +802,8 @@ def cmd_fleet(args) -> int:
         chaos_seed=args.chaos_seed,
         devices_per_replica=args.devices_per_replica,
         flight_dir=args.flight_dir,
+        audit_interval_s=args.audit_interval,
+        audit_quarantine=args.audit_quarantine,
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
         precompile=_load_manifest(args.precompile),
         # Process-mode replicas share the persistent compilation cache
@@ -789,7 +831,8 @@ def cmd_fleet(args) -> int:
                                            if args.lineage else None),
                                ledger_fn=(fleet.ledger.document
                                           if fleet.ledger is not None
-                                          else None))
+                                          else None),
+                               audit_fn=fleet.audit_document)
 
     def drive(sid: str, rate: float, seed: int) -> None:
         src = SyntheticSource(height=args.height, width=args.width,
@@ -880,6 +923,11 @@ def cmd_fleet(args) -> int:
         "standby_warm": stats["standby_warm"],
         "scale_outs": stats["scale_outs"],
         "scale_ins": stats["scale_ins"],
+        # Audit plane: the divergence detector's counters (events ride
+        # /audit and the flight dumps; the demo line carries the tally).
+        "audit": {k: stats["audit"][k] for k in
+                  ("checks_total", "divergences_total",
+                   "quarantined_total")},
     }
     print(json.dumps(out, default=float))
     return 0
@@ -923,6 +971,7 @@ def cmd_worker(args) -> int:
         fault_window_s=args.fault_window,
         chaos=_parse_chaos(args),
         trace=args.trace,
+        audit_wire=args.audit_wire or args.audit,
     )
     # /timeseries is part of every tier's endpoint surface: give the
     # worker its 1 Hz signal window when the exporter is requested.
@@ -932,10 +981,17 @@ def cmd_worker(args) -> int:
 
         ring = TimeSeriesRing(worker.signals, interval_s=1.0,
                               name="dvf-worker-telemetry").start()
+    # Endpoint parity with serve/fleet: the worker's exporter serves
+    # /ledger (its compile events) and /audit (wire-integrity counters)
+    # beside /metrics /healthz /timeseries.
     exporter = _start_exporter(args, worker.registry,
                                health_fn=lambda: {"ok": True,
                                                   **worker.signals()},
-                               ring=ring)
+                               ring=ring,
+                               ledger_fn=(worker.ledger.document
+                                          if worker.ledger is not None
+                                          else None),
+                               audit_fn=worker.audit_document)
     flight = None
     if args.flight_dir:
         from dvf_tpu.obs.export import FlightRecorder
@@ -1509,6 +1565,31 @@ def main(argv=None) -> int:
                            "?format=json for JSON), /healthz, and "
                            "/timeseries on 127.0.0.1:PORT (0 = ephemeral; "
                            "the bound port is announced on stderr)")
+    obsp.add_argument("--audit", action="store_true",
+                      help="arm the audit plane (obs.audit): serve/fleet "
+                           "run sampled shadow-replay of delivered frames "
+                           "against a golden un-jitted path plus the "
+                           "program-swap equivalence guard; the worker "
+                           "arms its wire-integrity envelope. Exports "
+                           "stats()['audit'], dvf_audit_* metrics, and "
+                           "/audit on --metrics-port")
+    obsp.add_argument("--audit-sample", type=int, default=64,
+                      metavar="K",
+                      help="shadow-replay sampling period: every Kth "
+                           "staged frame is re-executed on the golden "
+                           "path (default 64)")
+    obsp.add_argument("--audit-wire", action="store_true",
+                      help="wire-integrity digest envelope on the framed "
+                           "transports this tier runs: the ZMQ worker "
+                           "(both directions) and single-stream serve "
+                           "--transport ring; an 8-byte blake2b stamped "
+                           "at encode, verified at every decode hop — "
+                           "mismatches are 'integrity' faults. Peers "
+                           "must speak the envelope (the library "
+                           "ZmqStreamBridge takes audit_wire=). Tiers "
+                           "with no framed transport in the invocation "
+                           "say so on stderr instead of silently "
+                           "ignoring the flag")
 
     # Shared by serve + fleet: the multi-signature serving plane
     # (signature buckets, compiled-program pool, AOT warm-start).
@@ -1704,6 +1785,17 @@ def main(argv=None) -> int:
                          "sum over healthy replicas")
     fl.add_argument("--health-poll", type=float, default=0.25,
                     help="replica health monitor cadence (seconds)")
+    fl.add_argument("--audit-interval", type=float, default=0.0,
+                    metavar="S",
+                    help="cross-replica divergence cadence: every S "
+                         "seconds an identical probe frame runs through "
+                         "every replica warm on a shared signature and "
+                         "the output digests are compared (0 = off; "
+                         "--audit arms the per-replica planes too)")
+    fl.add_argument("--audit-quarantine", action="store_true",
+                    help="retire (drain + replace) a replica the "
+                         "divergence detector flags, through the "
+                         "scale-in seam — instead of only flagging it")
     fl.add_argument("--devices-per-replica", type=int, default=0,
                     help="local mode: devices per replica engine "
                          "(0 = even split)")

@@ -165,6 +165,49 @@ class _Leader:
         with self.lock:
             self.sessions.pop(sid, None)
 
+    def audit_probe(self, signature=None) -> dict:
+        """Cross-replica divergence probe (obs.audit): the deterministic
+        probe frame through the GROUP's own data plane — an internal
+        one-frame session, so the digest covers exactly what a tenant
+        would receive from this replica (shards shipped to peers, the
+        collective, global-row reassembly and all). The probe tag is
+        the canonical op_chain, matching the single-host flavor's
+        ``engine_probe_row`` tag, so digests compare across flavors."""
+        import numpy as np
+
+        from dvf_tpu.obs.audit import frame_digest, probe_frame
+        from dvf_tpu.serve.session import ServeError
+
+        if signature is not None and signature != self.key_render:
+            raise ServeError(
+                f"multihost replica serves ONE signature "
+                f"{self.key_render}; asked to probe {signature!r}")
+        shape = tuple(self.cfg["frame_shape"])
+        dtype = np.dtype(self.cfg["frame_dtype"])
+        frame = probe_frame(shape, dtype, tag=self.cfg["op_chain"])
+        sid = f"__audit_probe_{self.seq}_{time.monotonic_ns()}__"
+        self.open_stream(sid)
+        try:
+            self.submit(sid, frame)
+            deadline = time.time() + 15.0
+            got: list = []
+            while not got and time.time() < deadline:
+                got = self.poll(sid, max_items=1)
+                if not got:
+                    time.sleep(0.01)
+            if not got:
+                raise ServeError("multihost audit probe timed out "
+                                 "(group data plane not serving)")
+            return {"signature": self.key_render,
+                    "digest": frame_digest(
+                        np.ascontiguousarray(got[0].frame)).hex()}
+        finally:
+            try:
+                self.close(sid, drain=False)
+                self.release(sid)
+            except Exception:  # noqa: BLE001 — probe cleanup best-effort
+                pass
+
     def begin_drain(self) -> None:
         with self.lock:
             self.draining = True
@@ -502,6 +545,8 @@ def main(argv=None) -> int:
                     out = srv.health()
                 elif kind == "stats":
                     out = srv.stats()
+                elif kind == "audit_probe":
+                    out = srv.audit_probe(op[1] if len(op) > 1 else None)
                 elif kind == "trace":
                     out = {"events": []}  # lean tier: no tracer lanes
                 else:

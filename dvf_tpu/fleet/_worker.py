@@ -159,6 +159,12 @@ def main(argv=None) -> int:
                            "signals": frontend.signals(),
                            "health": dict(frontend.health(),
                                           submit_errors=submit_errors)}
+                elif kind == "audit_probe":
+                    # Cross-replica divergence probe (obs.audit): the
+                    # deterministic probe frame through this replica's
+                    # compiled program — the digest the fleet compares.
+                    out = frontend.audit_probe(op[1] if len(op) > 1
+                                               else None)
                 elif kind == "trace":
                     # The frontend tracer's bounded event window + epoch
                     # (plain values): the fleet's cross-process trace

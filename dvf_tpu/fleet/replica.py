@@ -190,6 +190,14 @@ class ReplicaHandle:
         RPC unchanged."""
         raise NotImplementedError
 
+    def audit_probe(self, signature=None) -> dict:
+        """Run the audit plane's deterministic probe frame through this
+        replica's compiled program for ``signature`` and return
+        ``{"signature", "digest"}`` (``ServeFrontend.audit_probe``) —
+        the fleet's cross-replica divergence detector compares these
+        across replicas warm on the same signature."""
+        raise NotImplementedError
+
 
 class LocalReplica(ReplicaHandle):
     """In-process replica: a ServeFrontend over a device slice."""
@@ -283,6 +291,9 @@ class LocalReplica(ReplicaHandle):
 
     def trace_snapshot(self) -> dict:
         return self._fe().tracer.snapshot()
+
+    def audit_probe(self, signature=None) -> dict:
+        return self._fe().audit_probe(signature)
 
 
 class ProcessReplica(ReplicaHandle):
@@ -566,6 +577,12 @@ class ProcessReplica(ReplicaHandle):
         # off-thread), so the worst case blocks a dump thread, not
         # supervision.
         return self._rpc(("trace",), lock_timeout=5.0)
+
+    def audit_probe(self, signature=None) -> dict:
+        # Bounded like the monitor's health probe: a divergence check
+        # runs at the monitor's cadence and must degrade to "replica
+        # unprobeable this round" behind a busy submit, never wedge.
+        return self._rpc(("audit_probe", signature), lock_timeout=5.0)
 
 
 def live_worker_processes() -> List[subprocess.Popen]:

@@ -64,6 +64,7 @@ from dvf_tpu.fleet.stats import (
     merge_latency_snapshots,
     replica_row,
 )
+from dvf_tpu.obs.audit import DivergenceDetector
 from dvf_tpu.obs.export import FlightRecorder, attach_fleet_provider
 from dvf_tpu.obs import ledger as ledger_mod
 from dvf_tpu.obs.ledger import ReconfigLedger
@@ -159,6 +160,17 @@ class FleetConfig:
     #   a scale-out is session-rebind time, not a cold spawn. Works
     #   with or without autoscale (manual spawn_replica() takes from
     #   the pool too). 0 = no pool, spawns are cold.
+    audit_interval_s: float = 0.0  # > 0: the cross-replica divergence
+    #   detector (obs.audit) runs on the monitor thread at this cadence
+    #   — an identical deterministic probe frame through every healthy
+    #   replica warm on a shared signature, output digests compared; a
+    #   diverging replica is flagged (audit events + a flight dump) and
+    #   — with audit_quarantine — retired through the retire_replica
+    #   seam. 0 = manual only (audit_divergence_check()).
+    audit_quarantine: bool = False  # flagged divergent replicas are
+    #   drained and retired (the existing scale-in machinery) instead
+    #   of just flagged — a replica provably computing WRONG pixels
+    #   has no business taking traffic
     multihost_hosts: int = 0      # >= 2 arms the BIGGER-replica axis:
     #   a spawn_replica(flavor="multihost") builds one replica whose
     #   worker is a MultiHostEngine process group of this many hosts
@@ -275,6 +287,19 @@ class FleetFrontend:
         self.ledger: Optional[ReconfigLedger] = None
         if self.config.serve.ledger:
             self.ledger = ReconfigLedger(tracer=self.tracer, track=1)
+        # -- audit plane, fleet detector (obs.audit): cross-replica
+        # divergence — probe-digest comparison over the healthy
+        # replicas, flagged replicas optionally retired through the
+        # scale-in seam. Always constructed (cheap counters; the /audit
+        # endpoint and the manual check work without a cadence);
+        # audit_interval_s > 0 runs it from the monitor thread.
+        self.divergence = DivergenceDetector(
+            tracer=self.tracer, ledger=self.ledger,
+            flight_cb=self._dump_async,
+            quarantine_cb=lambda rid: self.retire_replica(
+                rid, cause="audit",
+                reason="cross-replica divergence quarantine"))
+        self._last_audit_check = 0.0
         # -- elasticity plane (ISSUE 12): controller + standby pool. The
         # plane must exist before the ring so the ring's on_sample hook
         # can point at it; an armed autoscale implies the ring (the
@@ -329,7 +354,8 @@ class FleetFrontend:
                 stats_fn=self.stats,
                 ring=self.telemetry,
                 ledger_fn=(self.ledger.document
-                           if self.ledger is not None else None))
+                           if self.ledger is not None else None),
+                audit_fn=self.audit_document)
         self._stalls_seen: Dict[str, int] = {}
         # Per-replica warm-signature sets (canonical renders), fed by
         # the health monitor from each replica's health() export and
@@ -939,6 +965,19 @@ class FleetFrontend:
                                         replica=r.id, stalls=stalls)
                     self._dump_async(f"replica {r.id} watchdog stall "
                                      f"(stalls={stalls})")
+            # Cross-replica divergence cadence (obs.audit): one probe
+            # fan-out per audit_interval_s from this thread — the same
+            # bounded per-replica RPC discipline as the health poll
+            # (busy channel → that replica is unprobeable this round).
+            if self.config.audit_interval_s > 0:
+                now = time.monotonic()
+                if now - self._last_audit_check \
+                        >= self.config.audit_interval_s:
+                    self._last_audit_check = now
+                    try:
+                        self.audit_divergence_check()
+                    except Exception:  # noqa: BLE001 — the auditor
+                        pass           # never takes down supervision
 
     def _handle_loss(self, r: ReplicaHandle, exc: BaseException,
                      reachable: bool = False) -> None:
@@ -1323,6 +1362,63 @@ class FleetFrontend:
         self.tracer.instant("scale_saturated", track=0, reason=reason)
         self._dump_async(reason)
 
+    # -- audit plane: cross-replica divergence (obs.audit) ---------------
+
+    def _audit_signature(self) -> Optional[str]:
+        """The signature to probe: the canonical render warm on the
+        MOST healthy replicas (a probe is only a comparison when at
+        least two replicas can run it). None = nothing shared yet."""
+        with self._lock:
+            warm = {rid: set(keys) for rid, keys in self._warm.items()
+                    if rid in self._replicas
+                    and self._replicas[rid].state == HEALTHY}
+        counts: Dict[str, int] = {}
+        for keys in warm.values():
+            for k in keys:
+                counts[k] = counts.get(k, 0) + 1
+        if not counts:
+            return None
+        best = max(sorted(counts), key=lambda k: counts[k])
+        return best if counts[best] >= 2 else None
+
+    def audit_divergence_check(
+            self, signature: Optional[str] = None) -> dict:
+        """Detector 3: run the identical deterministic probe frame
+        through every healthy replica warm on ``signature`` (default:
+        the most widely warm one) and compare output digests. A
+        replica outvoted by the majority is flagged — and, under
+        ``audit_quarantine``, drained and retired through the existing
+        ``retire_replica`` seam. Returns the event record
+        (``verdict``: match / mismatch / skipped)."""
+        signature = signature if signature is not None \
+            else self._audit_signature()
+        if signature is None:
+            return self.divergence.check({}, signature=None)
+        with self._lock:
+            replicas = [(rid, r) for rid, r in self._replicas.items()
+                        if r.state == HEALTHY]
+        probes: Dict[str, Optional[dict]] = {}
+        for rid, r in replicas:
+            try:
+                probes[rid] = r.audit_probe(signature)
+            except Exception:  # noqa: BLE001 — unprobeable this round
+                probes[rid] = None       # (busy channel, not warm, mid-
+                #   drain): counted as unreachable, never judged
+        return self.divergence.check(
+            probes, signature=signature,
+            quarantine=self.config.audit_quarantine)
+
+    def audit_document(self) -> dict:
+        """The fleet's ``/audit`` endpoint / flight-dump audit.json:
+        the divergence detector's counters + event window, plus each
+        reachable replica's last-known audit counters would ride its
+        own /audit — the fleet document stays RPC-free."""
+        doc = self.divergence.document()
+        doc["label"] = "fleet"
+        doc["audit_interval_s"] = self.config.audit_interval_s
+        doc["quarantine"] = self.config.audit_quarantine
+        return doc
+
     def elastic_view(self) -> dict:
         """The structured half of a fleet control row — what the
         elastic plane composes with each flat ring sample before the
@@ -1488,6 +1584,7 @@ class FleetFrontend:
             out[f"admission_refusals_{name}_total"] = float(n)
         if self.ledger is not None:
             out.update(self.ledger.signals())
+        out.update(self.divergence.signals())
         if self.elastic is not None:
             for k, v in self.elastic.signals().items():
                 out.setdefault(k, v)   # plane extras (errors,
@@ -1584,6 +1681,7 @@ class FleetFrontend:
             "aggregate": merge_latency_snapshots(
                 {rid: (e or {}).get("latency")
                  for rid, e in exports.items()}),
+            "audit": self.divergence.stats(),
             **({"ledger": self.ledger.summary()}
                if self.ledger is not None else {}),
             **({"chaos": self.config.chaos.summary()}

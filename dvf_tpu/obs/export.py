@@ -166,6 +166,15 @@ def fleet_samples(fleet) -> List[MetricSample]:
         "scale_out_total": st.get("scale_outs"),
         "scale_in_total": st.get("scale_ins"),
         "standby_adoptions_total": st.get("standby_adoptions"),
+        # Audit plane: the cross-replica divergence detector's counters
+        # (per-replica shadow-replay/wire counters live on each
+        # replica's own scrape).
+        "audit_divergence_checks_total": (st.get("audit") or {}).get(
+            "checks_total"),
+        "audit_divergences_total": (st.get("audit") or {}).get(
+            "divergences_total"),
+        "audit_quarantined_total": (st.get("audit") or {}).get(
+            "quarantined_total"),
     }, prefix="fleet")
     if st.get("rejections_by_tier"):
         # One tier vocabulary across surfaces: the ring/signals names
@@ -249,6 +258,7 @@ class MetricsExporter:
         ring: Optional[TimeSeriesRing] = None,
         explain_fn: Optional[Callable[[], dict]] = None,
         ledger_fn: Optional[Callable[[], dict]] = None,
+        audit_fn: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.health_fn = health_fn
@@ -259,6 +269,11 @@ class MetricsExporter:
         self.ledger_fn = ledger_fn  # reconfiguration-ledger document
         #   (``ReconfigLedger.document`` on a serve/fleet owner):
         #   ``/ledger`` serves the bounded event window; 404s without one
+        self.audit_fn = audit_fn  # audit-plane document (obs.audit —
+        #   ``AuditPlane.document`` / a worker's wire counters / the
+        #   fleet's divergence detector): ``/audit`` serves verdict
+        #   counters + the recent confirmed-corruption events; 404s
+        #   without one
         self.requests = 0
         self.request_errors = 0
         self._stat_lock = threading.Lock()  # handler threads are
@@ -345,6 +360,13 @@ class MetricsExporter:
                 return
             self._reply(req, 200, "application/json",
                         json.dumps(jsonable(self.ledger_fn())))
+        elif path == "/audit":
+            if self.audit_fn is None:
+                req.send_error(404, explain="no audit plane attached "
+                                            "(arm --audit / --audit-wire)")
+                return
+            self._reply(req, 200, "application/json",
+                        json.dumps(jsonable(self.audit_fn())))
         else:
             req.send_error(404)
 
@@ -439,6 +461,7 @@ class FlightRecorder:
         max_total_bytes: Optional[int] = None,
         lineage_fn: Optional[Callable[[], dict]] = None,
         ledger_fn: Optional[Callable[[], dict]] = None,
+        audit_fn: Optional[Callable[[], dict]] = None,
     ):
         self.out_dir = out_dir
         self.label = label
@@ -465,6 +488,11 @@ class FlightRecorder:
         #   every compile/resize/rebuild/quality/scale event with its
         #   cause, wall cost, and measured bucket stall, so "what
         #   reconfigured right before the trip" is in the artifact
+        self.audit_fn = audit_fn  # AuditPlane.document on an audit-
+        #   armed owner: the dump then carries ``audit.json`` — verdict
+        #   counters plus the confirmed-corruption events with their
+        #   lineage/ledger context, so a corruption post-mortem names
+        #   the frame, the hop, and what reconfigured before it
         self.jax_profile_s = jax_profile_s
         self.dumps: List[str] = []
         self.suppressed = 0
@@ -598,6 +626,9 @@ class FlightRecorder:
         if self.ledger_fn is not None:
             best_effort("ledger", lambda: self._json(
                 dump_dir, "ledger.json", self.ledger_fn()))
+        if self.audit_fn is not None:
+            best_effort("audit", lambda: self._json(
+                dump_dir, "audit.json", self.audit_fn()))
         return wrote
 
     @staticmethod

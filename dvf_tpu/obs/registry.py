@@ -89,6 +89,7 @@ DYNAMIC_KEY_PARENTS = frozenset({
     "rates", "series", "configs", "rounds", "trials", "buckets",
     "warm_replicas", "by_signature", "by_bucket", "by_session",
     "rejections_by_tier", "standby", "phases", "by_cause",
+    "digests",  # audit divergence events: digest-hex → replica ids
 })
 
 

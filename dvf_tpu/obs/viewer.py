@@ -18,6 +18,10 @@ directory and renders the numbers a human reads first —
   scale action with its cause, wall cost, and MEASURED bucket stall —
   rendered inline beside the lane utilization; a bare trace file shows
   the same events from its ``reconfig:*`` lane spans.
+- **audit verdicts** (the obs/audit plane): a dump's ``audit.json`` —
+  shadow-replay / swap-guard / divergence counters plus the confirmed
+  corruption events, rendered beside the ledger events so "what
+  reconfigured" and "what corrupted" share one timeline.
 
 Everything returns plain dicts (the ``--json`` form); ``render_text``
 turns one summary into the human view.
@@ -224,6 +228,21 @@ def summarize_dump(dump_dir: str, top: int = 10) -> dict:
             out["ledger"] = {k: led.get(k) for k in
                              ("events_total", "stall_events_total",
                               "stall_ms_total", "by_kind", "by_cause")}
+    aud_path = os.path.join(dump_dir, "audit.json")
+    if os.path.exists(aud_path):
+        try:
+            with open(aud_path) as f:
+                aud = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            aud = None
+        if aud:
+            out["audit"] = {k: aud.get(k) for k in (
+                "replays_sampled_total", "replay_mismatches_total",
+                "swap_guards_total", "swap_guard_mismatches_total",
+                "confirmed_corruptions_total", "wire_mismatches_total",
+                "checks_total", "divergences_total",
+                "quarantined_total") if aud.get(k) is not None}
+            out["audit_events"] = list(aud.get("events") or [])[-top:]
     return out
 
 
@@ -290,6 +309,28 @@ def render_text(summary: dict) -> str:
             if cache:
                 bits.append(f"cache {cache}")
             lines.append(f"  {what:<28} {where:<32} {', '.join(bits)}")
+    audit = summary.get("audit")
+    if audit is not None:
+        lines.append("")
+        parts = [f"{k.replace('_total', '')}={v}"
+                 for k, v in audit.items()]
+        lines.append("audit verdicts: " + (", ".join(parts) or "(none)"))
+        for ev in summary.get("audit_events") or []:
+            kind = ev.get("kind", "?")
+            verdict = ev.get("verdict", "")
+            where = (ev.get("bucket") or ev.get("signature")
+                     or ev.get("session") or "")
+            bits = []
+            if ev.get("swap_kind"):
+                bits.append(ev["swap_kind"])
+            if ev.get("session") is not None and ev.get("index") is not None:
+                bits.append(f"{ev['session']}#{ev['index']}")
+            if ev.get("max_abs_diff") is not None:
+                bits.append(f"maxdiff {ev['max_abs_diff']:g}")
+            if ev.get("divergent"):
+                bits.append(f"divergent {','.join(ev['divergent'])}")
+            lines.append(f"  {kind:<18} {verdict:<12} {where:<32} "
+                         f"{', '.join(bits)}")
     lineages = summary.get("lineages")
     if lineages:
         lines.append("")

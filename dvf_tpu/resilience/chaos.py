@@ -33,6 +33,18 @@ site        hook location                           default effect/kind
                                                     ``replica`` fault,
                                                     drain + migrate +
                                                     restart
+``corrupt_wire`` audit-stamped transports (ring     flip ONE post-encode
+            queue put, worker egress, bridge        bit inside the digest
+            egress — per stamped payload)           envelope → the decode
+                                                    hop's verify must
+                                                    catch it
+                                                    (``integrity``)
+``corrupt_device`` serve collect (per fetched       perturb one element
+            batch)                                  of row 0 of a valid
+                                                    output batch → only
+                                                    shadow replay can
+                                                    catch it
+                                                    (``integrity``)
 =========== ======================================= =====================
 
 Triggers are event-indexed (``at`` — explicit 0-based event numbers at
@@ -80,6 +92,13 @@ SITE_KINDS = {
     "oom": FaultKind.OOM,
     "freeze": FaultKind.STALL,
     "replica": FaultKind.REPLICA,
+    # Audit-plane sites (obs.audit): corruption that PARSES — the wire
+    # flip lands post-encode inside a digest-stamped envelope; the
+    # device flip perturbs one element of an otherwise-valid output
+    # batch. Neither raises at injection: detection (or the lack of it)
+    # is exactly what the audit acceptance tests measure.
+    "corrupt_wire": FaultKind.INTEGRITY,
+    "corrupt_device": FaultKind.INTEGRITY,
 }
 
 
@@ -209,6 +228,32 @@ class FaultPlan:
             return blob
         keep = max(4, len(blob) // 3)
         return blob[:keep] + b"\x00" * 16
+
+    def flip_bit(self, site: str, blob: bytes,
+                 protect: int = 12) -> bytes:
+        """Flip ONE bit of ``blob`` when a rule triggers — the
+        post-encode wire corruption the audit envelope must catch.
+        The first ``protect`` bytes (the envelope header: magic,
+        version, digest — obs.audit.WIRE_HEADER_LEN) are spared so the
+        corrupted payload still PARSES as a stamped frame; flipping the
+        magic instead would be caught by the cheaper strict-framing
+        check, which is not the failure mode under test. Position is
+        deterministic per fire (seeded arithmetic, no clock/rng)."""
+        rule = self._match(site)
+        if rule is None or len(blob) <= protect:
+            return blob
+        pos = protect + ((rule.fired * 7919) % (len(blob) - protect))
+        out = bytearray(blob)
+        out[pos] ^= 0x01
+        return bytes(out)
+
+    def perturb(self, site: str) -> bool:
+        """Fire-and-report trigger for in-place array corruption sites
+        (``corrupt_device``): True when a rule fires this event — the
+        caller applies the perturbation (obs.audit.
+        maybe_corrupt_device), because the payload is an ndarray the
+        plan should not be reshaping itself."""
+        return self._match(site) is not None
 
     def truncate(self, site: str, parts: list) -> list:
         """Drop all but the first frame of a multipart message when a rule

@@ -46,13 +46,20 @@ class FaultKind:
     REPLICA = "replica"      # a fleet engine replica was lost (process died,
     #                          RPC channel broke, health check failed) —
     #                          the fleet tier's drain/migrate/restart domain
+    INTEGRITY = "integrity"  # the audit plane's domain (obs.audit):
+    #                          content-digest mismatch on a framed wire
+    #                          payload, a shadow-replay or swap-guard
+    #                          divergence from the golden path — the
+    #                          pixels are WRONG even though everything
+    #                          parsed and delivered
     INTERNAL = "internal"    # everything else (bookkeeping bugs, sinks)
 
 
 ALL_KINDS = (
     FaultKind.DECODE, FaultKind.GEOMETRY, FaultKind.TRANSPORT,
     FaultKind.H2D, FaultKind.D2H, FaultKind.COMPUTE, FaultKind.OOM,
-    FaultKind.STALL, FaultKind.REPLICA, FaultKind.INTERNAL,
+    FaultKind.STALL, FaultKind.REPLICA, FaultKind.INTEGRITY,
+    FaultKind.INTERNAL,
 )
 
 # Default classification for exceptions that carry no kind of their own,

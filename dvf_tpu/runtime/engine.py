@@ -398,6 +398,32 @@ class Engine:
         loops, which predate the serving-path name."""
         return self.submit_resident(batch)
 
+    def run_probe(self, batch: np.ndarray) -> np.ndarray:
+        """Audit-plane probe entry (obs.audit): run the compiled step on
+        ``batch`` WITHOUT touching serving state or stats — no state
+        threading (the returned state is discarded; stateless filters
+        only, where it is None anyway), no batch/frame counters, no
+        chaos sites. Safe to call concurrently with the serving
+        dispatch: jitted executables are thread-safe and the probe's
+        operands are its own fresh device buffers. Blocking
+        (materializes the result) — callers are off the hot path by
+        contract (swap guards, divergence probes)."""
+        if self.freed:
+            raise RuntimeError("cannot probe a freed engine")
+        if self._step is None or self._signature is None:
+            raise RuntimeError("cannot probe an uncompiled engine")
+        if self._exec_filter.stateful:
+            raise ValueError(
+                f"cannot probe stateful filter {self.filter.name!r}: the "
+                f"probe would consume (donated) live temporal state")
+        if (tuple(batch.shape), np.dtype(batch.dtype)) != self._signature:
+            raise ValueError(
+                f"probe batch {batch.shape}/{batch.dtype} does not match "
+                f"the compiled signature {self._signature}")
+        x = jax.device_put(np.ascontiguousarray(batch), self._sharding)
+        y, _ = self._step(x, self._state)
+        return np.asarray(y)
+
     def cost_analysis(self) -> Optional[dict]:
         """XLA's own cost model for the compiled step: total FLOPs and HBM
         bytes accessed per batch. This is what the per-config roofline
@@ -753,6 +779,17 @@ class ProgramPool:
         warm-replica preference matches against."""
         with self._lock:
             return list(self._entries)
+
+    def peek(self, key) -> Optional["Engine"]:
+        """The warm engine under ``key`` WITHOUT taking a lease — the
+        audit plane's divergence probe runs through it (a replica is
+        'warm on a signature' whether the program is bucket-leased or
+        pool-idle). None when absent; the caller must tolerate a
+        concurrent eviction (the freed engine's probe raises, which the
+        probe paths already contain as 'unprobeable')."""
+        with self._lock:
+            ent = self._entries.get(key)
+            return ent[0] if ent is not None else None
 
     def close(self) -> None:
         """Free every entry (frontend stop): after this, no pool engine

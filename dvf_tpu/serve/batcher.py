@@ -78,6 +78,11 @@ class BatchPlan:
     #   assemble_h2d at dispatch, device/d2h at collect; the router
     #   extends each slot's FrameLineage with them before demux (one
     #   stamp per batch, not per frame). None = lineage off.
+    audit_rows: Any = None  # audit-armed frontends (obs.audit): rows
+    #   the shadow-replay sampler picked this tick — [(row, input-copy,
+    #   session_id, frame_index, lineage), ...]; the collect side pairs
+    #   each with its DELIVERED output and hands the pair to the replay
+    #   worker. None = audit off or nothing sampled (zero cost).
 
 
 class ContinuousBatcher:

@@ -58,6 +58,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dvf_tpu.api.filter import Filter, FilterChain
+from dvf_tpu.obs.audit import (
+    AuditPlane,
+    attach_audit_provider,
+    maybe_corrupt_device,
+)
 from dvf_tpu.obs.export import FlightRecorder, attach_signal_provider
 from dvf_tpu.obs import ledger as ledger_mod
 from dvf_tpu.obs.ledger import ReconfigLedger
@@ -223,6 +228,22 @@ class ServeConfig:
     #   per-component costs written at bucket retirement/stop, loaded at
     #   bucket creation to seed tick-cost estimates and annotate
     #   control-plane decisions. None = no persistence.
+    audit: bool = False           # the audit plane (obs.audit):
+    #   sampled shadow-replay of delivered frames against a golden
+    #   un-jitted jnp re-execution (every audit_sample_every-th staged
+    #   frame, judged off the hot threads), plus the program-swap
+    #   equivalence guard — every recompile adopted by a batch resize,
+    #   quality rebind, or recovery rebuild ledgers a probe-digest
+    #   verdict. Exports: stats()["audit"], audit_* signals,
+    #   dvf_audit_* samples, /audit, flight-dump audit.json; the first
+    #   CONFIRMED corruption trips a flight dump. Overhead gated ≤3%
+    #   fps (benchmarks/AUDIT_BENCH.json). Off by default (--audit).
+    audit_sample_every: int = 64  # shadow-replay sampling period K:
+    #   every Kth staged frame is re-executed on the golden path
+    audit_seed: int = 0           # sampler phase (deterministic replay)
+    audit_tolerance: float = 2.0  # pinned max-abs-diff tolerance for
+    #   chains whose compute leaves uint8 (jit-vs-unjit float rounding
+    #   freedom); uint8_ok chains compare bit-exact regardless
     ledger: bool = True           # compile & reconfiguration ledger +
     #   memory accounting (obs.ledger / obs.memory): every compile,
     #   pool acquire/evict, batch resize, quality rebind, and engine
@@ -520,6 +541,20 @@ class ServeFrontend:
             attach_memory_provider(self.registry,
                                    bucket_rows_fn=self._memory_bucket_rows)
             self._leak_watch = LeakTrendWatch()
+        # -- audit plane (obs.audit): shadow replay + swap guard -----------
+        self.audit: Optional[AuditPlane] = None
+        if self.config.audit:
+            self.audit = AuditPlane(
+                sample_every=self.config.audit_sample_every,
+                seed=self.config.audit_seed,
+                tolerance=self.config.audit_tolerance,
+                tracer=self.tracer,
+                ledger=self.ledger,
+                flight_cb=self._flight_trip,
+                fault_cb=lambda e: self.faults.record(
+                    FaultKind.INTEGRITY, e),
+                label=f"serve-{label}" if label else "serve")
+            attach_audit_provider(self.registry, self.audit)
         # -- frame-lineage attribution plane (obs.lineage) -----------------
         self.attribution: Optional[AttributionPlane] = None
         if self.config.lineage:
@@ -587,7 +622,9 @@ class ServeFrontend:
                 lineage_fn=(self.attribution.snapshot
                             if self.attribution is not None else None),
                 ledger_fn=(self.ledger.document
-                           if self.ledger is not None else None))
+                           if self.ledger is not None else None),
+                audit_fn=(self.audit.document
+                          if self.audit is not None else None))
         self.registry.register_provider(self._bucket_samples)
         #   per-bucket queue depth / p99 + the compile-cache counters
         #   (dvf_compile_cache_hits_total / _misses_total,
@@ -670,6 +707,8 @@ class ServeFrontend:
             self.control_plane.start()
         if self.telemetry is not None:
             self.telemetry.start()
+        if self.audit is not None:
+            self.audit.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -678,6 +717,8 @@ class ServeFrontend:
         self._stop.set()
         if self._supervisor is not None:
             self._supervisor.stop()
+        if self.audit is not None:
+            self.audit.stop()
         if self.control_plane is not None:
             self.control_plane.stop()
         if self.telemetry is not None:
@@ -972,9 +1013,54 @@ class ServeFrontend:
             # window (attr_<component>_p99_ms) + lineage counters —
             # the "where did my p99 go" row, scrapeable per second.
             out.update(self.attribution.signals())
+        if self.audit is not None:
+            out.update(self.audit.signals())
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
+
+    def audit_probe(self, signature: Optional[str] = None) -> dict:
+        """Run the deterministic probe frame through one compiled
+        bucket's program and return its output digest — the unit the
+        fleet's cross-replica divergence detector compares (every
+        replica derives the SAME probe pixels from the signature, so
+        equal programs must produce equal digests). ``signature``
+        (a canonical render) picks the bucket; None probes the first
+        compiled one. Raises ``ServeError`` when nothing is compiled —
+        the fleet counts that replica as unprobeable, it does not
+        judge it."""
+        from dvf_tpu.obs.audit import engine_probe_row, frame_digest
+
+        with self._lock:
+            buckets = list(self._buckets)
+        engine = None
+        label = None
+        for b in buckets:
+            if b.engine.signature is None or b.engine.freed:
+                continue
+            if signature is None or b.label() == signature:
+                engine, label = b.engine, b.label()
+                break
+        if engine is None:
+            # Pool-warm fallback: "warm on a signature" includes
+            # programs whose bucket retired (or that only ever
+            # precompiled) — health() advertises exactly those, so the
+            # fleet's divergence check must be able to probe them too.
+            for key in sorted(self.pool.warm_keys(),
+                              key=lambda k: k.render()):
+                if signature is None or key.render() == signature:
+                    cand = self.pool.peek(key)
+                    if cand is not None and not cand.freed \
+                            and cand.signature is not None:
+                        engine, label = cand, key.render()
+                        break
+        if engine is None:
+            raise ServeError(
+                f"no compiled program to probe"
+                + (f" for signature {signature!r}" if signature else ""))
+        row = engine_probe_row(engine)
+        return {"signature": label,
+                "digest": frame_digest(row).hex()}
 
     def explain(self, q: float = 99.0) -> dict:
         """The latency-attribution ``explain`` surface: which components
@@ -1838,6 +1924,22 @@ class ServeFrontend:
                     signature=key.render(), bucket=target.label(),
                     session=sid, level=level, frames_flushed=flushed,
                     reason=reason, stall_from=stall_from)
+            if self.audit is not None:
+                # Equivalence verdict for the quality program the
+                # session was just rebound onto — vs the golden path
+                # of ITS OWN (decimate+upscale) chain: a rebind is by
+                # design not equivalent to the base program, but the
+                # substituted program must still compute its chain.
+                # Async: this is the dispatch thread — the probe runs
+                # on the audit worker (the bucket keeps its engine
+                # leased; a raced retirement yields probe_failed, not
+                # a crash).
+                self.audit.swap_guard(
+                    engine=target.engine, filt=target.filter,
+                    kind="quality_rebind",
+                    cause=ledger_mod.CAUSE_QUALITY,
+                    signature=key.render(), bucket=target.label(),
+                    reason=reason, asynchronous=True)
 
     def _apply_resizes_dispatch(self) -> None:
         """Dispatch-thread half of a batch resize: initiated only while
@@ -1905,6 +2007,12 @@ class ServeFrontend:
         Failure is contained — the old size keeps serving."""
         t0 = time.time()
         try:
+            # Swap guard (obs.audit): the OLD program's probe output
+            # must be captured BEFORE ensure_compiled replaces it in
+            # place — the resize substitutes a program under live
+            # tenants, which is only safe if equivalence is proven.
+            old_row = (self.audit.probe_row(bucket.engine)
+                       if self.audit is not None else None)
             before = bucket.engine.stats.compile_count
             with self._recover_lock:
                 bucket.engine.ensure_compiled(shape, dtype)
@@ -1931,6 +2039,17 @@ class ServeFrontend:
                 if compiled:
                     self._observe_compile(compile_ms, label,
                                           ledger_mod.CAUSE_RESIZE)
+            if self.audit is not None:
+                # Equivalence verdict for the adopted program: probe
+                # through the new program vs the golden path (and
+                # bit-identity vs the old program's probe row — same
+                # per-frame geometry across a batch resize). Ledgered
+                # as a swap_guard event: zero unaudited substitutions.
+                self.audit.swap_guard(
+                    engine=bucket.engine, filt=bucket.filter,
+                    kind="batch_resize", cause=ledger_mod.CAUSE_RESIZE,
+                    signature=bucket.label(), bucket=bucket.label(),
+                    old_row=old_row, reason=reason)
         except Exception:  # noqa: BLE001 — counted, never raised into
             with self._lock:               # the serving path
                 self.resize_compile_errors += 1
@@ -2347,6 +2466,11 @@ class ServeFrontend:
                     stall_from = (b.last_dispatch_t
                                   if b.last_dispatch_t is not None
                                   else t_rb)
+                    # Swap guard: old-program probe BEFORE the rebuild
+                    # replaces it (best-effort — a broken engine's
+                    # probe failing is itself expected here).
+                    old_row = (self.audit.probe_row(b.engine)
+                               if self.audit is not None else None)
                     b.engine = b.engine.rebuild()
                     if b._pooled and b.key is not None:
                         try:
@@ -2382,6 +2506,18 @@ class ServeFrontend:
                             self._observe_compile(
                                 compile_ms, label,
                                 ledger_mod.CAUSE_RECOVERY)
+                    if self.audit is not None:
+                        # Equivalence verdict for the rebuilt program
+                        # (recovery substitutes it under live
+                        # sessions): new probe vs golden, plus
+                        # bit-identity vs the old program when it was
+                        # still probeable.
+                        self.audit.swap_guard(
+                            engine=b.engine, filt=b.filter,
+                            kind="engine_rebuild",
+                            cause=ledger_mod.CAUSE_RECOVERY,
+                            signature=b.label(), bucket=b.label(),
+                            old_row=old_row, reason=reason)
                 # Second straggler sweep: a dispatch iteration that was
                 # mid-staging when the drain above ran (wedged past the
                 # park deadline) has had the whole engine rebuild to land
@@ -2523,6 +2659,19 @@ class ServeFrontend:
                 # program's cost. Contended ticks still count batches;
                 # they just don't feed the estimate.
                 plan.cost_sample = len(self._window) == 0
+                if self.audit is not None:
+                    # Shadow-replay sampling (obs.audit): the sampler
+                    # decides per staged frame; a picked frame's INPUT
+                    # is copied here — the only place it still exists —
+                    # and paired with its delivered output at collect.
+                    # One modulo per frame when nothing is picked.
+                    for row, slot in enumerate(plan.slots[: plan.valid]):
+                        if self.audit.want_sample():
+                            if plan.audit_rows is None:
+                                plan.audit_rows = []
+                            plan.audit_rows.append(
+                                (row, np.array(slot.frame, copy=True),
+                                 slot.session.id, slot.index, slot.lin))
                 try:
                     builder = self._builder_for(bucket, seq)
                     for row, slot in enumerate(plan.slots):
@@ -2632,6 +2781,12 @@ class ServeFrontend:
                            else np.asarray(result))
                     if plan.lin_marks is not None:
                         plan.lin_marks.append(("d2h", time.time()))
+                    if chaos is not None:
+                        # Chaos site "corrupt_device": one element of
+                        # row 0 perturbed in an otherwise-valid batch —
+                        # the silent corruption ONLY the shadow replay
+                        # below can catch (it parses, routes, delivers).
+                        out = maybe_corrupt_device(chaos, out)
                 except Exception as e:  # noqa: BLE001 — poisoned batch
                     if self._collect_gen != gen:
                         # Superseded mid-wait: make sure the plan's
@@ -2670,6 +2825,20 @@ class ServeFrontend:
                 self.tracer.complete("batch_complete", _t0, time.time(),
                                      TRACK_DEVICE, seq=seq,
                                      frames=plan.valid)
+                if plan.audit_rows and self.audit is not None \
+                        and bucket is not None:
+                    # Pair each sampled input with its DELIVERED output
+                    # (post any corrupt_device perturbation — the replay
+                    # must judge what the client actually receives) and
+                    # hand the pair to the off-thread golden worker.
+                    for row, in_frame, sid, idx, lin in plan.audit_rows:
+                        if row < plan.valid:
+                            self.audit.submit_replay(
+                                bucket.filter, in_frame,
+                                np.array(out[row], copy=True),
+                                session=sid, index=idx,
+                                bucket=bucket.label(), lineage=lin,
+                                out_uint8=bucket.engine.out_uint8)
                 self.router.route(plan, out)
                 # A materialized batch is proof of engine progress: the
                 # consecutive-stall escalation counter starts over.
@@ -2737,6 +2906,8 @@ class ServeFrontend:
                if self.tracer.enabled else {}),
             **({"attribution": self.attribution.summary()}
                if self.attribution is not None else {}),
+            **({"audit": self.audit.stats()}
+               if self.audit is not None else {}),
             **({"ledger": self.ledger.summary(),
                 "memory": self._memory_stats()}
                if self.ledger is not None else {}),
@@ -2781,6 +2952,7 @@ class ZmqStreamBridge:
         delta_keyframe_interval: int = 16,
         delta_threshold: int = 0,
         delta_degrade_after: int = 8,
+        audit_wire: bool = False,
     ):
         import zmq
 
@@ -2830,6 +3002,21 @@ class ZmqStreamBridge:
         # folds the wire components back into the frontend's attribution
         # plane — "21% encode" in explain() comes from here.
         self._attr = frontend.attribution
+        # Wire-integrity audit (obs.audit): incoming frames must pass
+        # the digest envelope, outgoing deliveries are stamped
+        # post-encode; counters fold into the frontend's audit plane
+        # when one is armed. Strict ingress — audit-mode peers stamp.
+        self._wire_in = None
+        self._wire_out = None
+        if audit_wire:
+            from dvf_tpu.obs.audit import WireAudit
+
+            self._wire_in = WireAudit("bridge_ingress")
+            self._wire_out = WireAudit("bridge_egress",
+                                       chaos=frontend.config.chaos)
+            if frontend.audit is not None:
+                frontend.audit.register_wire(self._wire_in)
+                frontend.audit.register_wire(self._wire_out)
         self.use_jpeg = wire != "raw"
         self.raw_size = raw_size
         self.poll_ms = poll_ms
@@ -2904,6 +3091,13 @@ class ZmqStreamBridge:
                         self.errors += 1
                     else:
                         remote_idx, payload = parsed
+                        if self._wire_in is not None:
+                            # Verify + strip the audit envelope before
+                            # decode: a flipped bit on the wire raises
+                            # WireIntegrityError into this loop's
+                            # containment (counted, frame dropped)
+                            # instead of decoding corrupt pixels.
+                            payload = self._wire_in.verify(payload)
                         self.frontend.submit(
                             self.session_id, self._decode(payload),
                             tag=(remote_idx, time.time()))
@@ -2931,6 +3125,14 @@ class ZmqStreamBridge:
                         if self._attr is not None \
                                 and d.lineage is not None:
                             d.lineage.mark("encode", enc_t)
+                        if self._wire_out is not None:
+                            # Stamp ONCE per frame, at enqueue: a
+                            # zmq.Again retry must re-send the same
+                            # stamped bytes, not re-stamp (which would
+                            # inflate the stamp counter and advance the
+                            # corrupt_wire chaos event index per
+                            # ATTEMPT instead of per frame).
+                            payload = self._wire_out.stamp(payload)
                         out_pending.append((d, payload))
                 while out_pending:
                     d, payload = out_pending[0]
@@ -2975,6 +3177,8 @@ class ZmqStreamBridge:
             for batch in self.plane.flush():
                 for d, payload, err in batch:
                     if err is None:
+                        if self._wire_out is not None:
+                            payload = self._wire_out.stamp(payload)
                         out_pending.append((d, payload))
                     else:
                         self.errors += 1

@@ -84,6 +84,8 @@ class RingFrameQueue:
         delta_tile: int = 32,
         delta_keyframe_interval: int = 48,
         delta_threshold: int = 0,
+        audit_wire: bool = False,
+        chaos=None,
     ):
         if wire is None:
             wire = "jpeg" if jpeg else "raw"
@@ -124,6 +126,19 @@ class RingFrameQueue:
         # noise-like content (worst case ~1.5×), and an oversized record
         # must fail loudly at push, never at pop. The delta header +
         # bitmap add at most a few KB on top of a raw-sized payload.
+        # Wire-integrity audit (obs.audit): every payload is wrapped in
+        # a digest-stamped envelope at put and verified+stripped at
+        # decode_into — a flipped bit between the two (the native ring,
+        # shm, a future network hop) raises WireIntegrityError into the
+        # pipeline's containment as an ``integrity`` fault instead of
+        # delivering wrong pixels. ``chaos`` arms the post-encode
+        # ``corrupt_wire`` flip on the stamp side. ~11 ns/KB of blake2b
+        # per direction; off by default.
+        self._wire_audit = None
+        if audit_wire:
+            from dvf_tpu.obs.audit import WireAudit
+
+            self._wire_audit = WireAudit("ring", chaos=chaos)
         # First eviction re-keys immediately; the cooldown only
         # rate-limits re-keying under SUSTAINED overload.
         self._force_cooldown = max(4, delta_keyframe_interval // 2)
@@ -152,6 +167,8 @@ class RingFrameQueue:
             payload = frame.tobytes() if isinstance(frame, np.ndarray) else frame
         else:
             payload = self.codec.encode(frame)
+        if self._wire_audit is not None:
+            payload = self._wire_audit.stamp(payload)
         evicted = self.ring.push(payload, idx, ts)
         self._puts_since_forced += 1
         if (evicted > 0 and self.wire == "delta"
@@ -185,6 +202,12 @@ class RingFrameQueue:
         composite sequentially, their per-frame cost scaled by the dirty
         ratio)."""
         k = len(items)
+        if self._wire_audit is not None:
+            # Verify + strip every envelope BEFORE any pixel decode: a
+            # digest mismatch raises here (integrity fault) instead of
+            # compositing corrupt bytes into the staging batch.
+            items = [(idx, self._wire_audit.verify(payload), ts)
+                     for idx, payload, ts in items]
         if self.wire == "raw":
             for row, (_, payload, _) in enumerate(items):
                 staging[row] = np.frombuffer(
@@ -217,6 +240,8 @@ class RingFrameQueue:
             out["codec"] = self.codec.config()
         elif self.codec is not None:
             out["codec"] = self.codec.config()
+        if self._wire_audit is not None:
+            out["audit"] = self._wire_audit.stats()
         return out
 
     def __len__(self) -> int:

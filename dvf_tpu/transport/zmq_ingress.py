@@ -117,6 +117,8 @@ class TpuZmqWorker:
         delta_keyframe_interval: int = 16,
         delta_threshold: int = 0,
         delta_device: bool = False,
+        audit_wire: bool = False,
+        ledger: bool = True,
     ):
         import zmq
 
@@ -216,6 +218,30 @@ class TpuZmqWorker:
         from dvf_tpu.obs.lineage import AttributionAggregate
 
         self.attribution = AttributionAggregate(1024)
+        # Wire-integrity audit (obs.audit): incoming payloads must carry
+        # (and pass) the digest envelope; outgoing results are stamped
+        # post-encode. Strict on ingress — in audit mode an unstamped
+        # payload is indistinguishable from one whose envelope header
+        # was flipped. A digest mismatch raises WireIntegrityError
+        # (kind ``integrity``) into run()'s containment, attributed to
+        # the zmq_ingress hop. Off by default: the reference app does
+        # not speak the envelope.
+        self._wire_in = None
+        self._wire_out = None
+        if audit_wire:
+            from dvf_tpu.obs.audit import WireAudit
+
+            self._wire_in = WireAudit("zmq_ingress")
+            self._wire_out = WireAudit("zmq_egress", chaos=chaos)
+        # Worker-tier reconfiguration ledger (endpoint parity with
+        # serve/fleet: --metrics-port serves /ledger here too): the
+        # worker's only reconfigurations are engine compiles on
+        # geometry change — each lands as one compile event.
+        self.ledger = None
+        if ledger:
+            from dvf_tpu.obs.ledger import ReconfigLedger
+
+            self.ledger = ReconfigLedger(tracer=self.tracer, track=2)
         self.faults = FaultStats()
         self.fault_budget = fault_budget
         self.fault_window_s = fault_window_s
@@ -282,7 +308,23 @@ class TpuZmqWorker:
         single staging buffer."""
         shape = (self.batch_size, h, w, 3)
         if self._asm is None or self._asm.batch_shape != shape:
+            before = self.engine.stats.compile_count
             self.engine.ensure_compiled(shape, np.uint8)
+            if (self.ledger is not None
+                    and self.engine.stats.compile_count != before):
+                from dvf_tpu.obs import ledger as ledger_mod
+
+                compile_ms = self.engine.last_compile_ms
+                sig_key = self.engine.signature_key
+                self.ledger.record(
+                    ledger_mod.COMPILE,
+                    cause=ledger_mod.CAUSE_ADMISSION,
+                    signature=(sig_key.render()
+                               if sig_key is not None else None),
+                    wall_ms=compile_ms,
+                    compile_ms=(round(float(compile_ms), 3)
+                                if compile_ms is not None else None),
+                    cache="miss")
             self._ingest_stats = IngestStats(
                 requested_mode=self.ingest, depth=self.ingest_depth,
                 h2d_block_ms=self.engine.h2d_block_ms)
@@ -359,6 +401,11 @@ class TpuZmqWorker:
                     print(f"[TpuZmqWorker] encode failed (dropping "
                           f"frame {idx}): {err!r}", file=sys.stderr)
                     continue
+                if self._wire_out is not None:
+                    # Post-encode stamp (and the corrupt_wire chaos
+                    # site): the digest covers exactly the bytes that
+                    # ride the wire.
+                    payload = self._wire_out.stamp(payload)
                 try:
                     self.push.send_multipart(
                         result_msg(idx, pid, t0, t1, payload))
@@ -483,6 +530,13 @@ class TpuZmqWorker:
         indices = [i for i, _ in pending]
         valid = len(pending)
         blobs = [b for _, b in pending]
+        if self._wire_in is not None:
+            # Verify + strip the audit envelope on every payload BEFORE
+            # any decode: a digest mismatch (a bit flip that would still
+            # JPEG-parse) raises WireIntegrityError into run()'s
+            # containment — the batch drops at-most-once under the
+            # integrity budget, attributed to the zmq_ingress hop.
+            blobs = [self._wire_in.verify(b) for b in blobs]
         # Geometry follows the STREAM (the app's target_size), not our
         # --target-size flag, which only governs the raw path's reshape
         # (reference inverter.py:34 hardcodes raw geometry the same way).
@@ -830,9 +884,33 @@ class TpuZmqWorker:
         attr = self.attribution.summary()
         for comp, row in (attr.get("components") or {}).items():
             out[f"attr_{comp}_p99_ms"] = row["p99_ms"]
+        if self._wire_in is not None:
+            out["audit_wire_verified_total"] = float(
+                self._wire_in.verified)
+            out["audit_wire_mismatches_total"] = float(
+                self._wire_in.mismatches)
+            out["audit_wire_stamped_total"] = float(
+                self._wire_out.stamped)
+        if self.ledger is not None:
+            out.update(self.ledger.signals())
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
+
+    def audit_document(self) -> dict:
+        """The worker's ``/audit`` endpoint body: wire-integrity
+        counters per hop (the worker runs no shadow replay — its loop
+        is batch-synchronous; wire digests are its audit surface)."""
+        hops = []
+        if self._wire_in is not None:
+            hops = [self._wire_in.stats(), self._wire_out.stats()]
+        return {
+            "label": "worker",
+            "wire_enabled": self._wire_in is not None,
+            "wire_hops": hops,
+            "wire_mismatches_total": sum(h["mismatches_total"]
+                                         for h in hops),
+        }
 
     def stats(self) -> dict:
         """Counters for tests/operators (the worker's run loop prints
@@ -860,6 +938,10 @@ class TpuZmqWorker:
                if self._ingest_stats is not None else {}),
             **({"egress": self._egress_stats.summary()}
                if self._egress_stats is not None else {}),
+            **({"audit": self.audit_document()}
+               if self._wire_in is not None else {}),
+            **({"ledger": self.ledger.summary()}
+               if self.ledger is not None else {}),
             **({"chaos": self.chaos.summary()}
                if self.chaos is not None else {}),
         }

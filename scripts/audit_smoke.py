@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""CI audit smoke: prove the audit plane detects what it claims to.
+
+Three bounded legs (seconds total, CPU backend), exit NONZERO on any
+miss — wired into scripts/ci_tier1.sh beside the perf sentinel:
+
+1. **Shadow replay, clean leg**: an audited serve frontend on
+   un-faulted traffic confirms ZERO corruptions (a false positive is a
+   3am page for nothing).
+2. **Shadow replay, injected device corruption**: the ``corrupt_device``
+   chaos site perturbs one element of delivered batches; the replay
+   worker must confirm ≥ 1 silent corruption.
+3. **Wire integrity, injected bit flip**: a digest-stamped ring-queue
+   payload with one post-encode flipped bit must raise a
+   WireIntegrityError at the decode hop (and an uncorrupted stream
+   must pass verbatim).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"audit_smoke: MISS — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _drive(fe, sid, frame, n):
+    got = 0
+    for _ in range(n):
+        fe.submit(sid, frame)
+    deadline = time.time() + 30.0
+    while got < n and time.time() < deadline:
+        got += len(fe.poll(sid))
+        if got < n:
+            time.sleep(0.005)
+    return got
+
+
+def shadow_replay_legs() -> None:
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.resilience.chaos import FaultPlan
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    frame = np.random.default_rng(0).integers(
+        0, 255, (48, 48, 3), dtype=np.uint8)
+    # Leg 1: clean traffic → zero confirmed corruptions.
+    fe = ServeFrontend(get_filter("invert"),
+                       ServeConfig(batch_size=2, audit=True,
+                                   audit_sample_every=2,
+                                   queue_size=64, slo_ms=60_000.0)).start()
+    try:
+        sid = fe.open_stream()
+        if _drive(fe, sid, frame, 12) < 12:
+            fail("clean leg: frames not delivered")
+        if not fe.audit.drain(20.0):
+            fail("clean leg: replay queue never drained")
+        st = fe.stats()["audit"]
+        if st["replays_sampled_total"] < 1:
+            fail("clean leg: sampler never fired")
+        if st["confirmed_corruptions_total"] != 0:
+            fail(f"clean leg: {st['confirmed_corruptions_total']} false "
+                 f"positive corruption(s)")
+    finally:
+        fe.stop()
+    # Leg 2: injected device corruption → confirmed within K frames.
+    plan = FaultPlan(seed=7).add("corrupt_device", every=2)
+    fe = ServeFrontend(get_filter("invert"),
+                       ServeConfig(batch_size=2, audit=True,
+                                   audit_sample_every=2, chaos=plan,
+                                   queue_size=64, slo_ms=60_000.0)).start()
+    try:
+        sid = fe.open_stream()
+        if _drive(fe, sid, frame, 12) < 12:
+            fail("chaos leg: frames not delivered")
+        if not fe.audit.drain(20.0):
+            fail("chaos leg: replay queue never drained")
+        st = fe.stats()["audit"]
+        if st["confirmed_corruptions_total"] < 1:
+            fail("chaos leg: injected device corruption NOT detected")
+    finally:
+        fe.stop()
+    print("audit_smoke: shadow replay "
+          f"(clean 0 false positives, chaos detected)", file=sys.stderr)
+
+
+def wire_leg() -> None:
+    from dvf_tpu.obs.audit import WireIntegrityError
+    from dvf_tpu.resilience.chaos import FaultPlan
+    from dvf_tpu.transport.ring_queue import RingFrameQueue
+
+    frame = np.random.default_rng(1).integers(
+        0, 255, (32, 32, 3), dtype=np.uint8)
+    staging = np.empty((4, 32, 32, 3), np.uint8)
+    # Clean pass-through first.
+    q = RingFrameQueue((32, 32, 3), capacity_frames=8, wire="raw",
+                       audit_wire=True)
+    try:
+        for i in range(3):
+            q.put((i, frame, time.time()))
+        items = q.pop_up_to(3)
+        q.decode_into(items, staging)
+        if not (staging[:3] == frame).all():
+            fail("wire leg: clean roundtrip corrupted")
+    finally:
+        q.close()
+    # One post-encode bit flip → exactly one detection at decode.
+    plan = FaultPlan(seed=1).add("corrupt_wire", at=(1,))
+    q = RingFrameQueue((32, 32, 3), capacity_frames=8, wire="raw",
+                       audit_wire=True, chaos=plan)
+    try:
+        for i in range(3):
+            q.put((i, frame, time.time()))
+        items = q.pop_up_to(3)
+        try:
+            q.decode_into(items, staging)
+        except WireIntegrityError as e:
+            if e.hop != "ring":
+                fail(f"wire leg: mismatch attributed to {e.hop!r}, "
+                     f"want 'ring'")
+        else:
+            fail("wire leg: injected bit flip NOT detected")
+    finally:
+        q.close()
+    print("audit_smoke: wire integrity (bit flip detected at ring hop)",
+          file=sys.stderr)
+
+
+def main() -> int:
+    shadow_replay_legs()
+    wire_leg()
+    print("audit_smoke: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

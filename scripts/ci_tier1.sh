@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Tier-1 CI gate: the full tier-1 test suite (ROADMAP.md's verify line)
-# PLUS the perf-regression sentinel (benchmarks/sentinel.py --quick).
-# Exit nonzero on a test failure OR a measured perf regression — the
-# same bar the GitHub Actions workflow (.github/workflows/ci.yml)
-# enforces on every push.
+# PLUS the audit smoke (scripts/audit_smoke.py: one shadow-replay round
+# + one injected-corruption detection, nonzero on a miss) PLUS the
+# perf-regression sentinel (benchmarks/sentinel.py --quick). Exit
+# nonzero on a test failure, an audit miss, OR a measured perf
+# regression — the same bar the GitHub Actions workflow
+# (.github/workflows/ci.yml) enforces on every push.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -18,6 +20,14 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 if [ "$rc" -ne 0 ]; then
     echo "ci_tier1: TEST FAILURE (pytest rc=$rc)" >&2
     exit "$rc"
+fi
+
+echo "== audit smoke (shadow replay + injected-corruption detection) =="
+JAX_PLATFORMS=cpu python scripts/audit_smoke.py
+arc=$?
+if [ "$arc" -ne 0 ]; then
+    echo "ci_tier1: AUDIT MISS (audit_smoke rc=$arc)" >&2
+    exit "$arc"
 fi
 
 echo "== perf-regression sentinel =="

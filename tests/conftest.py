@@ -110,6 +110,14 @@ def pytest_configure(config):
                    "pool, spawn/retire actuators, SIGKILL-during-scale-in "
                    "chaos — CPU backend, bounded wall time; run in "
                    "tier-1, select with -m elastic)")
+    config.addinivalue_line(
+        "markers", "audit: audit-plane tests (obs.audit — wire-integrity "
+                   "digests across raw/jpeg/delta, sampled shadow replay "
+                   "vs the golden un-jitted path, program-swap "
+                   "equivalence guard, cross-replica divergence, "
+                   "corrupt_wire/corrupt_device chaos acceptance — CPU "
+                   "backend, bounded wall time; run in tier-1, select "
+                   "with -m audit)")
 
 
 @pytest.fixture(scope="session", autouse=True)

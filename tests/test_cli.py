@@ -308,6 +308,15 @@ def test_observability_flags_consistent_across_tiers(capsys):
         assert "--metrics-port" in text, tier
         assert "--trace" in text, tier
         assert "--flight-dir" in text, tier
+        # Audit plane (ISSUE 15): every tier that scrapes also audits —
+        # the flags ride the same shared parser, and the worker's
+        # exporter serves /ledger + /audit like serve/fleet (pinned
+        # functionally in tests/test_audit.py's endpoint-parity test).
+        assert "--audit" in text, tier
+        assert "--audit-wire" in text, tier
+        if tier == "fleet":
+            assert "--audit-interval" in text
+            assert "--audit-quarantine" in text
 
 
 def test_trace_view_in_help(capsys):
