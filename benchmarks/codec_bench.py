@@ -131,6 +131,58 @@ def bench_delta(h: int, w: int, dirty_ratio: float, reps: int,
         dec.close()
 
 
+def bench_transform(h: int, w: int, reps: int) -> dict:
+    """Full-transform assist stage split at one geometry: the host's
+    whole JPEG encode cycle vs entropy coding alone
+    (``encode_coefficients`` over device-layout quantized blocks — what
+    the host still runs when the device did convert+DCT+quant). The
+    ratio is ``stage_costs.entropy_share``, which sizes
+    ``transport.codec.EntropyPool``. Needs jax (CPU is fine) to produce
+    the golden coefficient blocks; returns None when the shim or jax
+    cannot serve it."""
+    from dvf_tpu.transport.codec import NativeJpegCodec
+
+    codec = NativeJpegCodec(quality=90, threads=1)
+    if not hasattr(codec._lib, "dvf_jpeg_encode_coefficients"):
+        codec.close()
+        return None
+    try:
+        import jax.numpy as jnp
+
+        from dvf_tpu.ops.pallas_kernels import (dct8x8_quant_ref,
+                                                jpeg_quant_table)
+        from dvf_tpu.runtime.codec_assist import rgb_to_ycbcr420
+
+        frame = _frame(h, w)
+        y, cb, cr = rgb_to_ycbcr420(jnp.asarray(frame[None]))
+        ql, qc = jpeg_quant_table(90), jpeg_quant_table(90, chroma=True)
+        yq = np.asarray(dct8x8_quant_ref(y, ql))[0]
+        cbq = np.asarray(dct8x8_quant_ref(cb, qc))[0]
+        crq = np.asarray(dct8x8_quant_ref(cr, qc))[0]
+        codec.encode(frame)                          # warm
+        codec.encode_coefficients(yq, cbq, crq, h, w)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.encode(frame)
+        full_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            codec.encode_coefficients(yq, cbq, crq, h, w)
+        ent_s = time.perf_counter() - t0
+        return {
+            "encode_fps": round(reps / full_s, 1),
+            "entropy_fps": round(reps / ent_s, 1),
+            "entropy_share": round(ent_s / full_s, 3),
+            "host_cpus": os.cpu_count(),
+        }
+    except Exception as e:  # noqa: BLE001 — optional leg, never fatal
+        print(f"[codec-bench] transform split unavailable at {h}x{w}: "
+              f"{e!r}", file=sys.stderr)
+        return None
+    finally:
+        codec.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out-dir", default=os.path.join(REPO, "benchmarks"))
@@ -171,12 +223,28 @@ def main(argv=None) -> int:
             results[f"{gname}/delta/d{int(dirty * 100)}"] = r
             print(f"[codec-bench] {gname} delta d{int(dirty * 100)}: {r}",
                   file=sys.stderr, flush=True)
+        # Transform-on-device row: the host's remaining cost when the
+        # device runs convert+DCT+quant — entropy coding only.
+        r = bench_transform(h, w, max(4, args.reps * 512 * 512 // (h * w)))
+        if r is not None:
+            results[f"{gname}/transform/entropy"] = r
+            print(f"[codec-bench] {gname} transform split: {r}",
+                  file=sys.stderr, flush=True)
+
+    # Stage-cost block (read by transport.codec.entropy_pool_size): the
+    # measured fraction of one full host encode cycle that is entropy
+    # coding, averaged across geometries with a transform row.
+    shares = [r["entropy_share"] for k, r in results.items()
+              if k.endswith("/transform/entropy")]
+    stage_costs = ({"entropy_share": round(sum(shares) / len(shares), 3),
+                    "geometries": len(shares)} if shares else None)
 
     doc = {
         "generated_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "batch": args.batch,
         "host_cpus": os.cpu_count(),
         "results": results,
+        **({"stage_costs": stage_costs} if stage_costs else {}),
     }
     os.makedirs(args.out_dir, exist_ok=True)
     jpath = os.path.join(args.out_dir, "CODEC_BENCH.json")
@@ -207,11 +275,24 @@ def main(argv=None) -> int:
         "compare delta rows against a noise full-frame baseline "
         "(DELTA_BENCH.json's `full_jpeg` row), not across this table.",
         "",
+        "Transform rows (impl `transform`): the full-transform assist "
+        "stage split — `encode fps` is the whole host encode cycle "
+        "(color convert + DCT + quant + entropy), `decode fps` column "
+        "carries the ENTROPY-ONLY fps (`encode_coefficients` over "
+        "device-layout quantized blocks: the host's entire remaining "
+        "cost when the device runs the transform). Their ratio is "
+        "`stage_costs.entropy_share`, which sizes the entropy pool "
+        "(transport.codec.entropy_pool_size).",
+        "",
         "| geometry | impl | thr./dirty | encode fps | decode fps | wire KB |",
         "|---|---|---|---|---|---|",
     ]
     for key, r in results.items():
         g, i, t = key.split("/")
+        if i == "transform":
+            lines.append(f"| {g} | {i} | share={r['entropy_share']} | "
+                         f"{r['encode_fps']} | {r['entropy_fps']} | — |")
+            continue
         kb = r.get("jpeg_kb", r.get("wire_kb"))
         lines.append(f"| {g} | {i} | {t[1:]} | {r['encode_fps']} | "
                      f"{r['decode_fps']} | {kb} |")

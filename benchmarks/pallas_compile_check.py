@@ -47,6 +47,9 @@ def main(argv=None) -> int:
     from dvf_tpu.ops.conv import gaussian_kernel_1d
     from dvf_tpu.ops.pallas_kernels import (
         bilateral_nhwc_pallas,
+        dct8x8_quant_pallas,
+        dct8x8_quant_ref,
+        jpeg_quant_table,
         sep_blur_nhwc_pallas,
         sobel_bilateral_nhwc_pallas,
         warp_bounded_pallas,
@@ -113,6 +116,21 @@ def main(argv=None) -> int:
         cases[f"sobel_bilateral_tile{th}"] = (
             lambda x, th=th: sobel_bilateral_nhwc_pallas(
                 x, tile_h=th, interpret=interp), (frame,))
+    # Codec-endgame kernels (device-side JPEG transform): the luma plane
+    # at full geometry and the 4:2:0-subsampled chroma plane — distinct
+    # lane counts, so each needs its own lowering vouch.
+    ql = jpeg_quant_table(90)
+    qc = jpeg_quant_table(90, chroma=True)
+    if args.quick:
+        luma = jax.ShapeDtypeStruct((2, 48, 64), jnp.float32)
+        chroma = jax.ShapeDtypeStruct((2, 24, 32), jnp.float32)
+    else:
+        luma = jax.ShapeDtypeStruct((8, 1080, 1920), jnp.float32)
+        chroma = jax.ShapeDtypeStruct((8, 540, 960), jnp.float32)
+    cases["dct_quant_luma"] = (
+        lambda x: dct8x8_quant_pallas(x, ql, interpret=interp), (luma,))
+    cases["dct_quant_chroma"] = (
+        lambda x: dct8x8_quant_pallas(x, qc, interpret=interp), (chroma,))
     results = {}
     for name, (fn, shapes) in cases.items():
         try:
@@ -120,6 +138,38 @@ def main(argv=None) -> int:
             results[name] = "ok"
         except Exception as e:  # noqa: BLE001 — the error IS the datum
             results[name] = f"{type(e).__name__}: {e}"[:500]
+    # Executed bit-exactness, golden (jnp slab helper) vs Pallas: the
+    # quantized-coefficient wire is entropy-coded AS-IS by the shim, so
+    # a ±1 divergence here is a wire-visible corruption, not a tolerance
+    # question. Aligned geometry runs the kernel; the edge geometry
+    # pins the dispatcher's golden fallback to the same values the
+    # aligned kernel produces on its interior blocks.
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    for gname, (h, w) in (("aligned_64x128", (64, 128)),
+                          ("edge_52x100", (52, 100))):
+        try:
+            plane = rng.uniform(0, 255, (2, h, w)).astype(np.float32)
+            golden = np.asarray(dct8x8_quant_ref(jnp.asarray(plane), ql))
+            if h % 8 == 0 and w % 8 == 0:
+                got = np.asarray(dct8x8_quant_pallas(
+                    jnp.asarray(plane), ql, interpret=interp))
+            else:
+                # Edge geometry: the kernel needs 8-alignment; compare
+                # the ref's edge-padded interior against the kernel on
+                # the aligned crop — same blocks, same bits.
+                hc, wc = (h // 8) * 8, (w // 8) * 8
+                got = np.asarray(dct8x8_quant_pallas(
+                    jnp.asarray(plane[:, :hc, :wc]), ql,
+                    interpret=interp))
+                golden = golden[:, :hc // 8, :wc // 8]
+            n_bad = int((golden != got).sum())
+            results[f"dct_quant_exact_{gname}"] = (
+                "ok" if n_bad == 0 else f"{n_bad} coefficient mismatches")
+        except Exception as e:  # noqa: BLE001 — the error IS the datum
+            results[f"dct_quant_exact_{gname}"] = (
+                f"{type(e).__name__}: {e}"[:500])
     print(json.dumps({"backend": backend, "results": results}))
     ok = all(v == "ok" for v in results.values())
     if not ok:

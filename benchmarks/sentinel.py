@@ -21,7 +21,11 @@ cites. This sentinel is the CI gate that re-reads — and re-measures:
    ``SENTINEL_BASELINE.json`` with a wide noise band — wide enough for
    a steal-drifted host, narrow enough that a real slowdown (e.g. a
    sleep in the codec pool: ``--inject-slowdown-ms``, the self-test
-   tier-1 pins) trips it by an order of magnitude.
+   tier-1 pins) trips it by an order of magnitude. A second fresh leg
+   races the fused coefficient wire (FusedDeltaTransform → DeltaCodec
+   coefficient encode, host entropy coding only) against the same
+   reference denominator and gates its ratio identically — skipped,
+   not failed, on shim-less hosts.
 
 3. **Fresh bench diffs** (``--full``): quick-mode re-runs of the
    normalized-record writers (attr_bench, ledger_bench, audit_bench,
@@ -295,6 +299,164 @@ def probe_regressions(fresh, baseline):
 
 
 # ---------------------------------------------------------------------------
+# Leg 2b: fresh fused-codec probe (device transform + coefficient wire)
+# ---------------------------------------------------------------------------
+
+
+def fused_codec_unavailable():
+    """None when the fused coefficient path can run here, else the
+    reason it can't. A shim-less host SKIPS this leg rather than
+    failing it — production degrades the same way (worker falls back
+    to the probe tier), and the tier-1 coefficient tests skip too."""
+    try:
+        from dvf_tpu.transport.codec import NativeJpegCodec
+        codec = NativeJpegCodec(quality=85, threads=1)
+    except Exception as e:  # noqa: BLE001 — the reason IS the datum
+        return f"native jpeg shim unavailable: {e!r}"
+    try:
+        if not hasattr(codec._lib, "dvf_jpeg_encode_coefficients"):
+            return "shim predates coefficient assist"
+    finally:
+        codec.close()
+    return None
+
+
+def _fused_leg(duration_s, inject_ms, out):
+    """Fused-codec workload under test: FusedDeltaTransform (probe +
+    convert + DCT + quant, ONE device dispatch per batch) feeding
+    DeltaCodec's coefficient wire, so the host does entropy coding
+    only. A regression anywhere on that chain — the fused jit, the
+    lazy dirty-tile D2H fetch, the entropy pool, the wire framing —
+    lowers this leg's throughput while the reference leg (common
+    mode) stays put."""
+    from dvf_tpu.runtime.codec_assist import FusedDeltaTransform
+    from dvf_tpu.transport.codec import DeltaCodec, NativeJpegCodec
+
+    h, w, tile, bs = 32, 64, 16, 4
+    rng = np.random.default_rng(2)
+    y, x = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = np.stack([(x * 3) % 256, (y * 2) % 256, (x + y) % 256],
+                    -1).astype(np.uint8)
+    frames = []
+    for k in range(16):
+        f = base.copy()
+        x0 = (k * 8) % (w - 16)
+        # A moving dirty patch: a few tiles change per frame, so the
+        # leg exercises the sparse dirty-tile fetch, not keyframes.
+        f[8:24, x0:x0 + 16] = rng.integers(
+            60, 196, (16, 16, 3), dtype=np.uint8)
+        frames.append(f)
+    batches = [np.stack(frames[i:i + bs]) for i in range(0, 16, bs)]
+
+    fused = FusedDeltaTransform(tile=tile, quality=85)
+    inner = NativeJpegCodec(quality=85, threads=2)
+    if inject_ms > 0:
+        # Self-test parity with the serve leg: sleep in the per-frame
+        # ENTROPY encode — the exact host stage this wire leaves
+        # behind. Both entries wrapped: the codec prefers the batched
+        # one (one call per frame's dirty tiles) when the shim has it.
+        orig = inner.encode_coefficients
+        orig_batch = getattr(inner, "encode_coefficients_batch", None)
+
+        def slow_coeffs(*a, **kw):
+            time.sleep(inject_ms / 1e3)
+            return orig(*a, **kw)
+
+        inner.encode_coefficients = slow_coeffs
+        if orig_batch is not None:
+
+            def slow_batch(*a, **kw):
+                time.sleep(inject_ms / 1e3)
+                return orig_batch(*a, **kw)
+
+            inner.encode_coefficients_batch = slow_batch
+    codec = DeltaCodec(inner=inner, tile=tile)
+    try:
+        # Warm (fused jit compile + first keyframe) outside the clock.
+        bm, cfs = fused.process(batches[0])
+        for j in range(bs):
+            codec.encode(None, bitmap=bm[j], coeffs=cfs[j])
+        out["start"].wait()
+        served = 0
+        i = 1
+        deadline = time.perf_counter() + duration_s
+        while time.perf_counter() < deadline:
+            batch = batches[i % len(batches)]
+            bm, cfs = fused.process(batch)
+            for j in range(bs):
+                codec.encode(None, bitmap=bm[j], coeffs=cfs[j])
+            served += bs
+            i += 1
+        out["fused_fps"] = served / duration_s
+    finally:
+        codec.close()
+
+
+def fused_probe(rounds=3, duration_s=1.5, inject_ms=0):
+    """Best-of-rounds fused/reference ratio — same concurrent A/B
+    discipline as :func:`probe`, with the coefficient wire as the
+    numerator. Returns ``{"skipped": reason}`` on a shim-less host."""
+    reason = fused_codec_unavailable()
+    if reason is not None:
+        return {"skipped": reason}
+    ratios = []
+    rows = []
+    for i in range(rounds):
+        out = {"start": threading.Event()}
+        tf = threading.Thread(target=_fused_leg,
+                              args=(duration_s, inject_ms, out))
+        tr = threading.Thread(target=_reference_leg,
+                              args=(duration_s, out))
+        tf.start()
+        tr.start()
+        time.sleep(0.05)
+        out["start"].set()
+        tf.join()
+        tr.join()
+        fused_fps = out.get("fused_fps", 0.0)
+        ref_kops = out.get("ref_kops", 0.0)
+        ratio = fused_fps / ref_kops if ref_kops else None
+        if ratio:
+            ratios.append(ratio)
+        rows.append({"round": i, "fused_fps": round(fused_fps, 1),
+                     "ref_kops_per_s": round(ref_kops, 2),
+                     "fused_over_ref_ratio": (round(ratio, 4)
+                                              if ratio else None)})
+    return {
+        "rounds": {str(r["round"]): r for r in rows},
+        "duration_s": duration_s,
+        "inject_slowdown_ms": inject_ms,
+        "geometry": {"h": 32, "w": 64, "tile": 16, "batch": 4},
+        "ratio_best": (round(max(ratios), 4) if ratios else None),
+        "ratio_median": (round(statistics.median(ratios), 4)
+                         if ratios else None),
+    }
+
+
+def fused_regressions(fresh, baseline):
+    """Gate the fresh fused-codec ratio against the committed baseline's
+    ``fused`` section — same one-sided band as the serve probe."""
+    out = []
+    if fresh.get("skipped"):
+        return out, f"fused leg skipped: {fresh['skipped']}"
+    bf = (baseline or {}).get("fused") or {}
+    base = bf.get("ratio_best", bf.get("ratio_median"))
+    if base is None:
+        return out, ("no committed SENTINEL_BASELINE.json fused ratio "
+                     "(baseline predates the coefficient wire)")
+    m = fresh.get("ratio_best", fresh.get("ratio_median"))
+    band = bf.get("band_frac", PROBE_BAND_FRAC)
+    floor = base * (1.0 - band)
+    if m is None or m < floor:
+        out.append({"bench": "sentinel_fused_codec",
+                    "metric": "fused_over_ref_ratio",
+                    "ok": False,
+                    "detail": f"fresh {m} < floor {floor:.4f} "
+                              f"(baseline {base}, band {band:g})"})
+    return out, None
+
+
+# ---------------------------------------------------------------------------
 # Leg 3 (--full): fresh quick-mode bench diffs vs committed records
 # ---------------------------------------------------------------------------
 
@@ -432,11 +594,22 @@ def main(argv=None):
                           "band_frac": PROBE_BAND_FRAC,
                           "rounds": doc["rounds"]},
             }
+            fdoc = fused_probe(rounds=args.rounds or 5, duration_s=2.0)
+            if fdoc.get("skipped"):
+                print(f"fused leg skipped: {fdoc['skipped']} — baseline "
+                      f"written without a fused section", file=sys.stderr)
+            else:
+                baseline["fused"] = {"ratio_best": fdoc["ratio_best"],
+                                     "ratio_median": fdoc["ratio_median"],
+                                     "band_frac": PROBE_BAND_FRAC,
+                                     "geometry": fdoc["geometry"],
+                                     "rounds": fdoc["rounds"]}
             with open(BASELINE_PATH, "w") as f:
                 json.dump(baseline, f, indent=2)
             print(f"wrote {BASELINE_PATH} "
                   f"(ratio_best {doc['ratio_best']}, "
-                  f"median {doc['ratio_median']})")
+                  f"median {doc['ratio_median']}, "
+                  f"fused_best {fdoc.get('ratio_best')})")
             return 0
 
         failures = [g for g in baseline_gates() if not g["ok"]]
@@ -452,6 +625,17 @@ def main(argv=None):
             if note:
                 report["probe_note"] = note
             report["regressions"].extend(regs)
+            # The coefficient-wire leg: fused device transform + host
+            # entropy coding, gated the same way (skips shim-less).
+            ffresh = fused_probe(rounds=rounds,
+                                 duration_s=1.0 if args.quick else 2.0,
+                                 inject_ms=args.inject_slowdown_ms)
+            report["fused"] = ffresh
+            fregs, fnote = fused_regressions(ffresh, _load(
+                "SENTINEL_BASELINE.json"))
+            if fnote:
+                report["fused_note"] = fnote
+            report["regressions"].extend(fregs)
         if args.full:
             report["regressions"].extend(fresh_bench_diffs())
     except Exception as e:  # noqa: BLE001 — harness error ≠ regression

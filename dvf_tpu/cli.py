@@ -565,6 +565,10 @@ def cmd_serve(args) -> int:
             # stamped at put, verified at decode into staging —
             # mismatches classify as `integrity` faults in the
             # pipeline's containment.
+            # Provenance mapping: 'full' stamps the codec-level string;
+            # 'probe' leaves the codec unassisted (bitmaps only).
+            codec_assist={"full": "full-transform",
+                          "probe": "none"}.get(args.codec_assist, "none"),
             audit_wire=args.audit_wire,
             chaos=config.chaos,
         )
@@ -779,6 +783,12 @@ def cmd_fleet(args) -> int:
               "fleet front door (replica RPCs are length-prefixed "
               "pickle, demo streams are in-process); arm it on worker "
               "tiers / bridges at the edges", file=sys.stderr)
+    if getattr(args, "codec_assist", "none") != "none":
+        print(f"[fleet] note: --codec-assist {args.codec_assist} has no "
+              f"codec at the fleet front door (replica RPCs carry "
+              f"pixels); the assist tiers live on the worker "
+              f"(--codec-assist full) and serve ring "
+              f"(provenance stamp)", file=sys.stderr)
     autoscale = None
     if args.autoscale:
         try:
@@ -960,6 +970,7 @@ def cmd_worker(args) -> int:
         delta_tile=args.delta_tile,
         delta_keyframe_interval=args.delta_keyframe_interval,
         delta_device=args.delta_device,
+        codec_assist=args.codec_assist,
         raw_size=args.target_size,
         jpeg_quality=90,
         codec_threads=args.codec_threads,
@@ -1705,6 +1716,14 @@ def main(argv=None) -> int:
                          "resync bound after dropped delta frames)")
     sp.add_argument("--delta-tile", type=int, default=32,
                     help="--wire delta: change-detection tile size")
+    sp.add_argument("--codec-assist", choices=("none", "probe", "full"),
+                    default="none",
+                    help="codec-assist tier this run requests; on serve "
+                         "the ring is an ingest-side host wire, so the "
+                         "flag stamps PROVENANCE into codec.config() "
+                         "(none / ycbcr / full-transform rows in bench "
+                         "output) — the worker tier is where 'full' "
+                         "moves DCT+quant onto the device")
     sp.add_argument("--sessions", type=int, default=1,
                     help=">1: run the multi-stream serving demo — N "
                          "synthetic client streams at different frame "
@@ -1796,6 +1815,11 @@ def main(argv=None) -> int:
                     help="retire (drain + replace) a replica the "
                          "divergence detector flags, through the "
                          "scale-in seam — instead of only flagging it")
+    fl.add_argument("--codec-assist", choices=("none", "probe", "full"),
+                    default="none",
+                    help="accepted for tier parity; the fleet front door "
+                         "carries pixels (no codec), so a non-none value "
+                         "only prints where the assist actually lives")
     fl.add_argument("--devices-per-replica", type=int, default=0,
                     help="local mode: devices per replica engine "
                          "(0 = even split)")
@@ -1898,6 +1922,16 @@ def main(argv=None) -> int:
                     help="--wire delta: compute dirty-tile bitmaps on "
                          "DEVICE (runtime.codec_assist.DeviceDeltaProbe) "
                          "instead of the host reduction")
+    wp.add_argument("--codec-assist", choices=("none", "probe", "full"),
+                    default="none",
+                    help="--wire delta: device codec assist tier. 'probe' "
+                         "= dirty bitmaps on device (alias of "
+                         "--delta-device); 'full' = probe + RGB→YCbCr + "
+                         "8×8 DCT + quantization fused into ONE device "
+                         "pass per batch — the host entropy-codes int16 "
+                         "coefficient blocks and never touches pixels "
+                         "(falls back to 'probe' when the native shim "
+                         "or the stream geometry cannot serve it)")
     wp.add_argument("--codec-threads", type=int, default=4,
                     help="JPEG codec thread-pool size (encode/decode "
                          "parallelism; also the asynchronous egress "

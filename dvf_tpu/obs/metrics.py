@@ -297,6 +297,10 @@ class EgressStats:
         self.encode_batches = 0
         self.encode_ms_total = 0.0       # in-pool wall span per batch
         self.encode_wait_ms_total = 0.0  # exposed drain wait per batch
+        self.entropy_batches = 0
+        self.entropy_ms_total = 0.0      # host entropy-coding CPU time
+        #   per batch (full-transform assist: the ONLY host codec work —
+        #   compare against encode_ms on the host-transform path)
         self.send_batches = 0
         self.send_ms_total = 0.0
 
@@ -311,6 +315,14 @@ class EgressStats:
         self.encode_batches += 1
         self.encode_ms_total += encode_ms
         self.encode_wait_ms_total += wait_ms
+
+    def record_entropy(self, entropy_ms: float) -> None:
+        """Host entropy-coding time for one batch (full-transform assist:
+        the device already did DCT+quant, so this is the whole host-side
+        codec cost — the number that replaces ``encode_ms`` as the host
+        roofline)."""
+        self.entropy_batches += 1
+        self.entropy_ms_total += entropy_ms
 
     def record_send(self, send_ms: float) -> None:
         self.send_batches += 1
@@ -343,6 +355,8 @@ class EgressStats:
             "encode_batches": self.encode_batches,
             "encode_ms": round(self.encode_ms_total / ne, 4),
             "encode_wait_ms": round(self.encode_wait_ms_total / ne, 4),
+            "entropy_ms": round(self.entropy_ms_total
+                                / max(1, self.entropy_batches), 4),
             "send_ms": round(self.send_ms_total
                              / max(1, self.send_batches), 4),
             "pool_allocs": self.pool_allocs,

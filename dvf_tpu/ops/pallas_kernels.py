@@ -40,6 +40,7 @@ interpret mode (CPU) and the benchmark table compares wall time on device.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -661,3 +662,222 @@ def tile_maxdiff(a: jnp.ndarray, b: jnp.ndarray, tile: int = 32,
     if h % tile == 0 and w % tile == 0 and h % _SUBLANE == 0:
         return tile_maxdiff_pallas(a, b, tile, interpret=interpret)
     return tile_maxdiff_ref(a, b, tile)
+
+
+# ---------------------------------------------------------------------------
+# JPEG forward DCT + quantization (PR 16): the transform half of the host
+# codec, on device — NativeJpegCodec.encode_coefficients then does entropy
+# coding and nothing else.
+# ---------------------------------------------------------------------------
+
+# Annex-K base tables (the ones libjpeg scales in jpeg_set_quality).
+_JPEG_LUMA_BASE = (
+    (16, 11, 10, 16, 24, 40, 51, 61),
+    (12, 12, 14, 19, 26, 58, 60, 55),
+    (14, 13, 16, 24, 40, 57, 69, 56),
+    (14, 17, 22, 29, 51, 87, 80, 62),
+    (18, 22, 37, 56, 68, 109, 103, 77),
+    (24, 35, 55, 64, 81, 104, 113, 92),
+    (49, 64, 78, 87, 103, 121, 120, 101),
+    (72, 92, 95, 98, 112, 100, 103, 99),
+)
+_JPEG_CHROMA_BASE = (
+    (17, 18, 24, 47, 99, 99, 99, 99),
+    (18, 21, 26, 66, 99, 99, 99, 99),
+    (24, 26, 56, 99, 99, 99, 99, 99),
+    (47, 66, 99, 99, 99, 99, 99, 99),
+    (99, 99, 99, 99, 99, 99, 99, 99),
+    (99, 99, 99, 99, 99, 99, 99, 99),
+    (99, 99, 99, 99, 99, 99, 99, 99),
+    (99, 99, 99, 99, 99, 99, 99, 99),
+)
+
+
+def jpeg_quant_table(quality: int, chroma: bool = False):
+    """The (8, 8) quantization table ``jpeg_set_quality(quality,
+    force_baseline=TRUE)`` installs, reproduced exactly (IJG scaling of
+    the Annex-K base tables). Device-side quantization MUST divide by
+    these values so the native shim's entropy-only encode — which tells
+    the decoder to multiply by the same tables — reconstructs correctly.
+    Returns int32 numpy, natural (row-major) order."""
+    import numpy as np
+
+    q = min(100, max(1, int(quality)))
+    scale = 5000 // q if q < 50 else 200 - 2 * q
+    base = np.asarray(_JPEG_CHROMA_BASE if chroma else _JPEG_LUMA_BASE,
+                      dtype=np.int64)
+    table = (base * scale + 50) // 100
+    return np.clip(table, 1, 255).astype(np.int32)
+
+
+def _dct8_matrix():
+    """D[u, x] = C(u)/2 · cos((2x+1)uπ/16) — the orthonormal forward
+    8-point DCT-II so that coef = D · block · Dᵀ matches JPEG's
+    definition (float64 build, float32 constants)."""
+    import numpy as np
+
+    d = np.zeros((8, 8), np.float64)
+    for u in range(8):
+        cu = (1.0 / math.sqrt(2.0)) if u == 0 else 1.0
+        for x in range(8):
+            d[u, x] = 0.5 * cu * math.cos((2 * x + 1) * u * math.pi / 16.0)
+    return d.astype(np.float32)
+
+
+_DCT8 = _dct8_matrix()
+
+
+def _qrecip_lanes(qtable, nbx: int):
+    """Quantizer reciprocals laid out for the interleaved slab: lane
+    ``u·nbx + j`` holds 1/qtable[·, u] (u = horizontal frequency, j =
+    block index) — ``jnp.repeat`` along the frequency axis."""
+    import numpy as np
+
+    recip = (1.0 / np.asarray(qtable, np.float64)).astype(np.float32)
+    return np.repeat(recip, nbx, axis=1)  # (8, 8*nbx)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _dct8x8_quant_slab_jit(x, nbx, qrecip):
+    """The golden's execution of the shared slab math. Jitted on
+    purpose: eager per-op dispatch compiles each multiply-add as its own
+    XLA program and never forms FMAs, while the Pallas interpreter runs
+    the kernel body as one fused program (which does) — a 1-ulp
+    difference that flips round() on coefficient-boundary values. One
+    fused program on both sides restores bit-identity (pinned by
+    benchmarks/pallas_compile_check.py)."""
+    return _dct8x8_quant_slab(x, nbx, qrecip)
+
+
+def _dct8x8_quant_slab(x: jnp.ndarray, nbx: int,
+                       qrecip: jnp.ndarray) -> jnp.ndarray:
+    """Shared arithmetic of the golden AND the Pallas kernel — one op
+    sequence so the two paths are bit-identical in interpret mode.
+
+    ``x`` is a (…, 8, 8·nbx) float32 slab of 8-pixel-tall block rows in
+    INTERLEAVED lane order (lane = x_in_block · nbx + block_idx): every
+    per-block slice is then a contiguous lane chunk, which is the whole
+    trick — no strided lane access, no in-kernel reshape. Returns the
+    rounded quantized coefficients as float32, same layout with lane =
+    u_horiz · nbx + block_idx (caller casts to int16)."""
+    # Each product passes through an optimization barrier before the
+    # add: XLA's FMA contraction (fusing a*b+c into one fused
+    # multiply-add with unrounded product) is a per-fusion-context
+    # choice, so the golden and the kernel could round 1 ulp apart —
+    # enough to flip round() on quotients that land exactly on a ±.5
+    # quantization boundary (common at high quality, where divisors are
+    # 1–2). Barring contraction pins both programs to the identical
+    # IEEE mul-then-add sequence; the barrier is a compile-time marker,
+    # not a runtime op.
+    nofma = jax.lax.optimization_barrier
+    rows = [x[..., y, :] - 128.0 for y in range(8)]  # JPEG level shift
+    vert = []
+    for u in range(8):
+        acc = nofma(float(_DCT8[u, 0]) * rows[0])
+        for y in range(1, 8):
+            acc = acc + nofma(float(_DCT8[u, y]) * rows[y])
+        vert.append(acc)
+    v = jnp.stack(vert, axis=-2)                      # (…, 8, 8·nbx)
+    chunks = [v[..., :, k * nbx: (k + 1) * nbx] for k in range(8)]
+    horiz = []
+    for u in range(8):
+        acc = nofma(float(_DCT8[u, 0]) * chunks[0])
+        for k in range(1, 8):
+            acc = acc + nofma(float(_DCT8[u, k]) * chunks[k])
+        horiz.append(acc)
+    t = jnp.concatenate(horiz, axis=-1)               # (…, 8, 8·nbx)
+    return jnp.round(t * qrecip)
+
+
+def _to_slab(plane: jnp.ndarray, nby: int, nbx: int) -> jnp.ndarray:
+    """(B, H, W) → (B, nby, 8, 8·nbx) float32, interleaved lane order."""
+    b = plane.shape[0]
+    x = plane.astype(jnp.float32)
+    return (x.reshape(b, nby, 8, nbx, 8).transpose(0, 1, 2, 4, 3)
+            .reshape(b, nby, 8, 8 * nbx))
+
+
+def _from_slab(q: jnp.ndarray, nby: int, nbx: int) -> jnp.ndarray:
+    """(B, nby, 8, 8·nbx) quantized slab → (B, nby, nbx, 8, 8) int16
+    coefficient blocks in natural (row-major frequency) order — the
+    layout ``dvf_jpeg_encode_coefficients`` consumes."""
+    b = q.shape[0]
+    return (q.reshape(b, nby, 8, 8, nbx).transpose(0, 1, 4, 2, 3)
+            .astype(jnp.int16))
+
+
+def dct8x8_quant_ref(plane: jnp.ndarray, qtable) -> jnp.ndarray:
+    """jnp golden: per-8×8-block forward DCT + quantization of a sample
+    plane. ``(B, H, W) uint8 → (B, ⌈H/8⌉, ⌈W/8⌉, 8, 8) int16`` quantized
+    coefficients (natural order, level-shifted by −128, divided by
+    ``qtable`` with round-half-even). Unaligned H/W are edge-padded to
+    the block grid first — libjpeg's own edge replication. Bit-identity
+    with libjpeg's integer DCT is NOT claimed (it uses a scaled-integer
+    AAN transform); the pinned equivalence is decode tolerance, see
+    tests/test_delta_wire.py."""
+    squeeze = plane.ndim == 2
+    if squeeze:
+        plane = plane[None]
+    b, h, w = plane.shape
+    ph, pw = (-h) % 8, (-w) % 8
+    if ph or pw:
+        plane = jnp.pad(plane, ((0, 0), (0, ph), (0, pw)), mode="edge")
+        h, w = h + ph, w + pw
+    nby, nbx = h // 8, w // 8
+    qrecip = jnp.asarray(_qrecip_lanes(qtable, nbx))
+    out = _from_slab(_dct8x8_quant_slab_jit(_to_slab(plane, nby, nbx),
+                                            nbx, qrecip), nby, nbx)
+    return out[0] if squeeze else out
+
+
+def _dct8x8_quant_kernel(nbx: int):
+    def kernel(in_ref, q_ref, out_ref):
+        out_ref[0, 0, :, :] = _dct8x8_quant_slab(in_ref[0, 0], nbx,
+                                                 q_ref[...])
+    return kernel
+
+
+def dct8x8_quant_pallas(plane: jnp.ndarray, qtable,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Pallas DCT+quant: grid = (batch, block rows); each step transforms
+    one (8, W) block row entirely in VMEM/registers. The slab arrives in
+    interleaved lane order (see :func:`_dct8x8_quant_slab`) so both DCT
+    passes are static chunk slices + scalar multiply-adds — pure VPU
+    work, no gather, no in-kernel reshape. Requires H and W to be block
+    multiples (the dispatcher sends everything else to the golden)."""
+    interpret = _auto_interpret(interpret)
+    squeeze = plane.ndim == 2
+    if squeeze:
+        plane = plane[None]
+    b, h, w = plane.shape
+    if h % 8 or w % 8:
+        raise ValueError(f"dct8x8_quant_pallas needs H, W multiples of 8; "
+                         f"got {h}x{w}")
+    nby, nbx = h // 8, w // 8
+    lanes = 8 * nbx
+    qrecip = jnp.asarray(_qrecip_lanes(qtable, nbx))
+    out = pl.pallas_call(
+        _dct8x8_quant_kernel(nbx),
+        grid=(b, nby),
+        in_specs=[
+            pl.BlockSpec((1, 1, 8, lanes), lambda bb, ii: (bb, ii, 0, 0)),
+            pl.BlockSpec((8, lanes), lambda bb, ii: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 8, lanes),
+                               lambda bb, ii: (bb, ii, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nby, 8, lanes), jnp.float32),
+        interpret=interpret,
+    )(_to_slab(plane, nby, nbx), qrecip)
+    out = _from_slab(out, nby, nbx)
+    return out[0] if squeeze else out
+
+
+def dct8x8_quant(plane: jnp.ndarray, qtable,
+                 interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Dispatch: the Pallas kernel on block-aligned planes (compiled on
+    TPU, interpret elsewhere), the jnp golden (which edge-pads) for
+    unaligned geometries."""
+    h, w = plane.shape[-2], plane.shape[-1]
+    if h % 8 == 0 and w % 8 == 0:
+        return dct8x8_quant_pallas(plane, qtable, interpret=interpret)
+    return dct8x8_quant_ref(plane, qtable)

@@ -1,7 +1,7 @@
 """On-device codec assist: the transform half of the host JPEG cycle,
 moved onto the accelerator.
 
-Two device stages, both appended AFTER the filter program on the result
+Three device stages, all appended AFTER the filter program on the result
 batch (they consume the engine's output exactly where the egress plane
 fetches it, so their cost hides under the next batch's staging the same
 way the per-shard D2H does — the GPUOS operation-fusion discipline,
@@ -20,8 +20,13 @@ PAPERS.md arXiv:2604.17861, applied at the codec boundary):
   starts from HALF the bytes and skips its color-convert and
   downsample passes entirely: ``NativeJpegCodec.encode_ycbcr420`` runs
   DCT + quantization + entropy coding only (jpeg_write_raw_data).
+- :class:`FusedDeltaTransform` — the codec endgame: probe AND convert
+  AND per-8×8-block forward DCT AND quantization fused into ONE jitted
+  program per batch. Only dirty tiles' int16 coefficient blocks and the
+  bitmap cross D2H; the host runs entropy coding and nothing else
+  (``NativeJpegCodec.encode_coefficients``, jpeg_write_coefficients).
 
-Both are separate tiny jitted programs rather than a re-trace of the
+All are separate tiny jitted programs rather than a re-trace of the
 filter step: jax's async dispatch queues them back-to-back with the
 filter program (no host sync in between), the engine's compiled
 signature and every egress consumer stay untouched, and a path that
@@ -172,3 +177,114 @@ class DeviceCodecAssist:
     def planes(self, batch):
         y, cb, cr = self._fn(batch)
         return np.asarray(y), np.asarray(cb), np.asarray(cr)
+
+
+# -- full-transform assist: probe + convert + DCT + quant, ONE pass -----
+
+
+class FusedDeltaTransform:
+    """The codec endgame's device stage: dirty-tile probe, RGB→YCbCr
+    4:2:0, per-8×8-block forward DCT, and quantization as ONE fused
+    jitted program per batch (``ops.pallas_kernels.dct8x8_quant`` beside
+    ``tile_maxdiff``, inside a single jit — XLA schedules the whole
+    chain as one dispatch; ``calls`` counts dispatches so tests can pin
+    the one-dispatch-per-batch property). The host never sees pixels:
+    only dirty tiles' int16 coefficient blocks and the few-hundred-byte
+    bitmap cross D2H (``transport.codec.CoefficientFrame`` slices lazily),
+    and ``NativeJpegCodec.encode_coefficients`` does entropy coding and
+    nothing else.
+
+    Coefficients come out GROUPED BY DELTA TILE — y (B, nty, ntx, t/8,
+    t/8, 8, 8), cb/cr (B, nty, ntx, t/16, t/16, 8, 8) — so one dirty
+    tile is one contiguous slice. That forces ``tile % 16 == 0`` (chroma
+    blocks must not straddle tiles) and H, W multiples of the tile; gate
+    with :meth:`supports` and fall back to :class:`DeviceDeltaProbe` +
+    host encode elsewhere (e.g. 1080p, where H = 1080 isn't a multiple
+    of 32).
+
+    Probe semantics are identical to :class:`DeviceDeltaProbe` (same
+    ``tile_maxdiff``, same predecessor chaining, same all-dirty first
+    row) — at ``delta_threshold=0`` the dirty-tile SELECTION is
+    bit-identical to the host path's, which tests pin.
+    """
+
+    def __init__(self, tile: int = 32, quality: int = 90):
+        import jax
+        import jax.numpy as jnp
+
+        from dvf_tpu.ops.pallas_kernels import dct8x8_quant, jpeg_quant_table
+
+        if tile % 16:
+            raise ValueError(f"fused transform needs tile % 16 == 0 "
+                             f"(chroma blocks must tile), got {tile}")
+        self.tile = int(tile)
+        self.quality = int(quality)
+        self.calls = 0  # fused device dispatches (== batches processed)
+        self._prev = None
+        self._shape: Optional[Tuple[int, ...]] = None
+        ql = jpeg_quant_table(quality)
+        qc = jpeg_quant_table(quality, chroma=True)
+        t = self.tile
+
+        def group(q, bt):
+            # raster blocks (B, nby, nbx, 8, 8) → per-delta-tile
+            # (B, nty, ntx, bt, bt, 8, 8)
+            b, nby, nbx = q.shape[0], q.shape[1], q.shape[2]
+            return (q.reshape(b, nby // bt, bt, nbx // bt, bt, 8, 8)
+                    .transpose(0, 1, 3, 2, 4, 5, 6))
+
+        def fused(batch, prev):
+            chain = jnp.concatenate([prev, batch[:-1]], axis=0)
+            tiles = tile_maxdiff(batch, chain, t)
+            y, cb, cr = rgb_to_ycbcr420(batch)
+            yq = group(dct8x8_quant(y, ql), t // 8)
+            cbq = group(dct8x8_quant(cb, qc), t // 16)
+            crq = group(dct8x8_quant(cr, qc), t // 16)
+            return tiles, yq, cbq, crq, batch[-1:]
+
+        self._fn = jax.jit(fused)
+
+    @staticmethod
+    def supports(shape, tile: int) -> bool:
+        """Whether this batch geometry can take the fused path: (B, H,
+        W, 3) with H and W multiples of a tile that is itself a multiple
+        of 16."""
+        if len(shape) != 4 or shape[3] != 3:
+            return False
+        h, w = shape[1], shape[2]
+        return tile % 16 == 0 and h % tile == 0 and w % tile == 0
+
+    def process(self, batch):
+        """One fused dispatch → ``(bitmaps, coefficient_frames)``: a
+        host (B, nty, ntx) uint8 bitmap array and one lazy
+        :class:`~dvf_tpu.transport.codec.CoefficientFrame` per row
+        (nothing frame-sized crosses D2H here — the codec fetches dirty
+        tiles' blocks on demand)."""
+        from dvf_tpu.transport.codec import CoefficientFrame
+
+        shape = tuple(batch.shape)
+        if not self.supports(shape, self.tile):
+            raise ValueError(f"geometry {shape} unsupported at tile "
+                             f"{self.tile} (use supports() to gate)")
+        if self._prev is None or self._shape != shape:
+            # First batch: same semantics as DeviceDeltaProbe — only
+            # row 0 lacks a predecessor and is marked all-dirty.
+            self._shape = shape
+            tiles, yq, cbq, crq, self._prev = self._fn(batch, batch[:1])
+            self.calls += 1
+            bm = np.array(tiles)
+            bm[0] = 255
+        else:
+            tiles, yq, cbq, crq, self._prev = self._fn(batch, self._prev)
+            self.calls += 1
+            bm = np.asarray(tiles)
+        h, w = shape[1], shape[2]
+        frames = [CoefficientFrame(yq[i], cbq[i], crq[i], h, w, self.tile,
+                                   self.quality)
+                  for i in range(shape[0])]
+        return bm, frames
+
+    def reset(self) -> None:
+        """Drop the device state (geometry change, engine rebuild)."""
+        self._prev = None
+        self._shape = None

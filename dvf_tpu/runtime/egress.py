@@ -352,14 +352,23 @@ class AsyncCodecPlane:
         return len(self._pending)
 
     def submit(self, rows: Sequence[np.ndarray], metas: Sequence[Any],
-               bitmaps: Optional[Sequence[np.ndarray]] = None) -> None:
+               bitmaps: Optional[Sequence[np.ndarray]] = None,
+               coeffs: Optional[Sequence[Any]] = None) -> None:
         """``bitmaps`` (delta wire only): per-row device-computed dirty-
         tile reductions (runtime.codec_assist.DeviceDeltaProbe), handed
         through to ``DeltaCodec.encode_batch_async`` so the host skips
-        its own change-detection pass. Ignored by full-frame codecs."""
+        its own change-detection pass. Ignored by full-frame codecs.
+
+        ``coeffs`` (full-transform assist): per-row
+        ``transport.codec.CoefficientFrame`` handles from the fused
+        device pass — the codec entropy-codes device-quantized blocks
+        and never touches pixels, so ``rows`` may be ``[None, ...]``."""
         t0 = time.perf_counter()
         if self.jpeg:
-            if bitmaps is not None:
+            if coeffs is not None:
+                futures = self.codec.encode_batch_async(
+                    rows, bitmaps=bitmaps, coeffs=coeffs)
+            elif bitmaps is not None:
                 futures = self.codec.encode_batch_async(rows,
                                                         bitmaps=bitmaps)
             else:
@@ -407,6 +416,15 @@ class AsyncCodecPlane:
                 self.stats.record_encode(
                     encode_ms=(t_done - entry.t_submit) * 1e3,
                     wait_ms=wait_ms)
+                # Full-transform assist: drain the host entropy-coding
+                # time the codec accumulated for this batch — on that
+                # path it is the entire host codec cost (encode_ms wall
+                # span still includes pool queueing / drain overlap).
+                take = getattr(self.codec, "take_entropy_ms", None)
+                if take is not None:
+                    ms = take()
+                    if ms > 0.0:
+                        self.stats.record_entropy(ms)
             tracer = self.tracer
             if tracer is not None and tracer.enabled and entry.futures:
                 off = time.time() - time.perf_counter()

@@ -23,9 +23,12 @@ throughput outpacing the device) is a thread-count knob. Use
 from __future__ import annotations
 
 import ctypes
+import json
+import math
 import os
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
@@ -46,10 +49,12 @@ class JpegGeometryError(ValueError):
 
 
 class JpegCodec:
-    def __init__(self, quality: int = 90, threads: int = 4):
+    def __init__(self, quality: int = 90, threads: int = 4,
+                 assist: str = "none"):
         if not _HAS_CV2:
             raise ImportError("JpegCodec needs cv2 (baked into this environment)")
         self.quality = int(quality)
+        self.assist = str(assist)
         self.pool = ThreadPoolExecutor(max_workers=threads, thread_name_prefix="dvf-jpeg")
 
     # -- single frame ---------------------------------------------------
@@ -110,7 +115,7 @@ class JpegCodec:
         wire mode this codec implements — full-frame JPEG here; the
         temporal-delta wrapper reports ``"delta"`` plus its knobs."""
         return {"backend": "cv2", "wire": "jpeg", "quality": self.quality,
-                "threads": self.pool._max_workers}
+                "threads": self.pool._max_workers, "assist": self.assist}
 
     def close(self) -> None:
         # Join the pool: leaked codec threads across a long-lived server's
@@ -129,6 +134,7 @@ _shim: Optional[ctypes.CDLL] = None
 _shim_error: Optional[str] = None
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
+_i16p = ctypes.POINTER(ctypes.c_int16)
 
 
 def _load_shim() -> ctypes.CDLL:
@@ -180,6 +186,22 @@ def _load_shim() -> ctypes.CDLL:
                 _u8p, _u8p, _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 _u8p, ctypes.c_ulong,
             ]
+            # Full-transform assist entry (entropy coding only, from
+            # device-quantized DCT coefficient blocks).
+            lib.dvf_jpeg_encode_coefficients.restype = ctypes.c_long
+            lib.dvf_jpeg_encode_coefficients.argtypes = [
+                _i16p, _i16p, _i16p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, _u8p, ctypes.c_ulong,
+            ]
+            # Batched variant: one call entropy-codes N same-geometry
+            # tiles, amortizing the per-call setup that dominates small
+            # images (the delta wire's dirty-tile hot path).
+            lib.dvf_jpeg_encode_coefficients_batch.restype = ctypes.c_long
+            lib.dvf_jpeg_encode_coefficients_batch.argtypes = [
+                _i16p, _i16p, _i16p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, _u8p, ctypes.c_ulong,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
         except AttributeError:  # pragma: no cover — stale external .so
             pass
         _shim = lib
@@ -190,9 +212,11 @@ class NativeJpegCodec:
     """C++ libjpeg-turbo codec (SURVEY.md §2b): zero-copy decode into the
     device-transfer staging array. Same interface as :class:`JpegCodec`."""
 
-    def __init__(self, quality: int = 90, threads: int = 4):
+    def __init__(self, quality: int = 90, threads: int = 4,
+                 assist: str = "none"):
         self._lib = _load_shim()
         self.quality = int(quality)
+        self.assist = str(assist)
         self.pool = ThreadPoolExecutor(max_workers=threads, thread_name_prefix="dvf-jpeg")
         self._tls = threading.local()  # per-thread encode scratch
 
@@ -279,9 +303,12 @@ class NativeJpegCodec:
         return out
 
     def config(self) -> dict:
-        """Codec provenance for bench JSON (backend/wire/quality/threads)."""
+        """Codec provenance for bench JSON (backend/wire/quality/threads/
+        assist — ``assist`` names which device stage fed this codec:
+        ``none`` / ``ycbcr`` / ``full-transform``, so bench rows are
+        attributable to the path that produced them)."""
         return {"backend": "native", "wire": "jpeg", "quality": self.quality,
-                "threads": self.pool._max_workers}
+                "threads": self.pool._max_workers, "assist": self.assist}
 
     # -- codec assist (device-converted YCbCr 4:2:0 planes) -------------
 
@@ -320,6 +347,117 @@ class NativeJpegCodec:
         if n <= 0:
             raise ValueError(f"JPEG ycbcr420 encode failed (rc={n})")
         return bytes(memoryview(scratch)[: int(n)])
+
+    def encode_coefficients(self, yq: np.ndarray, cbq: np.ndarray,
+                            crq: np.ndarray, h: int, w: int) -> bytes:
+        """Entropy-only encode from PRE-QUANTIZED DCT coefficient blocks
+        (the full-transform assist: the device already ran level shift,
+        8×8 forward DCT, and quantization — ops.pallas_kernels.dct8x8_quant
+        with jpeg_quant_table(self.quality)): the host does Huffman
+        coding and nothing else (jpeg_write_coefficients).
+
+        ``yq`` is (⌈h/8⌉, ⌈w/8⌉, 8, 8) int16, ``cbq``/``crq`` are
+        (⌈h/16⌉, ⌈w/16⌉, 8, 8) int16 (4:2:0), blocks in natural
+        (row-major frequency) order. H and W must be even. The device
+        MUST have quantized with the same quality's IJG tables —
+        jpeg_quant_table mirrors jpeg_set_quality exactly, and the
+        equivalence ladder in tests/test_delta_wire.py pins the decoded
+        result against the host libjpeg path."""
+        if not hasattr(self._lib, "dvf_jpeg_encode_coefficients"):
+            raise RuntimeError("jpeg shim predates coefficient assist")
+        yq = np.ascontiguousarray(yq, dtype=np.int16)
+        cbq = np.ascontiguousarray(cbq, dtype=np.int16)
+        crq = np.ascontiguousarray(crq, dtype=np.int16)
+        if h % 2 or w % 2 or h <= 0 or w <= 0:
+            raise ValueError(f"coefficient encode needs even dims, got {h}x{w}")
+        nby, nbx = -(-h // 8), -(-w // 8)
+        ncy, ncx = -(-h // 16), -(-w // 16)
+        if (yq.shape != (nby, nbx, 8, 8) or cbq.shape != (ncy, ncx, 8, 8)
+                or crq.shape != (ncy, ncx, 8, 8)):
+            raise ValueError(
+                f"coefficient grids inconsistent with {h}x{w}: y {yq.shape} "
+                f"(want {(nby, nbx, 8, 8)}), cb {cbq.shape} / cr {crq.shape} "
+                f"(want {(ncy, ncx, 8, 8)})")
+        cap = h * w * 3 + 4096
+        scratch = getattr(self._tls, "scratch", None)
+        if scratch is None or len(scratch) < cap:
+            scratch = (ctypes.c_uint8 * cap)()
+            self._tls.scratch = scratch
+        n = self._lib.dvf_jpeg_encode_coefficients(
+            yq.ctypes.data_as(_i16p), cbq.ctypes.data_as(_i16p),
+            crq.ctypes.data_as(_i16p), h, w, self.quality, scratch,
+            len(scratch))
+        if n < 0 and n != -1:
+            scratch = (ctypes.c_uint8 * (-int(n)))()
+            self._tls.scratch = scratch
+            n = self._lib.dvf_jpeg_encode_coefficients(
+                yq.ctypes.data_as(_i16p), cbq.ctypes.data_as(_i16p),
+                crq.ctypes.data_as(_i16p), h, w, self.quality, scratch,
+                len(scratch))
+        if n <= 0:
+            raise ValueError(f"JPEG coefficient encode failed (rc={n})")
+        return bytes(memoryview(scratch)[: int(n)])
+
+    def encode_coefficients_batch(self, yqs: np.ndarray, cbqs: np.ndarray,
+                                  crqs: np.ndarray, h: int,
+                                  w: int) -> list:
+        """Entropy-only encode of N same-geometry coefficient images in
+        ONE native call — the delta wire's dirty-tile hot path. A 32×32
+        tile costs ~26 µs through :meth:`encode_coefficients` but only
+        ~0.5 µs/block of actual Huffman work; batching a frame's dirty
+        tiles makes the host's entropy stage scale with dirty BLOCKS,
+        not dirty TILES. ``yqs`` is (N, ⌈h/8⌉, ⌈w/8⌉, 8, 8) int16,
+        ``cbqs``/``crqs`` (N, ⌈h/16⌉, ⌈w/16⌉, 8, 8); returns N payload
+        ``bytes``, each decodable exactly like the single entry's."""
+        if not hasattr(self._lib, "dvf_jpeg_encode_coefficients_batch"):
+            raise RuntimeError("jpeg shim predates batched coefficient "
+                               "assist")
+        yqs = np.ascontiguousarray(yqs, dtype=np.int16)
+        cbqs = np.ascontiguousarray(cbqs, dtype=np.int16)
+        crqs = np.ascontiguousarray(crqs, dtype=np.int16)
+        if h % 2 or w % 2 or h <= 0 or w <= 0:
+            raise ValueError(f"coefficient encode needs even dims, "
+                             f"got {h}x{w}")
+        n = yqs.shape[0]
+        if n == 0:
+            return []
+        nby, nbx = -(-h // 8), -(-w // 8)
+        ncy, ncx = -(-h // 16), -(-w // 16)
+        if (yqs.shape != (n, nby, nbx, 8, 8)
+                or cbqs.shape != (n, ncy, ncx, 8, 8)
+                or crqs.shape != (n, ncy, ncx, 8, 8)):
+            raise ValueError(
+                f"coefficient grids inconsistent with {n}x{h}x{w}: "
+                f"y {yqs.shape} (want {(n, nby, nbx, 8, 8)}), "
+                f"cb {cbqs.shape} / cr {crqs.shape} "
+                f"(want {(n, ncy, ncx, 8, 8)})")
+        cap = n * (h * w * 3 + 4096)
+        scratch = getattr(self._tls, "batch_scratch", None)
+        if scratch is None or len(scratch) < cap:
+            scratch = (ctypes.c_uint8 * cap)()
+            self._tls.batch_scratch = scratch
+        sizes = (ctypes.c_uint32 * n)()
+        total = self._lib.dvf_jpeg_encode_coefficients_batch(
+            yqs.ctypes.data_as(_i16p), cbqs.ctypes.data_as(_i16p),
+            crqs.ctypes.data_as(_i16p), n, h, w, self.quality, scratch,
+            len(scratch), sizes)
+        if total < -1:
+            scratch = (ctypes.c_uint8 * (-int(total)))()
+            self._tls.batch_scratch = scratch
+            total = self._lib.dvf_jpeg_encode_coefficients_batch(
+                yqs.ctypes.data_as(_i16p), cbqs.ctypes.data_as(_i16p),
+                crqs.ctypes.data_as(_i16p), n, h, w, self.quality,
+                scratch, len(scratch), sizes)
+        if total <= 0:
+            raise ValueError(
+                f"batched JPEG coefficient encode failed (rc={total})")
+        view = memoryview(scratch)
+        out, off = [], 0
+        for i in range(n):
+            sz = int(sizes[i])
+            out.append(bytes(view[off: off + sz]))
+            off += sz
+        return out
 
     def close(self) -> None:
         # Join the pool (see JpegCodec.close): bounded by cancel_futures.
@@ -487,17 +625,18 @@ def jpeg_wire_budget(height: int, width: int, quality: int = 90,
     return out
 
 
-def make_codec(quality: int = 90, threads: int = 4):
+def make_codec(quality: int = 90, threads: int = 4, assist: str = "none"):
     """The production constructor: native C++ codec, falling back to the
     cv2-threaded one (with a one-line notice) if the shim can't build."""
     try:
-        return NativeJpegCodec(quality=quality, threads=threads)
+        return NativeJpegCodec(quality=quality, threads=threads,
+                               assist=assist)
     except (RuntimeError, OSError) as e:
         import sys
 
         print(f"[dvf] native jpeg shim unavailable ({e}); using cv2 codec",
               file=sys.stderr)
-        return JpegCodec(quality=quality, threads=threads)
+        return JpegCodec(quality=quality, threads=threads, assist=assist)
 
 
 # -- temporal-delta wire ------------------------------------------------
@@ -601,6 +740,169 @@ def host_tile_changed(a: np.ndarray, b: np.ndarray, tile: int,
     return host_tile_maxdiff(a, b, tile, scratch=scratch) > 0
 
 
+class CoefficientFrame:
+    """Device-side quantized DCT coefficients for ONE frame — the lazy
+    D2H handle the full-transform assist hands to :class:`DeltaCodec`.
+
+    Layout is grouped by DELTA tile (not by 8×8 block row), so one dirty
+    tile is one contiguous basic-index slice and the only pixels whose
+    coefficients ever cross D2H are the dirty ones::
+
+        yq        (nty, ntx, t/8,  t/8,  8, 8) int16
+        cbq, crq  (nty, ntx, t/16, t/16, 8, 8) int16   (4:2:0)
+
+    where ``t`` is the delta tile (a multiple of 16 so chroma blocks
+    never straddle a tile). The arrays are whatever the fused device
+    pass emitted (jax device arrays in production, numpy in tests) —
+    nothing is fetched until :meth:`fetch_dirty` / :meth:`frame_blocks`,
+    and ``d2h_bytes`` counts exactly what was (the egress-stats story of
+    the shrunken wire: coefficient bytes for dirty tiles instead of RGB
+    for the whole frame)."""
+
+    def __init__(self, yq, cbq, crq, h: int, w: int, tile: int,
+                 quality: int):
+        if tile % 16 or h % tile or w % tile:
+            raise ValueError(
+                f"coefficient frames need tile % 16 == 0 and H, W "
+                f"multiples of the tile; got {h}x{w} at tile {tile}")
+        self.yq, self.cbq, self.crq = yq, cbq, crq
+        self.h, self.w, self.tile = int(h), int(w), int(tile)
+        self.quality = int(quality)
+        self.d2h_bytes = 0
+        nty, ntx = h // tile, w // tile
+        bt, ct = tile // 8, tile // 16
+        want_y = (nty, ntx, bt, bt, 8, 8)
+        want_c = (nty, ntx, ct, ct, 8, 8)
+        if (tuple(yq.shape) != want_y or tuple(cbq.shape) != want_c
+                or tuple(crq.shape) != want_c):
+            raise ValueError(
+                f"coefficient grids inconsistent: y {tuple(yq.shape)} "
+                f"(want {want_y}), cb {tuple(cbq.shape)} / cr "
+                f"{tuple(crq.shape)} (want {want_c})")
+
+    def grid(self):
+        """(n_tiles_y, n_tiles_x) — the delta bitmap geometry."""
+        return self.h // self.tile, self.w // self.tile
+
+    def fetch_dirty(self, dirty: np.ndarray):
+        """One D2H gather per plane of JUST the dirty tiles' blocks:
+        ``(ys, cbs, crs)`` packed in bitmap row-major order (the delta
+        wire's tile order), ys[k] being the (t/8, t/8, 8, 8) block grid
+        of the k-th dirty tile — exactly what ``encode_coefficients``
+        wants for a t×t tile image.
+
+        When the planes already live in host memory (numpy, or jax
+        arrays on the CPU backend) the gather runs in numpy: a device
+        gather there is pure dispatch overhead (~5 ms/frame on this
+        host vs ~0.01 ms for the host mask) with no link to shrink.
+        ``d2h_bytes`` still counts only the dirty tiles' bytes — it
+        records what the WIRE needs from the device, which is the
+        number that survives a move to a real accelerator."""
+        mask = np.ascontiguousarray(dirty, dtype=bool)
+        on_host = isinstance(self.yq, np.ndarray)
+        if not on_host:
+            devs = getattr(self.yq, "devices", None)
+            if devs is not None:
+                try:
+                    on_host = all(d.platform == "cpu" for d in devs())
+                except TypeError:
+                    pass
+        if on_host:
+            ys = np.ascontiguousarray(np.asarray(self.yq)[mask])
+            cbs = np.ascontiguousarray(np.asarray(self.cbq)[mask])
+            crs = np.ascontiguousarray(np.asarray(self.crq)[mask])
+        else:
+            ys = np.asarray(self.yq[mask])
+            cbs = np.asarray(self.cbq[mask])
+            crs = np.asarray(self.crq[mask])
+        self.d2h_bytes += ys.nbytes + cbs.nbytes + crs.nbytes
+        return ys, cbs, crs
+
+    def frame_blocks(self):
+        """Full-frame block grids for a keyframe: ``(y, cb, cr)`` with
+        y (h/8, w/8, 8, 8) and cb/cr (h/16, w/16, 8, 8) — the per-tile
+        grouping unfolded back to raster block order (host-side, after
+        one whole-plane D2H per component)."""
+        y = np.asarray(self.yq)
+        cb = np.asarray(self.cbq)
+        cr = np.asarray(self.crq)
+        self.d2h_bytes += y.nbytes + cb.nbytes + cr.nbytes
+
+        def unfold(a):
+            nty, ntx, bt = a.shape[0], a.shape[1], a.shape[2]
+            return (a.transpose(0, 2, 1, 3, 4, 5)
+                    .reshape(nty * bt, ntx * bt, 8, 8))
+
+        return unfold(y), unfold(cb), unfold(cr)
+
+
+def entropy_pool_size(cores: Optional[int] = None) -> int:
+    """Entropy-pool width from MEASURED stage costs (the TVM discipline:
+    size from data, not guesses). benchmarks/CODEC_BENCH.json's
+    ``stage_costs.entropy_share`` records what fraction of the classic
+    full encode cycle survives on the host once the transform moved to
+    the device; the pool only needs that share of the cores the full
+    codec pool would have used. Falls back to half the cores when the
+    table hasn't been regenerated on this checkout."""
+    cores = cores or os.cpu_count() or 1
+    share = 0.5
+    try:
+        path = os.path.join(os.path.dirname(os.path.dirname(_DIR)),
+                            "benchmarks", "CODEC_BENCH.json")
+        with open(path) as f:
+            share = float(json.load(f)["stage_costs"]["entropy_share"])
+    except (OSError, KeyError, ValueError, TypeError):
+        pass
+    return max(1, min(cores, math.ceil(cores * min(1.0, max(0.05, share)))))
+
+
+class EntropyPool:
+    """Host-wide entropy-coding pool for the full-transform assist — ONE
+    shared ThreadPoolExecutor that interleaves every stream's dirty-tile
+    coefficient blocks across the host cores (N worker streams sharing
+    cores beats N private pools fighting over them; each DeltaCodec
+    already serializes its own frames on its ordered worker, so the
+    shared pool only ever sees independent per-tile jobs). Acquired
+    refcounted via :func:`acquire_entropy_pool` and released on codec
+    close — the conftest leak guard watches the ``dvf-jpeg-entropy``
+    thread prefix the same way it watches the codec pools."""
+
+    def __init__(self, workers: Optional[int] = None):
+        self.workers = int(workers) if workers else entropy_pool_size()
+        self._ex = ThreadPoolExecutor(max_workers=self.workers,
+                                      thread_name_prefix="dvf-jpeg-entropy")
+
+    def map(self, fn, *iterables):
+        return list(self._ex.map(fn, *iterables))
+
+    def shutdown(self) -> None:
+        self._ex.shutdown(wait=True, cancel_futures=True)
+
+
+_entropy_lock = threading.Lock()
+_entropy_pool: Optional[EntropyPool] = None
+_entropy_refs = 0
+
+
+def acquire_entropy_pool() -> EntropyPool:
+    global _entropy_pool, _entropy_refs
+    with _entropy_lock:
+        if _entropy_pool is None:
+            _entropy_pool = EntropyPool()
+        _entropy_refs += 1
+        return _entropy_pool
+
+
+def release_entropy_pool() -> None:
+    global _entropy_pool, _entropy_refs
+    with _entropy_lock:
+        _entropy_refs -= 1
+        if _entropy_refs <= 0 and _entropy_pool is not None:
+            _entropy_pool.shutdown()
+            _entropy_pool = None
+            _entropy_refs = 0
+
+
 class DeltaCodec:
     """Temporal-delta wire over an inner full-frame codec.
 
@@ -683,6 +985,17 @@ class DeltaCodec:
         self._enc_seq = 0
         self._since_key = 0
         self._force_key = True
+        # full-transform assist state (coefficient wire): provenance,
+        # geometry pin, shared entropy pool handle, stage accounting.
+        # Inherit the inner codec's pre-stamped provenance (make_wire_codec
+        # assist=); flips to "full-transform" on the first coeff encode.
+        self.assist = getattr(self.inner, "assist", "none")
+        self._coef_geom: Optional[tuple] = None
+        self._entropy: Optional[EntropyPool] = None
+        self.entropy_ms = 0.0          # lifetime total (stats())
+        self._entropy_ms_pending = 0.0  # drained by take_entropy_ms()
+        self.d2h_coef_bytes = 0
+        self.coef_frames = 0
         # decoder state
         self._dec_ref: Optional[np.ndarray] = None
         self._dec_seq: Optional[int] = None
@@ -723,6 +1036,9 @@ class DeltaCodec:
             self._enc_ref = np.empty_like(frame)
             self._enc_scratch = (np.empty_like(frame), np.empty_like(frame))
         np.copyto(self._enc_ref, frame)
+        # A pixel keyframe invalidates any coefficient-wire geometry pin
+        # (and vice versa): switching paths mid-stream must re-key.
+        self._coef_geom = None
         self._since_key = 0
         self._force_key = False
         self.keyframes += 1
@@ -732,12 +1048,24 @@ class DeltaCodec:
         return hasattr(self.inner, "encode_batch_async") and not isinstance(
             self.inner, RawCodec)
 
-    def encode(self, frame: np.ndarray,
-               bitmap: Optional[np.ndarray] = None) -> bytes:
+    def encode(self, frame: Optional[np.ndarray],
+               bitmap: Optional[np.ndarray] = None,
+               coeffs: Optional[CoefficientFrame] = None) -> bytes:
         """One frame → one framed wire payload. ``bitmap`` is an optional
         device-computed (n_tiles_y, n_tiles_x) max-abs-diff reduction
         (runtime.codec_assist.DeviceDeltaProbe) — when given, the host
-        skips its own change-detection pass entirely."""
+        skips its own change-detection pass entirely.
+
+        With ``coeffs`` (a :class:`CoefficientFrame` from the fused
+        probe→convert→DCT→quant device pass), ``frame`` may be None: the
+        host never sees pixels at all. The bitmap is then REQUIRED (it
+        came out of the same fused dispatch), dirty tiles ship as
+        u32-length-prefixed JPEGs entropy-coded from the device-quantized
+        blocks, and keyframes as one full-frame coefficient JPEG — the
+        wire framing, flags, and decoder are unchanged, so any delta
+        peer decodes it."""
+        if coeffs is not None:
+            return self._encode_coeffs(coeffs, bitmap)
         frame = np.ascontiguousarray(frame, dtype=np.uint8)
         if frame.ndim != 3 or frame.shape[2] != 3:
             raise ValueError(f"delta wire carries (H, W, 3) uint8 frames, "
@@ -820,6 +1148,146 @@ class DeltaCodec:
             self._enc_seq += 1
             blob = b"".join(parts)
             self.payload_bytes += len(blob)
+            return blob
+
+    # -- full-transform assist (coefficient wire) -----------------------
+
+    def _encode_coeff_keyframe(self, cf: CoefficientFrame,
+                               h: int, w: int) -> bytes:
+        y, cb, cr = cf.frame_blocks()
+        t0 = time.perf_counter()
+        payload = self.inner.encode_coefficients(y, cb, cr, h, w)
+        self._note_entropy((time.perf_counter() - t0) * 1e3)
+        header = _DELTA_HEADER.pack(
+            DELTA_MAGIC, DELTA_VERSION, _DELTA_FLAG_KEY,
+            self._enc_seq & 0xFFFFFFFF, h, w, self.tile)
+        # Coefficient keyframes carry no pixels: drop the pixel-path
+        # reference so a later pixel encode re-keys instead of diffing
+        # against a stale frame.
+        self._enc_ref = None
+        self._coef_geom = (h, w)
+        self._since_key = 0
+        self._force_key = False
+        self.keyframes += 1
+        return header + payload
+
+    def _note_entropy(self, ms: float) -> None:
+        self.entropy_ms += ms
+        self._entropy_ms_pending += ms
+
+    def take_entropy_ms(self) -> float:
+        """Drain entropy-stage wall time accumulated since the last call
+        — the AsyncCodecPlane's hook for EgressStats ``entropy_ms`` (the
+        number that replaces ``encode_ms`` as the host-cost story on the
+        full-transform wire)."""
+        with self._enc_lock:
+            v = self._entropy_ms_pending
+            self._entropy_ms_pending = 0.0
+            return v
+
+    def _entropy_encode(self, ys, cbs, crs, t: int, n_dirty: int) -> list:
+        """Per-tile JPEG payloads for a frame's dirty tiles, in bitmap
+        order. Prefers the shim's batched entry (one native call per
+        pool worker's contiguous chunk — per-call setup is ~3× the
+        actual Huffman work at delta-tile sizes), falling back to the
+        per-tile map when the shim predates it."""
+        batch = getattr(self.inner, "encode_coefficients_batch", None)
+        if batch is not None and hasattr(
+                getattr(self.inner, "_lib", None),
+                "dvf_jpeg_encode_coefficients_batch"):
+            workers = min(getattr(self._entropy, "workers", 1), n_dirty)
+            if workers <= 1:
+                return batch(ys, cbs, crs, t, t)
+            # Contiguous chunks, one batched native call each, fanned
+            # across the shared pool — parallelism across chunks,
+            # amortized setup within them.
+            bounds = [(k * n_dirty) // workers
+                      for k in range(workers + 1)]
+            chunks = self._entropy.map(
+                lambda k: batch(ys[bounds[k]:bounds[k + 1]],
+                                cbs[bounds[k]:bounds[k + 1]],
+                                crs[bounds[k]:bounds[k + 1]], t, t),
+                range(workers))
+            return [enc for chunk in chunks for enc in chunk]
+        return self._entropy.map(
+            lambda k: self.inner.encode_coefficients(
+                ys[k], cbs[k], crs[k], t, t), range(n_dirty))
+
+    def _encode_coeffs(self, cf: CoefficientFrame,
+                       bitmap: Optional[np.ndarray]) -> bytes:
+        if not hasattr(self.inner, "encode_coefficients"):
+            raise RuntimeError(
+                "full-transform assist needs the native shim's "
+                "encode_coefficients (cv2 fallback can't entropy-code "
+                "coefficient blocks)")
+        if cf.tile != self.tile:
+            raise ValueError(f"coefficient frame tile {cf.tile} != codec "
+                             f"tile {self.tile}")
+        if cf.quality != getattr(self.inner, "quality", cf.quality):
+            raise ValueError(
+                f"coefficient frame quantized at quality {cf.quality}, "
+                f"inner codec entropy-codes for "
+                f"{getattr(self.inner, 'quality', None)} — the tables "
+                f"must match or every peer decodes garbage")
+        h, w = cf.h, cf.w
+        with self._enc_lock:
+            if self._entropy is None:
+                self._entropy = acquire_entropy_pool()
+            self.assist = "full-transform"
+            self.frames += 1
+            self.coef_frames += 1
+            geometry_changed = self._coef_geom != (h, w)
+            if (self.full_frames or self._force_key or geometry_changed
+                    or self._since_key >= self.keyframe_interval):
+                blob = self._encode_coeff_keyframe(cf, h, w)
+                self._enc_seq += 1
+                self.payload_bytes += len(blob)
+                self.d2h_coef_bytes += cf.d2h_bytes
+                return blob
+            nty, ntx, nbytes = self._tiles(h, w)
+            if bitmap is None:
+                raise ValueError(
+                    "coefficient encode needs the device-probe bitmap "
+                    "(the host has no pixels to diff)")
+            diff = np.asarray(bitmap, dtype=np.uint8)
+            if diff.shape != (nty, ntx):
+                raise ValueError(
+                    f"bitmap is {diff.shape}, geometry wants "
+                    f"({nty}, {ntx}) at tile {self.tile}")
+            dirty = diff > self.delta_threshold
+            n_dirty = int(dirty.sum())
+            if n_dirty >= self.scene_cut_ratio * nty * ntx:
+                self.scene_cuts += 1
+                blob = self._encode_coeff_keyframe(cf, h, w)
+                self._enc_seq += 1
+                self.payload_bytes += len(blob)
+                self.d2h_coef_bytes += cf.d2h_bytes
+                return blob
+            self.total_tiles += nty * ntx
+            self.dirty_tiles += n_dirty
+            # Delta frames on the coefficient wire are never LOSSLESS
+            # (tiles are JPEGs from quantized blocks); the header flag
+            # says so and the unchanged decoder composites accordingly.
+            parts = [
+                _DELTA_HEADER.pack(
+                    DELTA_MAGIC, DELTA_VERSION, 0,
+                    self._enc_seq & 0xFFFFFFFF, h, w, self.tile),
+                np.packbits(dirty).tobytes(),
+            ]
+            if n_dirty:
+                ys, cbs, crs = cf.fetch_dirty(dirty)
+                t = self.tile
+                t0 = time.perf_counter()
+                encs = self._entropy_encode(ys, cbs, crs, t, n_dirty)
+                self._note_entropy((time.perf_counter() - t0) * 1e3)
+                for enc in encs:
+                    parts.append(struct.pack("<I", len(enc)))
+                    parts.append(enc)
+            self._since_key += 1
+            self._enc_seq += 1
+            blob = b"".join(parts)
+            self.payload_bytes += len(blob)
+            self.d2h_coef_bytes += cf.d2h_bytes
             return blob
 
     # -- decoder --------------------------------------------------------
@@ -1012,18 +1480,24 @@ class DeltaCodec:
     # -- batched (order-preserving) -------------------------------------
 
     def encode_batch(self, frames: Sequence[np.ndarray],
-                     bitmaps: Optional[Sequence[np.ndarray]] = None
+                     bitmaps: Optional[Sequence[np.ndarray]] = None,
+                     coeffs: Optional[Sequence[CoefficientFrame]] = None
                      ) -> List[bytes]:
-        return [self.encode(f, None if bitmaps is None else bitmaps[i])
+        return [self.encode(f, None if bitmaps is None else bitmaps[i],
+                            None if coeffs is None else coeffs[i])
                 for i, f in enumerate(frames)]
 
     def encode_batch_async(self, frames: Sequence[np.ndarray],
-                           bitmaps: Optional[Sequence[np.ndarray]] = None
-                           ) -> list:
+                           bitmaps: Optional[Sequence[np.ndarray]] = None,
+                           coeffs: Optional[Sequence[CoefficientFrame]]
+                           = None) -> list:
         """Per-frame futures in frame order (the AsyncCodecPlane entry
         point), resolved by ONE ordered worker: delta encoding is
         stateful, so two batches must never interleave — the plane's
-        submission order IS the wire order."""
+        submission order IS the wire order. On the full-transform wire
+        ``frames`` is a row of Nones and ``coeffs`` carries the device
+        handles; the ordered worker still serializes frames while the
+        shared entropy pool parallelizes tiles WITHIN each frame."""
         from concurrent.futures import Future
 
         futs = [Future() for _ in frames]
@@ -1036,7 +1510,8 @@ class DeltaCodec:
                     continue
                 try:
                     fut.set_result(self.encode(
-                        f, None if bitmaps is None else bitmaps[i]))
+                        f, None if bitmaps is None else bitmaps[i],
+                        None if coeffs is None else coeffs[i]))
                 except BaseException as e:  # noqa: BLE001 — per-row error
                     fut.set_exception(e)
 
@@ -1072,7 +1547,14 @@ class DeltaCodec:
             delta_threshold=self.delta_threshold,
             lossless_tiles=self.lossless,
             scene_cut_ratio=self.scene_cut_ratio,
+            # Assist provenance (none / ycbcr / full-transform): which
+            # device stage fed this codec — flips to full-transform the
+            # moment a CoefficientFrame is encoded, so bench rows and
+            # worker stats are attributable to the path that actually ran.
+            assist=self.assist,
         )
+        if self._entropy is not None:
+            cfg["entropy_workers"] = self._entropy.workers
         return cfg
 
     def stats(self) -> dict:
@@ -1090,6 +1572,10 @@ class DeltaCodec:
             "decode_frames": self.decode_frames,
             "resyncs": self.resyncs,
             "full_frames": self.full_frames,
+            "assist": self.assist,
+            "coef_frames": self.coef_frames,
+            "entropy_ms": round(self.entropy_ms, 3),
+            "d2h_coef_bytes": self.d2h_coef_bytes,
         }
 
     def close(self) -> None:
@@ -1104,6 +1590,11 @@ class DeltaCodec:
                 except Exception:  # noqa: BLE001 — racing completion
                     pass
         self._async_pending = []
+        if self._entropy is not None:
+            # Refcounted: the shared entropy pool joins when the LAST
+            # coefficient-wire codec closes (conftest leak guard).
+            self._entropy = None
+            release_entropy_pool()
         self.inner.close()
 
 
@@ -1133,21 +1624,25 @@ class RawCodec:
 
     def config(self) -> dict:
         return {"backend": "raw", "wire": "raw", "quality": None,
-                "threads": 0}
+                "threads": 0, "assist": "none"}
 
     def close(self) -> None:
         pass
 
 
 def make_wire_codec(wire: str, quality: int = 90, threads: int = 4,
-                    raw_shape=None, **delta_kw):
+                    raw_shape=None, assist: str = "none", **delta_kw):
     """One constructor for every wire mode: ``"jpeg"`` → the plain
     full-frame codec, ``"delta"`` → :class:`DeltaCodec` over it,
-    ``"raw"`` → :class:`RawCodec` (needs ``raw_shape``)."""
+    ``"raw"`` → :class:`RawCodec` (needs ``raw_shape``). ``assist``
+    pre-stamps the inner codec's provenance (none / ycbcr /
+    full-transform) so config() rows are attributable even before the
+    first assisted encode lands."""
     if wire == "jpeg":
-        return make_codec(quality=quality, threads=threads)
+        return make_codec(quality=quality, threads=threads, assist=assist)
     if wire == "delta":
-        return DeltaCodec(make_codec(quality=quality, threads=threads),
+        return DeltaCodec(make_codec(quality=quality, threads=threads,
+                                     assist=assist),
                           **delta_kw)
     if wire == "raw":
         if raw_shape is None:

@@ -249,4 +249,202 @@ long dvf_jpeg_encode_ycbcr420(const unsigned char* y,
   return written;
 }
 
+// Full-transform codec-assist entry: entropy-code PRE-QUANTIZED DCT
+// coefficient blocks (device-side DCT + quantization,
+// ops/pallas_kernels.py dct8x8_quant) via jpeg_write_coefficients — the
+// host does Huffman coding and nothing else. Blocks are int16 in NATURAL
+// (row-major frequency) order, already divided by the tables
+// jpeg_set_quality(quality, force_baseline=TRUE) installs (the device
+// uses the same IJG formula, jpeg_quant_table); libjpeg applies the
+// zigzag during entropy coding. yq is ceil(h/8)*ceil(w/8) blocks of 64,
+// row-major over the block grid; cbq/crq are ceil(h/16)*ceil(w/16)
+// blocks (4:2:0). h and w must be even (the device stage pads).
+// Virtual-array rows beyond the provided grid (iMCU rounding) stay
+// zero — the decoder discards that region, so zero padding is exact.
+// Returns bytes written (>0), -needed if out_cap was too small, 0 on
+// error, -1 on odd dims.
+long dvf_jpeg_encode_coefficients(const short* yq, const short* cbq,
+                                  const short* crq, int h, int w,
+                                  int quality, unsigned char* out,
+                                  unsigned long out_cap) {
+  if (h % 2 || w % 2 || h <= 0 || w <= 0) return -1;
+  jpeg_compress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  unsigned char* buf = out;
+  unsigned long sz = out_cap;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    return 0;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &buf, &sz);
+  cinfo.image_width = static_cast<JDIMENSION>(w);
+  cinfo.image_height = static_cast<JDIMENSION>(h);
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_YCbCr;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  cinfo.comp_info[0].h_samp_factor = 2;
+  cinfo.comp_info[0].v_samp_factor = 2;
+  cinfo.comp_info[1].h_samp_factor = 1;
+  cinfo.comp_info[1].v_samp_factor = 1;
+  cinfo.comp_info[2].h_samp_factor = 1;
+  cinfo.comp_info[2].v_samp_factor = 1;
+  // Caller-provided block grids (tight: exactly covering the image).
+  const int nby[3] = {(h + 7) / 8, (h + 15) / 16, (h + 15) / 16};
+  const int nbx[3] = {(w + 7) / 8, (w + 15) / 16, (w + 15) / 16};
+  const short* src[3] = {yq, cbq, crq};
+  // Virtual coefficient arrays must be requested BEFORE
+  // jpeg_write_coefficients (which realizes them) and filled after,
+  // with dims rounded up to the sampling factors — the coefficient
+  // controller reads whole iMCU rows, v_samp block rows at a time.
+  jvirt_barray_ptr coef[3];
+  for (int ci = 0; ci < 3; ++ci) {
+    const int hs = cinfo.comp_info[ci].h_samp_factor;
+    const int vs = cinfo.comp_info[ci].v_samp_factor;
+    const JDIMENSION wib =
+        static_cast<JDIMENSION>((nbx[ci] + hs - 1) / hs * hs);
+    const JDIMENSION hib =
+        static_cast<JDIMENSION>((nby[ci] + vs - 1) / vs * vs);
+    coef[ci] = (*cinfo.mem->request_virt_barray)(
+        reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE,
+        TRUE /* pre_zero: iMCU-rounding padding blocks stay 0 */, wib,
+        hib, static_cast<JDIMENSION>(vs));
+  }
+  jpeg_write_coefficients(&cinfo, coef);
+  for (int ci = 0; ci < 3; ++ci) {
+    const int vs = cinfo.comp_info[ci].v_samp_factor;
+    for (int by = 0; by < nby[ci]; by += vs) {
+      JBLOCKARRAY rows = (*cinfo.mem->access_virt_barray)(
+          reinterpret_cast<j_common_ptr>(&cinfo), coef[ci],
+          static_cast<JDIMENSION>(by), static_cast<JDIMENSION>(vs), TRUE);
+      const int nrows = by + vs <= nby[ci] ? vs : nby[ci] - by;
+      for (int r = 0; r < nrows; ++r) {
+        memcpy(rows[r],
+               src[ci] + (static_cast<size_t>(by + r) * nbx[ci]) * DCTSIZE2,
+               static_cast<size_t>(nbx[ci]) * DCTSIZE2 * sizeof(JCOEF));
+      }
+    }
+  }
+  jpeg_finish_compress(&cinfo);
+  unsigned char* fin = buf;
+  unsigned long fsz = sz;
+  long written;
+  if (fin == out) {
+    written = static_cast<long>(fsz);
+  } else if (fsz <= out_cap) {
+    memcpy(out, fin, fsz);
+    free(fin);
+    written = static_cast<long>(fsz);
+  } else {
+    free(fin);
+    written = -static_cast<long>(fsz);
+  }
+  jpeg_destroy_compress(&cinfo);
+  return written;
+}
+
+// Batched variant: n same-geometry coefficient images (the delta wire's
+// dirty tiles) entropy-coded in ONE call, reusing one compress object
+// across images (libjpeg supports sequential multi-image reuse; the
+// JPOOL_IMAGE pool is released by each finish_compress). This exists
+// because the per-call cost dominates small tiles: one 32x32 tile costs
+// ~26 us through the single entry (ctypes + struct setup + table init)
+// but only ~0.5 us/block of actual Huffman work — batching all of a
+// frame's dirty tiles into one call makes the host's entropy stage
+// scale with dirty BLOCKS, not dirty TILES. Planes are packed
+// contiguously per image (image i's yq at yq + i*ceil(h/8)*ceil(w/8)*64,
+// chroma at i*ceil(h/16)*ceil(w/16)*64). JPEGs land back-to-back in
+// `out`; sizes[i] gets image i's byte length. Returns total bytes
+// (>0), 0 on a libjpeg error, -1 on bad dims/count, -needed (a lower
+// bound) if out_cap ran out.
+long dvf_jpeg_encode_coefficients_batch(const short* yq, const short* cbq,
+                                        const short* crq, int n, int h,
+                                        int w, int quality,
+                                        unsigned char* out,
+                                        unsigned long out_cap,
+                                        unsigned int* sizes) {
+  if (h % 2 || w % 2 || h <= 0 || w <= 0 || n <= 0) return -1;
+  const int nby[3] = {(h + 7) / 8, (h + 15) / 16, (h + 15) / 16};
+  const int nbx[3] = {(w + 7) / 8, (w + 15) / 16, (w + 15) / 16};
+  const size_t ystride =
+      static_cast<size_t>(nby[0]) * nbx[0] * DCTSIZE2;
+  const size_t cstride =
+      static_cast<size_t>(nby[1]) * nbx[1] * DCTSIZE2;
+  jpeg_compress_struct cinfo;
+  ErrMgr err;
+  install(&cinfo, &err);
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    return 0;
+  }
+  jpeg_create_compress(&cinfo);
+  unsigned long off = 0;
+  for (int i = 0; i < n; ++i) {
+    unsigned char* buf = out + off;
+    unsigned long sz = out_cap - off;
+    jpeg_mem_dest(&cinfo, &buf, &sz);
+    cinfo.image_width = static_cast<JDIMENSION>(w);
+    cinfo.image_height = static_cast<JDIMENSION>(h);
+    cinfo.input_components = 3;
+    cinfo.in_color_space = JCS_YCbCr;
+    jpeg_set_defaults(&cinfo);
+    jpeg_set_quality(&cinfo, quality, TRUE);
+    cinfo.comp_info[0].h_samp_factor = 2;
+    cinfo.comp_info[0].v_samp_factor = 2;
+    cinfo.comp_info[1].h_samp_factor = 1;
+    cinfo.comp_info[1].v_samp_factor = 1;
+    cinfo.comp_info[2].h_samp_factor = 1;
+    cinfo.comp_info[2].v_samp_factor = 1;
+    const short* src[3] = {yq + i * ystride, cbq + i * cstride,
+                           crq + i * cstride};
+    jvirt_barray_ptr coef[3];
+    for (int ci = 0; ci < 3; ++ci) {
+      const int hs = cinfo.comp_info[ci].h_samp_factor;
+      const int vs = cinfo.comp_info[ci].v_samp_factor;
+      const JDIMENSION wib =
+          static_cast<JDIMENSION>((nbx[ci] + hs - 1) / hs * hs);
+      const JDIMENSION hib =
+          static_cast<JDIMENSION>((nby[ci] + vs - 1) / vs * vs);
+      coef[ci] = (*cinfo.mem->request_virt_barray)(
+          reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE, TRUE, wib,
+          hib, static_cast<JDIMENSION>(vs));
+    }
+    jpeg_write_coefficients(&cinfo, coef);
+    for (int ci = 0; ci < 3; ++ci) {
+      const int vs = cinfo.comp_info[ci].v_samp_factor;
+      for (int by = 0; by < nby[ci]; by += vs) {
+        JBLOCKARRAY rows = (*cinfo.mem->access_virt_barray)(
+            reinterpret_cast<j_common_ptr>(&cinfo), coef[ci],
+            static_cast<JDIMENSION>(by), static_cast<JDIMENSION>(vs),
+            TRUE);
+        const int nrows = by + vs <= nby[ci] ? vs : nby[ci] - by;
+        for (int r = 0; r < nrows; ++r) {
+          memcpy(rows[r],
+                 src[ci] +
+                     (static_cast<size_t>(by + r) * nbx[ci]) * DCTSIZE2,
+                 static_cast<size_t>(nbx[ci]) * DCTSIZE2 * sizeof(JCOEF));
+        }
+      }
+    }
+    jpeg_finish_compress(&cinfo);
+    if (buf != out + off || sz > out_cap - off) {
+      // jpeg_mem_dest outgrew the caller's remaining space and
+      // realloc'd its own buffer: report a lower bound on the needed
+      // capacity so the caller can retry (or fall back to singles).
+      if (buf != out + off) free(buf);
+      jpeg_destroy_compress(&cinfo);
+      return -static_cast<long>(
+          off + sz +
+          static_cast<unsigned long>(n - 1 - i) *
+              (static_cast<unsigned long>(h) * w * 3 + 4096));
+    }
+    sizes[i] = static_cast<unsigned int>(sz);
+    off += sz;
+  }
+  jpeg_destroy_compress(&cinfo);
+  return static_cast<long>(off);
+}
+
 }  // extern "C"

@@ -84,6 +84,7 @@ class RingFrameQueue:
         delta_tile: int = 32,
         delta_keyframe_interval: int = 48,
         delta_threshold: int = 0,
+        codec_assist: str = "none",
         audit_wire: bool = False,
         chaos=None,
     ):
@@ -101,9 +102,15 @@ class RingFrameQueue:
         self.codec_pool_threads = codec_threads
         self.codec = None
         self._dec_codec = None
+        # ``codec_assist`` here is PROVENANCE, not behavior: the serve
+        # tier's ring is an ingest-side host wire (source → pipeline), so
+        # the device transform cannot feed it — the stamp makes bench
+        # rows attributable to the assist tier the run requested (the
+        # worker tier is where "full" changes the dataflow).
         if wire == "jpeg":
             self.codec = make_wire_codec("jpeg", quality=jpeg_quality,
-                                         threads=codec_threads)
+                                         threads=codec_threads,
+                                         assist=codec_assist)
             self._dec_codec = self.codec  # stateless: one instance, both ends
         elif wire == "delta":
             # Distinct encoder/decoder instances — DeltaCodec keeps
@@ -113,6 +120,7 @@ class RingFrameQueue:
             def _delta():
                 return make_wire_codec(
                     "delta", quality=jpeg_quality, threads=codec_threads,
+                    assist=codec_assist,
                     tile=delta_tile,
                     keyframe_interval=delta_keyframe_interval,
                     delta_threshold=delta_threshold,

@@ -117,6 +117,7 @@ class TpuZmqWorker:
         delta_keyframe_interval: int = 16,
         delta_threshold: int = 0,
         delta_device: bool = False,
+        codec_assist: str = "none",
         audit_wire: bool = False,
         ledger: bool = True,
     ):
@@ -181,7 +182,42 @@ class TpuZmqWorker:
         else:
             self.codec = make_wire_codec("jpeg", quality=jpeg_quality,
                                          threads=codec_threads)
+        if codec_assist not in ("none", "probe", "full"):
+            raise ValueError(f"codec_assist must be one of "
+                             f"('none', 'probe', 'full'), got {codec_assist!r}")
+        if codec_assist == "probe":
+            delta_device = True  # alias: probe assist IS --delta-device
+        self.codec_assist = codec_assist
         self._probe = None
+        self._fused = None
+        self._fused_geom_warned = False
+        if wire == "delta" and codec_assist == "full":
+            # Full-transform assist: probe→convert→DCT→quant fused into
+            # ONE device program per batch (FusedDeltaTransform); the
+            # host entropy-codes device-quantized coefficient blocks and
+            # never touches pixels. Requires the native shim's
+            # coefficient entry — fall back to the probe tier (device
+            # bitmaps, host transform) when it is absent so the worker
+            # still serves.
+            inner = getattr(self.codec, "inner", None)
+            lib = getattr(inner, "_lib", None)
+            if (hasattr(inner, "encode_coefficients")
+                    and hasattr(lib, "dvf_jpeg_encode_coefficients")):
+                from dvf_tpu.runtime.codec_assist import FusedDeltaTransform
+
+                self._fused = FusedDeltaTransform(tile=delta_tile,
+                                                  quality=jpeg_quality)
+                delta_device = True  # the fused pass embeds the probe;
+                #   keep the probe tier armed as the fallback ladder
+            else:
+                print("[TpuZmqWorker] --codec-assist full: native shim "
+                      "coefficient entry unavailable (cv2 fallback?); "
+                      "degrading to probe assist", file=sys.stderr)
+                delta_device = True
+        if wire != "delta" and codec_assist != "none":
+            print(f"[TpuZmqWorker] --codec-assist {codec_assist} ignored: "
+                  f"assist rides the delta wire (wire={wire})",
+                  file=sys.stderr)
         if wire == "delta" and delta_device:
             from dvf_tpu.runtime.codec_assist import DeviceDeltaProbe
 
@@ -616,7 +652,29 @@ class TpuZmqWorker:
         # crosses to the host, and the delta encoder skips its own
         # frame-sized reduction pass.
         bitmaps = None
-        if self._probe is not None:
+        coeffs = None
+        if self._fused is not None:
+            # Full-transform assist: ONE fused dispatch runs the probe,
+            # RGB→YCbCr 4:2:0, 8×8 DCT and quantization behind the
+            # filter program; only the bitmap (synced here) and, later,
+            # the dirty tiles' int16 coefficient blocks cross D2H — the
+            # RGB fetch below is skipped entirely.
+            shape = tuple(getattr(result, "shape", ()))
+            if self._fused.supports(shape, self._fused.tile):
+                try:
+                    bitmaps, coeffs = self._fused.process(result)
+                except Exception as e:  # noqa: BLE001 — assist is
+                    # optional: degrade to the probe tier, keep serving
+                    print(f"[TpuZmqWorker] fused codec transform failed "
+                          f"(probe fallback): {e!r}", file=sys.stderr)
+                    self._fused = None
+            elif not self._fused_geom_warned:
+                self._fused_geom_warned = True
+                print(f"[TpuZmqWorker] --codec-assist full: geometry "
+                      f"{shape} not tile-aligned (tile="
+                      f"{self._fused.tile}); probe assist only",
+                      file=sys.stderr)
+        if bitmaps is None and self._probe is not None:
             try:
                 bitmaps = self._probe.bitmaps(result)
             except Exception as e:  # noqa: BLE001 — assist is optional:
@@ -627,8 +685,21 @@ class TpuZmqWorker:
         # Streamed egress: issue the per-shard D2H immediately, fetch into
         # the preallocated slab, and hand the rows to the asynchronous
         # codec plane — encode/send of THIS batch overlap the decode/H2D/
-        # compute of the next one (bounded at egress_depth batches).
-        fetcher = self._fetcher_for()
+        # compute of the next one (bounded at egress_depth batches). On
+        # the full-assist path there is no pixel fetch at all: the codec
+        # gathers dirty coefficient blocks lazily at encode time.
+        if coeffs is not None:
+            # No pixel slab pool on the coefficient wire — but the plane
+            # still needs its stats sink (encode_ms/entropy_ms land there).
+            fetcher = None
+            if self._egress_stats is None:
+                self._egress_stats = EgressStats(
+                    requested_mode=self.egress, depth=self.egress_depth,
+                    d2h_block_ms=self.engine.d2h_block_ms)
+                if self._plane is not None:
+                    self._plane.stats = self._egress_stats
+        else:
+            fetcher = self._fetcher_for()
         if fetcher is not None:
             fetcher.prefetch(result)
         t_ready = None
@@ -642,7 +713,9 @@ class TpuZmqWorker:
             t_ready = time.time()
         except Exception:  # noqa: BLE001 — attribution must never turn
             pass           # a poisoned batch into a new failure mode
-        if fetcher is not None:
+        if coeffs is not None:
+            out = None  # coefficient wire: no host pixel batch exists
+        elif fetcher is not None:
             out = fetcher.fetch(result, self._egress_seq)
         else:
             out = np.asarray(result)
@@ -658,10 +731,13 @@ class TpuZmqWorker:
         self.tracer.complete("batch_complete", t0, t1, 0,
                              frames=valid, batch=self.batches)
         plane = self._plane_for()
-        plane.submit([out[i] for i in range(valid)],
+        plane.submit([None] * valid if out is None else
+                     [out[i] for i in range(valid)],
                      [(idx, t0, t1) for idx in indices],
                      bitmaps=None if bitmaps is None else
-                     [bitmaps[i] for i in range(valid)])
+                     [bitmaps[i] for i in range(valid)],
+                     coeffs=None if coeffs is None else
+                     [coeffs[i] for i in range(valid)])
         self.frames_processed += valid
         self.batches += 1
         self._pump_egress(pid, block=len(plane) > plane.depth)
@@ -922,7 +998,10 @@ class TpuZmqWorker:
             "wire": self.wire,
             **({"delta": {**self.codec.stats(),
                           "fallback_reason": self._wire_degrade_reason,
-                          "device_probe": self._probe is not None}}
+                          "device_probe": self._probe is not None,
+                          "fused_transform": self._fused is not None,
+                          **({"fused_dispatches": self._fused.calls}
+                             if self._fused is not None else {})}}
                if self.wire == "delta" else {}),
             "faults": self.faults.summary(),
             # Batch-level hop attribution (per-frame lineage is the

@@ -47,11 +47,17 @@ def _codec_pools_joined_on_close():
     """Codec pools must be joined on close (codec.close → pool.shutdown
     wait=True): a leaked dvf-jpeg worker thread at session end means some
     codec was never closed, or close() stopped joining — a long-lived
-    server churning codecs would accumulate threads forever. Session
-    scope (not per-test): module-scoped codec fixtures legitimately keep
-    a pool open across tests, but every pool must be gone once all
-    fixtures have finalized. A short grace window absorbs shutdown
-    latency; test_egress_stream pins the prompt-join property directly."""
+    server churning codecs would accumulate threads forever. The
+    ``dvf-jpeg`` prefix match covers every pool family: the per-codec
+    encode/decode pools (``dvf-jpeg``), DeltaCodec's ordered encode
+    worker (``dvf-jpeg-delta``), and the host-wide refcounted entropy
+    pool of the full-transform assist (``dvf-jpeg-entropy``,
+    transport.codec.EntropyPool — released when the last DeltaCodec that
+    acquired it closes). Session scope (not per-test): module-scoped
+    codec fixtures legitimately keep a pool open across tests, but every
+    pool must be gone once all fixtures have finalized. A short grace
+    window absorbs shutdown latency; test_egress_stream pins the
+    prompt-join property directly."""
     yield
     leaked = _codec_threads()
     deadline = time.time() + 5.0

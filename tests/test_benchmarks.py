@@ -80,8 +80,12 @@ def test_stage_decomposition_fields():
     assert set(d) == {"batch_1", "batch_2", "codec"}
     # r08 adds "wire": bench rows must say WHICH wire mode (full-frame
     # jpeg vs temporal-delta) produced the encode numbers beside them.
-    assert set(d["codec"]) == {"backend", "wire", "quality", "threads"}
+    # r15 adds "assist": which codec-assist tier (none / ycbcr /
+    # full-transform) the encode numbers were produced under.
+    assert set(d["codec"]) == {"backend", "wire", "quality", "threads",
+                               "assist"}
     assert d["codec"]["wire"] == "jpeg"
+    assert d["codec"]["assist"] == "none"
     assert d["codec"]["threads"] == 1  # per-frame serialized cost
     for b in ("batch_1", "batch_2"):
         legs = d[b]

@@ -963,3 +963,249 @@ class TestServeBridgeDelta:
             plain.close()
             app_enc.close()
             app_dec.close()
+
+
+# ---------------------------------------------------------------------------
+# Coefficient wire (full-transform assist): device DCT+quant, host
+# entropy coding only
+# ---------------------------------------------------------------------------
+
+
+def _smooth_stream(n, h=H, w=W, moving=True):
+    """Smooth gradient frames with a moving smooth patch — JPEG-friendly
+    content, so decode tolerances measure the PATH divergence (float vs
+    fixed-point convert, mean vs h2v2 subsample), not content entropy."""
+    y, x = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = np.stack([(x * 3) % 256, (y * 2) % 256, (x + y) % 256],
+                    -1).astype(np.uint8)
+    out = [base.copy()]
+    for k in range(1, n):
+        f = out[-1].copy()
+        if moving:
+            f[16:32, 16:48] = np.stack(
+                [((x + 5 * k) % 256)[16:32, 16:48],
+                 ((y + 3 * k) % 256)[16:32, 16:48],
+                 ((x * 2 + k) % 256)[16:32, 16:48]], -1).astype(np.uint8)
+        out.append(f)
+    return out
+
+
+def _native_coef_codec():
+    from dvf_tpu.transport.codec import NativeJpegCodec
+
+    try:
+        codec = NativeJpegCodec(quality=90, threads=1)
+    except (RuntimeError, OSError) as e:
+        pytest.skip(f"native jpeg shim unavailable: {e}")
+    if not hasattr(codec._lib, "dvf_jpeg_encode_coefficients"):
+        codec.close()
+        pytest.skip("shim predates coefficient assist")
+    return codec
+
+
+class TestCoefficientWire:
+
+    def test_dct_quant_golden_vs_pallas_bit_exact(self, rng):
+        """Rung 1 of the equivalence ladder: the Pallas DCT+quant kernel
+        is BIT-identical to the jnp golden path — quantized coefficients
+        ride the wire as-is, so ±1 here is wire-visible corruption."""
+        import jax.numpy as jnp
+
+        from dvf_tpu.ops.pallas_kernels import (
+            dct8x8_quant,
+            dct8x8_quant_pallas,
+            dct8x8_quant_ref,
+            jpeg_quant_table,
+        )
+
+        for quality in (50, 90, 95):
+            q = jpeg_quant_table(quality)
+            for shape in ((2, 64, 128), (1, 8, 8), (3, 48, 64)):
+                plane = rng.uniform(0, 255, shape).astype(np.float32)
+                golden = np.asarray(dct8x8_quant_ref(jnp.asarray(plane), q))
+                pal = np.asarray(dct8x8_quant_pallas(
+                    jnp.asarray(plane), q, interpret=True))
+                np.testing.assert_array_equal(golden, pal)
+        # Edge geometry routes through the golden path with edge-padded
+        # partial blocks — the dispatcher must cover it transparently.
+        q = jpeg_quant_table(90)
+        plane = rng.uniform(0, 255, (2, 52, 100)).astype(np.float32)
+        out = np.asarray(dct8x8_quant(jnp.asarray(plane), q))
+        assert out.shape == (2, 7, 13, 8, 8) and out.dtype == np.int16
+
+    def test_equivalence_ladder_coefficients_to_host_jpeg(self):
+        """Rungs 2–3: device-quantized blocks entropy-coded by the shim
+        decode (a) near-exactly against the host path fed the SAME
+        planes (quantization rung in isolation) and (b) within the
+        pinned convert-divergence tolerance of the full host RGB
+        libjpeg path."""
+        import jax.numpy as jnp
+
+        from dvf_tpu.ops.pallas_kernels import (dct8x8_quant_ref,
+                                                jpeg_quant_table)
+        from dvf_tpu.runtime.codec_assist import rgb_to_ycbcr420
+
+        codec = _native_coef_codec()
+        try:
+            frame = _smooth_stream(1)[0]
+            y, cb, cr = rgb_to_ycbcr420(jnp.asarray(frame[None]))
+            ql = jpeg_quant_table(90)
+            qc = jpeg_quant_table(90, chroma=True)
+            yq = np.asarray(dct8x8_quant_ref(y, ql))[0]
+            cbq = np.asarray(dct8x8_quant_ref(cb, qc))[0]
+            crq = np.asarray(dct8x8_quant_ref(cr, qc))[0]
+            blob = codec.encode_coefficients(yq, cbq, crq, H, W)
+            dec = codec.decode(blob)
+            if hasattr(codec._lib, "dvf_jpeg_encode_ycbcr420"):
+                # same planes through the shim's own DCT+quant: only the
+                # transform differs, and it must agree almost exactly
+                same_planes = codec.decode(codec.encode_ycbcr420(
+                    np.asarray(y[0]), np.asarray(cb[0]), np.asarray(cr[0])))
+                err = np.abs(dec.astype(int) - same_planes.astype(int))
+                assert err.max() <= 8 and err.mean() < 0.5
+            ref = codec.decode(codec.encode(frame))
+            err = np.abs(dec.astype(int) - ref.astype(int))
+            # float convert + mean subsample vs libjpeg fixed-point +
+            # h2v2 — the same divergence bound the ycbcr assist pins
+            assert err.max() <= 24 and err.mean() < 1.5
+        finally:
+            codec.close()
+
+    def test_fused_selection_bit_identical_and_one_dispatch(self, rng):
+        """Acceptance: the fused probe+transform pass is ONE device
+        dispatch per batch (dispatch-count assertion) and its dirty-tile
+        selection is bit-identical to ``host_tile_maxdiff``."""
+        import jax.numpy as jnp
+
+        from dvf_tpu.runtime.codec_assist import FusedDeltaTransform
+
+        fused = FusedDeltaTransform(tile=TILE, quality=90)
+        frames = _stream(rng, 9)
+        batches = [np.stack(frames[i:i + 3]) for i in (0, 3, 6)]
+        prev_tail = None
+        for bi, batch in enumerate(batches):
+            bms, cfs = fused.process(jnp.asarray(batch))
+            assert fused.calls == bi + 1  # ONE dispatch per batch
+            assert len(cfs) == batch.shape[0]
+            chain = (np.concatenate([batch[:1], batch[:-1]])
+                     if prev_tail is None
+                     else np.concatenate([prev_tail[None], batch[:-1]]))
+            for i in range(batch.shape[0]):
+                if bi == 0 and i == 0:
+                    assert (bms[0] == 255).all()  # no predecessor
+                    continue
+                np.testing.assert_array_equal(
+                    bms[i], host_tile_maxdiff(batch[i], chain[i], TILE))
+            prev_tail = batch[-1]
+
+    def test_fused_coefficient_wire_roundtrip(self, rng):
+        """The fused pass's CoefficientFrames drive DeltaCodec.encode;
+        an UNCHANGED delta peer decodes the stream (keyframe + delta
+        framing intact, coefficient tiles lossy-JPEG, never flagged
+        LOSSLESS), and provenance/stage stats land in stats()."""
+        import jax.numpy as jnp
+
+        from dvf_tpu.runtime.codec_assist import FusedDeltaTransform
+        from dvf_tpu.transport.codec import (_DELTA_FLAG_KEY,
+                                             _DELTA_FLAG_LOSSLESS,
+                                             _DELTA_HEADER)
+
+        codec = _native_coef_codec()
+        codec.close()  # availability gate only; DeltaCodec builds its own
+        from dvf_tpu.transport.codec import NativeJpegCodec
+
+        fused = FusedDeltaTransform(tile=TILE, quality=90)
+        enc = DeltaCodec(NativeJpegCodec(quality=90, threads=1), tile=TILE,
+                         keyframe_interval=32)
+        dec = DeltaCodec(NativeJpegCodec(quality=90, threads=1), tile=TILE)
+        try:
+            frames = _smooth_stream(6)
+            bms, cfs = fused.process(jnp.asarray(np.stack(frames)))
+            out = np.empty((H, W, 3), np.uint8)
+            for k, f in enumerate(frames):
+                blob = enc.encode(None, bitmap=bms[k], coeffs=cfs[k])
+                _m, _v, flags, _s, _h, _w, _t = _DELTA_HEADER.unpack_from(
+                    blob)
+                if k == 0:
+                    assert flags & _DELTA_FLAG_KEY
+                else:
+                    assert not flags & _DELTA_FLAG_KEY
+                    assert not flags & _DELTA_FLAG_LOSSLESS
+                dec.decode_into(blob, out)
+                err = np.abs(out.astype(int) - f.astype(int))
+                # one 4:2:0 q90 JPEG generation on smooth content
+                assert err.max() <= 32 and err.mean() < 2.0
+            s = enc.stats()
+            assert s["assist"] == "full-transform"
+            assert s["coef_frames"] == 6 and s["keyframes"] == 1
+            assert s["entropy_ms"] > 0 and s["d2h_coef_bytes"] > 0
+            # dirty-tile gathers cross a fraction of the full-frame bytes
+            assert s["d2h_coef_bytes"] < 6 * H * W * 3
+            assert "entropy_workers" in enc.config()
+        finally:
+            enc.close()
+            dec.close()
+
+    def test_worker_full_assist_end_to_end_with_corrupt_wire(self, rng):
+        """Acceptance, end-to-end: the worker on --codec-assist full
+        serves the coefficient wire under the audit envelope; a
+        chaos-injected post-encode bit flip (``corrupt_wire``) is
+        DETECTED by the peer's verify, and every clean payload verifies
+        and decodes. Dispatch count is pinned batch-for-batch."""
+        zmq = pytest.importorskip("zmq")  # noqa: F841
+        from dvf_tpu.obs.audit import (WireIntegrityError, stamp_wire,
+                                       verify_wire)
+        from dvf_tpu.resilience import FaultPlan
+        from dvf_tpu.transport.zmq_ingress import TpuZmqWorker
+
+        _native_coef_codec().close()  # skip when the shim can't serve it
+        n = 8
+        frames = _smooth_stream(n, h=32, w=64)
+        app_enc = DeltaCodec(make_codec(threads=1), tile=16,
+                             keyframe_interval=8)
+        app_dec = DeltaCodec(make_codec(threads=1), tile=16,
+                             on_gap="composite")
+        app = _mini_app([stamp_wire(app_enc.encode(f)) for f in frames])
+        worker = TpuZmqWorker(
+            get_filter("invert"), host="127.0.0.1",
+            distribute_port=app.dist_port, collect_port=app.coll_port,
+            batch_size=4, wire="delta", delta_tile=16,
+            delta_keyframe_interval=8, codec_assist="full",
+            audit_wire=True,
+            chaos=FaultPlan(seed=3).add("corrupt_wire", at=(2,)))
+        try:
+            assert worker._fused is not None
+            t = threading.Thread(target=worker.run,
+                                 kwargs={"max_frames": n}, daemon=True)
+            t.start()
+            app.serve(n_expect=n, timeout_s=30.0)
+            worker.stop()
+            t.join(timeout=20)
+            stats = worker.stats()
+            d = stats["delta"]
+            assert d["assist"] == "full-transform"
+            assert d["fused_transform"] is True
+            assert d["fused_dispatches"] == stats["batches"]  # ONE per batch
+            assert d["coef_frames"] == stats["frames_processed"]
+            assert d["entropy_ms"] > 0
+            assert stats["egress"]["entropy_ms"] > 0
+            corrupt, clean = 0, {}
+            for i, payload in app.results.items():
+                try:
+                    clean[i] = verify_wire(bytes(payload), hop="app")
+                except WireIntegrityError:
+                    corrupt += 1
+            assert corrupt == 1  # the injected flip, caught at verify
+            assert len(clean) == n - 1
+            out = np.empty((32, 64, 3), np.uint8)
+            from dvf_tpu.transport.codec import _DELTA_HEADER
+
+            for _i, b in sorted(clean.items(),
+                                key=lambda kv: _DELTA_HEADER.unpack_from(
+                                    kv[1])[3]):
+                app_dec.decode_into(b, out)  # framing intact end-to-end
+        finally:
+            worker.close()
+            app.close()
+            app_enc.close()
+            app_dec.close()
