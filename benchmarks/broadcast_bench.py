@@ -1,0 +1,427 @@
+"""Broadcast-plane bench: encode-once fan-out at subscriber scale.
+
+Writes ``BROADCAST_BENCH.json`` + ``BROADCAST_BENCH.md``. Three legs:
+
+1. **Subscriber sweep** (the headline): one published channel with a
+   fixed 3-tier ladder, swept across subscriber counts (100 → 1000 →
+   4000 by default). The encode-once invariant is ASSERTED on live
+   counters at every point — each tier's codec runs once per fanned
+   frame, so ``encodes_per_frame`` stays == tier count while the
+   watcher count grows 40×. What grows with watchers is queue puts
+   (cheap reference distribution), and the sweep records that cost
+   honestly as fan-out wall time per frame.
+
+2. **Publisher p99 through churn**: a real ServeFrontend session
+   published at admission, driven at a fixed frame rate while watcher
+   bursts join/leave and a relay spawns and retires mid-stream. The
+   publisher's own client-side delivery p99 must hold its SLO — fan-out
+   churn may never stall the serving hot path.
+
+3. **Relay-path audit integrity**: the PR 14 wire envelope crossing a
+   relay hop with one injected ``corrupt_wire`` bit flip; the final
+   subscriber's verifier must catch exactly the flipped frame and pass
+   every other frame verbatim.
+
+CPU-host caveats are recorded in the document: these are CPU
+container numbers measuring the FAN-OUT plane (queues + codecs +
+threads), not TPU serving throughput; absolute fps here says nothing
+about device capacity, and the GIL makes the drainer threads part of
+the measured system. The invariant claims (encode-once counters, SLO
+hold, audit detection) are host-independent; the throughput numbers
+are not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+TIERS = ["native/q85/jpeg", "24x16/q60/jpeg", "native/q70/delta"]
+
+
+def make_frames(n, h=48, w=64, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    return [np.roll(base, shift=i, axis=1).copy() for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: subscriber sweep
+# ---------------------------------------------------------------------------
+
+
+def sweep_point(n_subs, n_frames, drainers=2):
+    """One sweep point: ``n_subs`` watchers round-robined across the
+    fixed ladder, ``n_frames`` through the channel, every counter read
+    back. Returns the point row; raises AssertionError if the
+    encode-once invariant breaks (the bench IS the regression pin)."""
+    from dvf_tpu.broadcast import BroadcastPlane
+
+    pl = BroadcastPlane(ingest_depth=n_frames + 8, sub_queue=8,
+                        evict_after=1 << 30)  # no eviction: pure fan-out
+    stop = threading.Event()
+    try:
+        ch = pl.publish("bench", tiers=TIERS)
+        subs = [pl.subscribe("bench", tier=TIERS[i % len(TIERS)])
+                for i in range(n_subs)]
+        delivered = [0] * drainers
+
+        def drain(k):
+            mine = subs[k::drainers]
+            while not stop.is_set():
+                got = 0
+                for s in mine:
+                    got += len(s.poll(64))
+                delivered[k] += got
+                if not got:
+                    time.sleep(0.001)
+
+        threads = [threading.Thread(target=drain, args=(k,), daemon=True)
+                   for k in range(drainers)]
+        for t in threads:
+            t.start()
+
+        fs = make_frames(n_frames)
+        t0 = time.perf_counter()
+        for i, f in enumerate(fs):
+            ch.offer(i, f, time.time())
+        offer_wall = time.perf_counter() - t0
+        ok = ch.flush(timeout=120.0)
+        fanout_wall = time.perf_counter() - t0
+        time.sleep(0.2)  # last queue residents
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+        st = ch.stats()
+        lanes = st["tiers"]
+        encodes = {lab: lane["encodes_total"] for lab, lane in lanes.items()}
+        fanned = sum(lane["fanout_frames_total"] for lane in lanes.values())
+        dropped = sum(lane["dropped_total"] for lane in lanes.values())
+        # THE invariant: every tier encoded once per fanned frame —
+        # watcher count must not appear in any encode counter.
+        for lab, lane in lanes.items():
+            assert lane["encodes_total"] == st["fanned_out_total"], (
+                f"{lab}: encodes {lane['encodes_total']} != frames "
+                f"{st['fanned_out_total']} — encode-once broken")
+        return {
+            "subscribers": n_subs,
+            "frames_offered": st["offered_total"],
+            "frames_fanned": st["fanned_out_total"],
+            "fanout_quiesced": bool(ok),
+            "encodes_by_tier": encodes,
+            "encodes_per_frame": (sum(encodes.values())
+                                  / max(1, st["fanned_out_total"])),
+            "fanout_puts_total": fanned,
+            "delivered_total": sum(delivered),
+            "dropped_total": dropped,
+            "offer_wall_s": round(offer_wall, 3),
+            "fanout_wall_s": round(fanout_wall, 3),
+            "fanout_ms_per_frame": round(
+                fanout_wall * 1e3 / max(1, st["fanned_out_total"]), 3),
+            "deliveries_per_s": round(
+                sum(delivered) / max(fanout_wall, 1e-9), 1),
+        }
+    finally:
+        stop.set()
+        pl.stop()
+
+
+def sweep(quick=False):
+    counts = [50, 200] if quick else [100, 1000, 4000]
+    n_frames = 60 if quick else 120
+    points = [sweep_point(s, n_frames) for s in counts]
+    per_frame = [p["encodes_per_frame"] for p in points]
+    return {
+        "tiers": TIERS,
+        "frames_per_point": n_frames,
+        "points": points,
+        # Flat encode cost: encodes per frame == tier count at EVERY
+        # subscriber count (asserted per point above; recorded here).
+        "encodes_per_frame_by_point": per_frame,
+        "encode_scales_with_tiers_not_viewers": (
+            len(set(per_frame)) == 1
+            and per_frame[0] == float(len(TIERS))),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: publisher p99 through watcher/relay churn
+# ---------------------------------------------------------------------------
+
+
+def publisher_churn_leg(quick=False, slo_ms=250.0):
+    """Publish a live serve session, then churn the fan-out plane hard
+    (watcher join/leave bursts + one relay spawn/retire cycle) while
+    the publisher's client keeps a fixed frame cadence. The recorded
+    p99 is the publisher's OWN delivery latency — the number churn is
+    forbidden to move past the SLO."""
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    fps = 30.0
+    n_frames = 90 if quick else 240
+    burst = 25 if quick else 100
+    fe = ServeFrontend(get_filter("invert"),
+                       ServeConfig(batch_size=4, queue_size=1000,
+                                   out_queue_size=1000, slo_ms=60_000.0,
+                                   broadcast_ingest_depth=64,
+                                   broadcast_sub_queue=8)).start()
+    stop = threading.Event()
+    churn_counts = {"joined": 0, "left": 0, "relay_cycles": 0}
+
+    def churn():
+        while not stop.is_set():
+            batch = [fe.subscribe("cam", tier=TIERS[0])
+                     for _ in range(burst)]
+            churn_counts["joined"] += len(batch)
+            time.sleep(0.05)
+            for s in batch:
+                fe.unsubscribe(s)
+            churn_counts["left"] += len(batch)
+            node = fe.broadcast.spawn_relay("cam")
+            time.sleep(0.05)
+            fe.broadcast.retire_relay(node.id)
+            churn_counts["relay_cycles"] += 1
+
+    try:
+        sid = fe.open_stream(publish="cam", publish_tiers=TIERS)
+        frame = make_frames(1, h=32, w=32)[0]
+        # Warm the engine outside the clock.
+        fe.submit(sid, frame)
+        deadline = time.time() + 20.0
+        while not fe.poll(sid) and time.time() < deadline:
+            time.sleep(0.002)
+        ct = threading.Thread(target=churn, daemon=True)
+        ct.start()
+
+        lat_ms = []
+        submitted_ts = {}
+        next_t = time.perf_counter()
+        for i in range(n_frames):
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            submitted_ts[i + 1] = time.perf_counter()
+            fe.submit(sid, frame)
+            next_t += 1.0 / fps
+            for d in fe.poll(sid):
+                t_in = submitted_ts.pop(d.index, None)
+                if t_in is not None:
+                    lat_ms.append((time.perf_counter() - t_in) * 1e3)
+        deadline = time.time() + 20.0
+        while submitted_ts and time.time() < deadline:
+            for d in fe.poll(sid):
+                t_in = submitted_ts.pop(d.index, None)
+                if t_in is not None:
+                    lat_ms.append((time.perf_counter() - t_in) * 1e3)
+            time.sleep(0.002)
+        stop.set()
+        ct.join(timeout=10.0)
+        p50, p99 = (float(np.percentile(lat_ms, q)) for q in (50, 99))
+        return {
+            "frames": n_frames,
+            "fps": fps,
+            "delivered": len(lat_ms),
+            "churn": dict(churn_counts),
+            "publisher_p50_ms": round(p50, 2),
+            "publisher_p99_ms": round(p99, 2),
+            "slo_ms": slo_ms,
+            "publisher_p99_within_slo": bool(p99 <= slo_ms),
+        }
+    finally:
+        stop.set()
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: relay-path audit integrity
+# ---------------------------------------------------------------------------
+
+
+def relay_audit_leg():
+    from dvf_tpu.broadcast import BroadcastPlane
+    from dvf_tpu.obs.audit import WireIntegrityError, verify_wire
+    from dvf_tpu.resilience.chaos import FaultPlan
+
+    n = 16
+    flip_at = 5
+    chaos = FaultPlan(seed=7).add("corrupt_wire", at=(flip_at,))
+    pl = BroadcastPlane(audit_wire=True, ingest_depth=64, sub_queue=64)
+    try:
+        ch = pl.publish("cam", tiers=[TIERS[0]])
+        node = pl.spawn_relay("cam", chaos=chaos, sub_queue=64,
+                              upstream_queue=64)
+        rsub = node.subscribe()
+        for i, f in enumerate(make_frames(n)):
+            ch.offer(i, f, time.time())
+        ch.flush(timeout=30.0)
+        got = []
+        deadline = time.time() + 15.0
+        while len(got) < n and time.time() < deadline:
+            got.extend(rsub.poll(64))
+            time.sleep(0.002)
+        caught = []
+        for d in got:
+            try:
+                verify_wire(d.payload, hop="bench-subscriber")
+            except WireIntegrityError:
+                caught.append(d.seq)
+        return {
+            "frames": n,
+            "relayed": len(got),
+            "injected_flip_at_seq": flip_at,
+            "verifier_caught_seqs": caught,
+            "relay_hop_corruptions_accounted":
+                node.stats()["corrupted_on_hop_total"],
+            "end_to_end_integrity_ok": (
+                len(got) == n and caught == [flip_at]),
+        }
+    finally:
+        pl.stop()
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick=False):
+    import jax
+
+    sw = sweep(quick=quick)
+    churn = publisher_churn_leg(quick=quick)
+    audit = relay_audit_leg()
+    return {
+        "schema": "dvf.broadcast_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "device_count": jax.device_count(),
+        "quick": bool(quick),
+        "sweep": sw,
+        "publisher_churn": churn,
+        "relay_audit": audit,
+        "acceptance": {
+            "encode_scales_with_tiers_not_viewers":
+                sw["encode_scales_with_tiers_not_viewers"],
+            "publisher_p99_within_slo":
+                churn["publisher_p99_within_slo"],
+            "publisher_p99_ms": churn["publisher_p99_ms"],
+            "slo_ms": churn["slo_ms"],
+            "relay_audit_end_to_end_ok":
+                audit["end_to_end_integrity_ok"],
+        },
+        "caveats": [
+            "CPU-container numbers (host_cpus above): the sweep "
+            "measures the "
+            "fan-out plane (queues + tier codecs + drainer threads), "
+            "not TPU serving throughput; absolute fps is not a device "
+            "capacity claim.",
+            "Drainer threads share the GIL with the fan-out worker — "
+            "deliveries_per_s undercounts what independent subscriber "
+            "processes would drain.",
+            "Subscriber queues are depth-8 in the sweep, so "
+            "dropped_total > 0 at high watcher counts is expected "
+            "drop-oldest behavior, not loss on the encode path "
+            "(frames_fanned and encodes_by_tier are the loss-free "
+            "counters).",
+            "The invariant results (encode-once counters, SLO hold, "
+            "audit detection) are host-independent; the throughput "
+            "numbers are not.",
+        ],
+    }
+
+
+def write_md(doc, path):
+    sw = doc["sweep"]
+    churn = doc["publisher_churn"]
+    audit = doc["relay_audit"]
+    lines = [
+        "# Broadcast plane: encode-once fan-out at subscriber scale",
+        "",
+        f"Captured {doc['captured_utc']} on platform="
+        f"{doc['platform']}, {doc['host_cpus']} host CPUs"
+        f"{' (quick mode)' if doc['quick'] else ''}.",
+        "",
+        "## Subscriber sweep",
+        "",
+        f"Fixed ladder: {', '.join('`%s`' % t for t in sw['tiers'])}; "
+        f"{sw['frames_per_point']} frames per point; watchers "
+        "round-robined across tiers.",
+        "",
+        "| subscribers | encodes/frame | fan-out puts | delivered | "
+        "dropped | fan-out ms/frame | deliveries/s |",
+        "|---:|---:|---:|---:|---:|---:|---:|",
+    ]
+    for p in sw["points"]:
+        lines.append(
+            f"| {p['subscribers']} | {p['encodes_per_frame']:g} | "
+            f"{p['fanout_puts_total']} | {p['delivered_total']} | "
+            f"{p['dropped_total']} | {p['fanout_ms_per_frame']} | "
+            f"{p['deliveries_per_s']} |")
+    lines += [
+        "",
+        "Encode cost is FLAT across the sweep: `encodes/frame` equals "
+        "the tier count at every subscriber count (asserted on live "
+        "counters inside the harness — the codecs never see the "
+        "watcher count). What grows with watchers is queue puts, "
+        "recorded as fan-out ms/frame.",
+        "",
+        "## Publisher p99 through churn",
+        "",
+        f"{churn['frames']} frames at {churn['fps']:g} fps while "
+        f"{churn['churn']['joined']} watchers joined, "
+        f"{churn['churn']['left']} left, and "
+        f"{churn['churn']['relay_cycles']} relay spawn/retire cycles "
+        "ran mid-stream:",
+        "",
+        f"- publisher p50 {churn['publisher_p50_ms']} ms, p99 "
+        f"{churn['publisher_p99_ms']} ms (SLO {churn['slo_ms']:g} ms) "
+        f"— {'HOLDS' if churn['publisher_p99_within_slo'] else 'MISS'}",
+        "",
+        "## Relay-path audit integrity",
+        "",
+        f"- {audit['relayed']}/{audit['frames']} frames crossed the "
+        f"relay hop; one `corrupt_wire` bit flip injected at seq "
+        f"{audit['injected_flip_at_seq']}; the subscriber's verifier "
+        f"caught {audit['verifier_caught_seqs']} — "
+        f"{'exactly the flipped frame' if audit['end_to_end_integrity_ok'] else 'MISS'}.",
+        "",
+        "## Caveats",
+        "",
+    ]
+    lines += [f"- {c}" for c in doc["caveats"]]
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    json_path = os.path.join(_HERE, "BROADCAST_BENCH.json")
+    with open(json_path, "w") as f:
+        json.dump(doc, f, indent=2, default=float)
+        f.write("\n")
+    write_md(doc, os.path.join(_HERE, "BROADCAST_BENCH.md"))
+    acc = doc["acceptance"]
+    print(json.dumps(acc, indent=2))
+    ok = (acc["encode_scales_with_tiers_not_viewers"]
+          and acc["publisher_p99_within_slo"]
+          and acc["relay_audit_end_to_end_ok"])
+    print(f"broadcast_bench: {'clean' if ok else 'ACCEPTANCE MISS'} "
+          f"-> {json_path}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,10 @@ cites. This sentinel is the CI gate that re-reads — and re-measures:
    races the fused coefficient wire (FusedDeltaTransform → DeltaCodec
    coefficient encode, host entropy coding only) against the same
    reference denominator and gates its ratio identically — skipped,
-   not failed, on shim-less hosts.
+   not failed, on shim-less hosts. A third fresh leg races the
+   broadcast plane's encode-once fan-out (one channel, one tier, 32
+   watchers) against the same denominator, gated identically plus an
+   absolute encode-once counter check.
 
 3. **Fresh bench diffs** (``--full``): quick-mode re-runs of the
    normalized-record writers (attr_bench, ledger_bench, audit_bench,
@@ -457,6 +460,140 @@ def fused_regressions(fresh, baseline):
 
 
 # ---------------------------------------------------------------------------
+# Leg 2c: fresh broadcast fan-out probe (encode-once tiered fan-out)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_leg(duration_s, inject_ms, out):
+    """Broadcast-plane workload under test: one published channel, one
+    jpeg tier, 32 watchers — publisher offers in closed loop while the
+    main thread drains every watcher. A regression anywhere on the
+    fan-out chain (ingest queue, tier codec, subscriber queues, the
+    fan-out worker itself) lowers delivered throughput while the
+    reference leg (common mode) stays put. The leg also re-checks the
+    encode-once invariant on live counters: the tier codec must run
+    once per fanned frame, never × watchers."""
+    from dvf_tpu.broadcast import BroadcastPlane, Tier
+
+    n_subs = 32
+    tier = "native/q85/jpeg"
+    rng = np.random.default_rng(3)
+    frame = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    pl = BroadcastPlane(ingest_depth=64, sub_queue=64)
+    try:
+        ch = pl.publish("sentinel", tiers=[tier])
+        subs = [pl.subscribe("sentinel") for _ in range(n_subs)]
+        # Warm (lazy codec build + first fan-out) outside the clock.
+        ch.offer(0, frame, time.time())
+        ch.flush(timeout=10.0)
+        lane = ch.add_tier(Tier.parse(tier))
+        if inject_ms > 0:
+            # Self-test parity with the serve leg: sleep in the TIER
+            # codec's per-frame encode — the stage encode-once promises
+            # to run once per frame regardless of watcher count.
+            orig = lane.codec.encode
+
+            def slow_encode(f):
+                time.sleep(inject_ms / 1e3)
+                return orig(f)
+
+            lane.codec.encode = slow_encode
+        for s in subs:
+            s.poll(256)
+        out["start"].wait()
+        delivered = 0
+        offered = 0
+        deadline = time.perf_counter() + duration_s
+        while time.perf_counter() < deadline:
+            ch.offer(offered + 1, frame, time.time())
+            offered += 1
+            for s in subs:
+                delivered += len(s.poll(256))
+        ch.flush(timeout=10.0)
+        for s in subs:
+            delivered += len(s.poll(256))
+        st = lane.stats()
+        out["bcast_fps"] = delivered / duration_s
+        out["encode_once_ok"] = (
+            st["encodes_total"] <= offered + 1
+            and st["fanout_frames_total"]
+            == st["encodes_total"] * n_subs)
+        out["encodes_total"] = st["encodes_total"]
+    finally:
+        pl.stop()
+
+
+def broadcast_probe(rounds=3, duration_s=1.5, inject_ms=0):
+    """Best-of-rounds broadcast/reference ratio — same concurrent A/B
+    discipline as :func:`probe`, with aggregate watcher deliveries per
+    second as the numerator."""
+    ratios = []
+    rows = []
+    encode_once_ok = True
+    for i in range(rounds):
+        out = {"start": threading.Event()}
+        tb = threading.Thread(target=_broadcast_leg,
+                              args=(duration_s, inject_ms, out))
+        tr = threading.Thread(target=_reference_leg,
+                              args=(duration_s, out))
+        tb.start()
+        tr.start()
+        time.sleep(0.05)
+        out["start"].set()
+        tb.join()
+        tr.join()
+        bcast_fps = out.get("bcast_fps", 0.0)
+        ref_kops = out.get("ref_kops", 0.0)
+        encode_once_ok = encode_once_ok and bool(
+            out.get("encode_once_ok"))
+        ratio = bcast_fps / ref_kops if ref_kops else None
+        if ratio:
+            ratios.append(ratio)
+        rows.append({"round": i, "bcast_fps": round(bcast_fps, 1),
+                     "ref_kops_per_s": round(ref_kops, 2),
+                     "bcast_over_ref_ratio": (round(ratio, 4)
+                                              if ratio else None)})
+    return {
+        "rounds": {str(r["round"]): r for r in rows},
+        "duration_s": duration_s,
+        "inject_slowdown_ms": inject_ms,
+        "subscribers": 32,
+        "encode_once_ok": encode_once_ok,
+        "ratio_best": (round(max(ratios), 4) if ratios else None),
+        "ratio_median": (round(statistics.median(ratios), 4)
+                         if ratios else None),
+    }
+
+
+def broadcast_regressions(fresh, baseline):
+    """Gate the fresh broadcast ratio against the committed baseline's
+    ``broadcast`` section (skip-not-fail on a predating baseline); the
+    encode-once counter check is absolute and gates regardless."""
+    out = []
+    if not fresh.get("encode_once_ok", True):
+        out.append({"bench": "sentinel_broadcast",
+                    "metric": "encode_once_invariant",
+                    "ok": False,
+                    "detail": "tier codec ran more than once per fanned "
+                              "frame (encode cost scaled with watchers)"})
+    bb = (baseline or {}).get("broadcast") or {}
+    base = bb.get("ratio_best", bb.get("ratio_median"))
+    if base is None:
+        return out, ("no committed SENTINEL_BASELINE.json broadcast "
+                     "ratio (baseline predates the broadcast plane)")
+    m = fresh.get("ratio_best", fresh.get("ratio_median"))
+    band = bb.get("band_frac", PROBE_BAND_FRAC)
+    floor = base * (1.0 - band)
+    if m is None or m < floor:
+        out.append({"bench": "sentinel_broadcast",
+                    "metric": "bcast_over_ref_ratio",
+                    "ok": False,
+                    "detail": f"fresh {m} < floor {floor:.4f} "
+                              f"(baseline {base}, band {band:g})"})
+    return out, None
+
+
+# ---------------------------------------------------------------------------
 # Leg 3 (--full): fresh quick-mode bench diffs vs committed records
 # ---------------------------------------------------------------------------
 
@@ -604,12 +741,22 @@ def main(argv=None):
                                      "band_frac": PROBE_BAND_FRAC,
                                      "geometry": fdoc["geometry"],
                                      "rounds": fdoc["rounds"]}
+            bdoc = broadcast_probe(rounds=args.rounds or 5,
+                                   duration_s=2.0)
+            baseline["broadcast"] = {
+                "ratio_best": bdoc["ratio_best"],
+                "ratio_median": bdoc["ratio_median"],
+                "band_frac": PROBE_BAND_FRAC,
+                "subscribers": bdoc["subscribers"],
+                "encode_once_ok": bdoc["encode_once_ok"],
+                "rounds": bdoc["rounds"]}
             with open(BASELINE_PATH, "w") as f:
                 json.dump(baseline, f, indent=2)
             print(f"wrote {BASELINE_PATH} "
                   f"(ratio_best {doc['ratio_best']}, "
                   f"median {doc['ratio_median']}, "
-                  f"fused_best {fdoc.get('ratio_best')})")
+                  f"fused_best {fdoc.get('ratio_best')}, "
+                  f"bcast_best {bdoc.get('ratio_best')})")
             return 0
 
         failures = [g for g in baseline_gates() if not g["ok"]]
@@ -636,6 +783,17 @@ def main(argv=None):
             if fnote:
                 report["fused_note"] = fnote
             report["regressions"].extend(fregs)
+            # The broadcast fan-out leg: encode-once tiered fan-out,
+            # gated the same way (plus an absolute encode-once check).
+            bfresh = broadcast_probe(rounds=rounds,
+                                     duration_s=1.0 if args.quick else 2.0,
+                                     inject_ms=args.inject_slowdown_ms)
+            report["broadcast"] = bfresh
+            bregs, bnote = broadcast_regressions(bfresh, _load(
+                "SENTINEL_BASELINE.json"))
+            if bnote:
+                report["broadcast_note"] = bnote
+            report["regressions"].extend(bregs)
         if args.full:
             report["regressions"].extend(fresh_bench_diffs())
     except Exception as e:  # noqa: BLE001 — harness error ≠ regression

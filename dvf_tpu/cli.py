@@ -407,10 +407,28 @@ def _cmd_serve_multi(args, filt, engine) -> int:
             # without copying (StreamSession.submit references them).
             frontend.submit(sid, frame, ts=ts)
 
+    gate = None
     try:
         with frontend:
             sids = [frontend.open_stream(slo_ms=args.slo_ms, tier=args.tier)
                     for _ in range(n)]
+            if args.publish:
+                # First stream doubles as the broadcast publisher: its
+                # deliveries tee into the channel's per-tier encoders
+                # (its own poll loop below is untouched — the tap rides
+                # the delivery path).
+                frontend.publish_stream(
+                    sids[0], args.publish,
+                    tiers=[t.strip()
+                           for t in args.publish_tiers.split(",")
+                           if t.strip()])
+                if args.broadcast_bind:
+                    from dvf_tpu.broadcast.plane import ZmqBroadcastGate
+
+                    gate = ZmqBroadcastGate(frontend.broadcast,
+                                            args.broadcast_bind)
+                    print(f"[serve] broadcast channel {args.publish!r} "
+                          f"on {args.broadcast_bind}", file=sys.stderr)
             drivers = [
                 threading.Thread(target=drive, args=(sid, rate, i), daemon=True)
                 for i, (sid, rate) in enumerate(zip(sids, rates))
@@ -434,6 +452,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
                 delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
             stats = frontend.stats()
     finally:
+        if gate is not None:
+            gate.close()
         if exporter is not None:
             exporter.stop()
 
@@ -455,8 +475,96 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         "faults": stats["faults"]["by_kind"],
         "recoveries": stats["recoveries"],
     }
+    if args.publish and "broadcast" in stats:
+        bc = stats["broadcast"]["channels"].get(args.publish, {})
+        out["broadcast"] = {
+            "channel": args.publish,
+            "offered": bc.get("offered_total", 0),
+            "tiers": {label: {"encodes": t.get("encodes_total", 0),
+                              "delivered": t.get("delivered_total", 0),
+                              "subscribers": t.get("subscriber_count", 0)}
+                      for label, t in bc.get("tiers", {}).items()},
+            **({"gate": gate.stats()} if gate is not None else {}),
+        }
     print(json.dumps(out, default=float))
     return 0
+
+
+def cmd_subscribe(args) -> int:
+    """Remote watcher: DEALER-connect to a broadcast gate, hello into a
+    channel/tier, decode what arrives, print one JSON summary line."""
+    try:
+        import zmq
+    except ImportError:
+        print("error: subscribe needs pyzmq (the gate side is "
+              "`serve --publish --broadcast-bind`)", file=sys.stderr)
+        return 2
+    from dvf_tpu.obs.audit import is_stamped, verify_wire
+    from dvf_tpu.transport.codec import make_wire_codec
+
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.DEALER)
+    sock.linger = 0
+    sock.connect(args.endpoint)
+    try:
+        sock.send(json.dumps({"op": "hello", "channel": args.channel,
+                              "tier": args.tier,
+                              "queue": args.queue}).encode())
+        if not sock.poll(int(args.timeout * 1000)):
+            print(f"error: no hello reply from {args.endpoint} within "
+                  f"{args.timeout:g}s", file=sys.stderr)
+            return 1
+        meta = json.loads(sock.recv_multipart()[0])
+        if not meta.get("ok"):
+            print(f"error: gate refused: {meta.get('error')}",
+                  file=sys.stderr)
+            return 1
+        wire, quality = meta["wire"], meta["quality"]
+        codec = None
+        if wire != "raw":
+            # The SAME codec shape the tier's encoder runs — the meta
+            # carries every parameter the closed loop needs; delta
+            # joins on the gate's forced keyframe, so decode starts in
+            # sync. on_gap='composite': a dropped frame costs staleness
+            # in the changed tiles, never a dead stream.
+            kw = {}
+            if wire == "delta":
+                kw = {"tile": meta["delta_tile"],
+                      "keyframe_interval": meta["keyframe_interval"],
+                      "on_gap": "composite"}
+            codec = make_wire_codec(wire, quality=quality, threads=2, **kw)
+        t0 = time.time()
+        got = frames_bytes = keyframes = integrity_errors = 0
+        deadline = t0 + args.timeout
+        while got < args.frames and time.time() < deadline:
+            if not sock.poll(200):
+                continue
+            parts = sock.recv_multipart()
+            if len(parts) < 2:
+                continue
+            head, payload = json.loads(parts[0]), parts[1]
+            frames_bytes += len(payload)
+            if meta.get("audit") and is_stamped(payload):
+                try:
+                    payload = verify_wire(payload, hop="subscribe")
+                except Exception:  # noqa: BLE001 — counted, stream lives
+                    integrity_errors += 1
+                    continue
+            if codec is not None:
+                codec.decode(payload)
+            keyframes += bool(head.get("key"))
+            got += 1
+        sock.send(json.dumps({"op": "bye"}).encode())
+        dt = max(time.time() - t0, 1e-9)
+        print(json.dumps({
+            "channel": args.channel, "tier": meta["tier"],
+            "wire": wire, "frames": got, "keyframes": keyframes,
+            "bytes": frames_bytes, "fps": round(got / dt, 2),
+            "integrity_errors": integrity_errors,
+            "complete": got >= args.frames}))
+        return 0 if got > 0 else 1
+    finally:
+        sock.close(0)
 
 
 def cmd_serve(args) -> int:
@@ -526,6 +634,12 @@ def cmd_serve(args) -> int:
         # trips and hard pipeline failures dump post-mortems there.
         flight_dir=args.flight_dir,
     )
+    if args.publish:
+        print("[serve] note: --publish is a multi-session feature (the "
+              "broadcast plane taps the serving frontend's delivery "
+              "path); use --sessions N, the fleet tier, or the "
+              "in-process ServeFrontend.publish_stream API",
+              file=sys.stderr)
     if args.lineage or args.profile_dir:
         print("[serve] note: --lineage/--profile-dir are multi-session "
               "features (per-frame attribution and per-signature stage "
@@ -1765,6 +1879,45 @@ def main(argv=None) -> int:
                          "batch — sheds first; default 1). Under "
                          "--control overload the admission floor "
                          "refuses high tier values first")
+    sp.add_argument("--publish", default=None, metavar="CHANNEL",
+                    help="--sessions mode: register the first stream's "
+                         "output as a broadcast channel (encode-once "
+                         "tiered fan-out, dvf_tpu.broadcast); watchers "
+                         "attach in-process via subscribe() or remotely "
+                         "through --broadcast-bind")
+    sp.add_argument("--publish-tiers", default="native/q90/jpeg",
+                    metavar="SPECS",
+                    help="comma-separated tier specs for --publish, "
+                         "each 'GEOMxGEOM|native / qN / raw|jpeg|delta' "
+                         "(e.g. 'native/q90/jpeg,640x360/q60/delta'); "
+                         "one closed-loop encoder per tier, shared by "
+                         "every watcher on it")
+    sp.add_argument("--broadcast-bind", default=None, metavar="ENDPOINT",
+                    help="with --publish: bind the ZMQ broadcast gate "
+                         "here (e.g. tcp://127.0.0.1:5556) — remote "
+                         "'dvf_tpu subscribe' clients attach through it")
+
+    sb = sub.add_parser(
+        "subscribe",
+        help="watch a broadcast channel through a ZMQ gate (the client "
+             "side of serve --publish --broadcast-bind)")
+    sb.add_argument("endpoint", metavar="ENDPOINT",
+                    help="the gate's ZMQ endpoint "
+                         "(e.g. tcp://127.0.0.1:5556)")
+    sb.add_argument("--channel", required=True,
+                    help="published channel name to attach to")
+    sb.add_argument("--tier", default=None, metavar="SPEC",
+                    help="tier spec to watch (e.g. 'native/q90/jpeg'); "
+                         "omitted = the channel's ladder top")
+    sb.add_argument("--frames", type=int, default=120,
+                    help="stop after this many received frames")
+    sb.add_argument("--timeout", type=float, default=30.0,
+                    help="give up after this many seconds without the "
+                         "requested frame count")
+    sb.add_argument("--queue", type=int, default=8,
+                    help="gate-side drop-oldest queue depth for this "
+                         "watcher (small = freshest, large = fewest "
+                         "drops)")
 
     fl = sub.add_parser(
         "fleet", parents=[plat, ing, res, obsp, sig],
@@ -2041,6 +2194,7 @@ def main(argv=None) -> int:
             "serve": cmd_serve, "worker": cmd_worker, "fleet": cmd_fleet,
             "bench": cmd_bench, "train": cmd_train, "train-sr": cmd_train_sr,
             "camera": cmd_camera, "trace-view": cmd_trace_view,
+            "subscribe": cmd_subscribe,
         }[args.cmd](args)
     finally:
         if getattr(args, "platform", None):

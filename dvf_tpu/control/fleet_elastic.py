@@ -56,6 +56,9 @@ from dvf_tpu.control.controllers import Action
 # Replica flavors a scale-out action may target (``Action.target``).
 FLAVOR_DEFAULT = "default"      # whatever FleetConfig.mode spawns
 FLAVOR_MULTIHOST = "multihost"  # MultiHostEngine process group
+FLAVOR_RELAY = "relay"          # broadcast egress relay (no filter
+#   compute — a RelayNode fanning an already-encoded tier out to its
+#   own subscribers; the THIRD scaling axis, broadcast plane)
 
 
 @dataclasses.dataclass
@@ -98,6 +101,21 @@ class ElasticConfig:
     # -- saturation ------------------------------------------------------
     saturate_after: int = 10       # pressured samples at max_replicas
     #   with nothing left to spawn → flight dump (one per episode)
+    # -- relay axis (broadcast fan-out) ----------------------------------
+    relay_subscribers_high: int = 0  # 0 disables the relay axis (the
+    #   default: recorded pre-broadcast replay windows stay byte-
+    #   identical, and a fleet that never publishes has nothing to
+    #   relay). >0: once direct subscribers per egress point (origin +
+    #   live relays) reach this, fan-out — not filter compute — is the
+    #   bottleneck, and the right spawn is a relay-only egress replica,
+    #   never another filter replica
+    relay_out_after: int = 2       # consecutive fan-out-pressured
+    #   samples before a relay spawn (short, like out_after: every
+    #   sample over the watermark is subscriber-visible egress drop)
+    relay_in_after: int = 24       # consecutive fan-out-calm samples
+    #   before a relay retire (soak posture, like in_after)
+    relay_cooldown: int = 6        # min samples between relay actions
+    max_relays: int = 4            # relay-replica ceiling
 
 
 def fleet_pressure(row: dict, prev: Optional[dict],
@@ -144,6 +162,33 @@ def fleet_pressure(row: dict, prev: Optional[dict],
     return None
 
 
+def relay_pressure(row: dict, prev: Optional[dict],
+                   config: ElasticConfig) -> Optional[str]:
+    """The fan-out overload predicate — the broadcast analogue of
+    :func:`fleet_pressure`, stated once. Fan-out pressure is NOT filter
+    pressure: every queue/p99/refusal signal above can be calm while
+    tens of thousands of subscribers drain one origin's egress, so the
+    relay axis reads only the broadcast row — subscribers per egress
+    point (origin + live relays) against the watermark, and advancing
+    egress drops as the lagging confirmation."""
+    if config.relay_subscribers_high <= 0:
+        return None
+    subs = float(row.get("broadcast_subscribers") or 0.0)
+    if subs <= 0:
+        return None
+    egress = 1.0 + float(row.get("relays_live") or 0.0)
+    if subs / egress >= config.relay_subscribers_high:
+        return (f"fan-out {subs:g} subscribers over {egress:g} egress "
+                f"point(s) >= {config.relay_subscribers_high}/point")
+    if prev is not None:
+        cur_v = row.get("broadcast_dropped_total")
+        prev_v = prev.get("broadcast_dropped_total")
+        if (cur_v is not None and prev_v is not None
+                and float(cur_v) > float(prev_v)):
+            return "broadcast egress drops advancing"
+    return None
+
+
 class FleetElasticityController:
     """Deterministic scale-out/scale-in transducer (module docstring).
 
@@ -179,6 +224,13 @@ class FleetElasticityController:
         self._calm_streak = 0
         self._cooldown = 0
         self._saturation_open = False
+        # Relay axis: independent streaks/cooldown — fan-out pressure
+        # and filter pressure are different bottlenecks and must never
+        # share a hysteresis state (a compute burst would reset the
+        # relay calm clock and pin surplus relays alive).
+        self._relay_pressure_streak = 0
+        self._relay_calm_streak = 0
+        self._relay_cooldown = 0
 
     # -- the decision step ------------------------------------------------
 
@@ -234,6 +286,44 @@ class FleetElasticityController:
                     # every retiring replica's migrations into one
                     # window.
                     self._calm_streak = 0
+        out.extend(self._relay_step(row, prev))
+        return out
+
+    def _relay_step(self, row: dict, prev: Optional[dict]) -> List[Action]:
+        """The relay axis, stepped on the same row (at most one relay
+        action per step, independent of any scale action the same
+        step emitted — they move different resources)."""
+        cfg = self.config
+        if cfg.relay_subscribers_high <= 0:
+            return []
+        if self._relay_cooldown > 0:
+            self._relay_cooldown -= 1
+        reason = relay_pressure(row, prev, cfg)
+        relays = int(float(row.get("relays_live") or 0.0))
+        out: List[Action] = []
+        if reason is not None:
+            self._relay_pressure_streak += 1
+            self._relay_calm_streak = 0
+            if (self._relay_pressure_streak >= cfg.relay_out_after
+                    and relays < cfg.max_relays
+                    and self._relay_cooldown <= 0):
+                out.append(Action(
+                    "relay_out", FLAVOR_RELAY, relays + 1,
+                    f"{reason} (pressure x{self._relay_pressure_streak}), "
+                    f"relays {relays} -> {relays + 1}"))
+                self._relay_cooldown = cfg.relay_cooldown
+        else:
+            self._relay_calm_streak += 1
+            self._relay_pressure_streak = 0
+            if (self._relay_calm_streak >= cfg.relay_in_after
+                    and relays > 0 and self._relay_cooldown <= 0):
+                out.append(Action(
+                    "relay_in", None, relays - 1,
+                    f"broadcast calm x{self._relay_calm_streak}, "
+                    f"relays {relays} -> {relays - 1}"))
+                self._relay_cooldown = cfg.relay_cooldown
+                # Fresh calm per further step down (scale-in's rule).
+                self._relay_calm_streak = 0
         return out
 
     # -- helpers ----------------------------------------------------------

@@ -201,6 +201,8 @@ class ElasticFleetPlane:
         self.scale_in_total = 0
         self.scale_errors_total = 0
         self.saturations_total = 0
+        self.relay_out_total = 0
+        self.relay_in_total = 0
         self.decisions: "collections.deque" = collections.deque(
             maxlen=decision_log)
         # The composed-row window + emitted actions: the deterministic
@@ -313,6 +315,24 @@ class ElasticFleetPlane:
             if ok:
                 with self._lock:
                     self.scale_in_total += 1
+        elif a.kind == "relay_out":
+            # Third axis: a relay-only egress replica — no desired-
+            # replicas bookkeeping to roll back (relays never count
+            # against the filter-replica bounds).
+            try:
+                fleet.spawn_broadcast_relay(cause="autoscale",
+                                            reason=a.reason)
+            except Exception:
+                with self._lock:
+                    self.scale_errors_total += 1
+                return
+            with self._lock:
+                self.relay_out_total += 1
+        elif a.kind == "relay_in":
+            if fleet.retire_broadcast_relay(a.target, cause="autoscale",
+                                            reason=a.reason):
+                with self._lock:
+                    self.relay_in_total += 1
         elif a.kind == "flight":
             with self._lock:
                 self.saturations_total += 1
@@ -328,6 +348,8 @@ class ElasticFleetPlane:
                 "scale_in_total": float(self.scale_in_total),
                 "scale_errors_total": float(self.scale_errors_total),
                 "scale_saturations_total": float(self.saturations_total),
+                "relay_out_total": float(self.relay_out_total),
+                "relay_in_total": float(self.relay_in_total),
             }
 
     def stats(self) -> dict:

@@ -380,6 +380,15 @@ class FleetFrontend:
         self._explain_cache_t = float("-inf")
         self._explain_cache_lock = threading.Lock()
         self._explain_refresh_lock = threading.Lock()
+        # -- broadcast plane (ISSUE 17): fleet-level encode-once
+        # fan-out. Built lazily at the first publish_stream(); pump
+        # threads (one per published channel) own polling the
+        # published session and tee its deliveries into the channel.
+        self.broadcast: Any = None
+        self._publish_pumps: Dict[str, dict] = {}
+        self._pump_errors = 0
+        self.relay_spawns = 0     # applied spawn_broadcast_relay calls
+        self.relay_retires = 0    # applied retire_broadcast_relay calls
         for i in range(self.desired):
             rid = f"r{i}"
             self._replicas[rid] = self._make_replica(rid, i)
@@ -544,6 +553,19 @@ class FleetFrontend:
             self.telemetry.stop()
         if self.elastic is not None:
             self.elastic.stop()
+        # Broadcast before the replicas: the pumps poll sessions THROUGH
+        # the front door, and relays/fan-out workers must be joined
+        # before the conftest guard's sweep (dvf-fleet-bcast*,
+        # dvf-bcast*).
+        with self._lock:
+            pumps = list(self._publish_pumps.values())
+            self._publish_pumps.clear()
+        for p in pumps:
+            p["stop"].set()
+        for p in pumps:
+            p["thread"].join(timeout=timeout)
+        if self.broadcast is not None:
+            self.broadcast.stop(timeout=timeout)
         if self._monitor is not None:
             self._monitor.join(timeout=timeout)
             self._monitor = None
@@ -1362,6 +1384,140 @@ class FleetFrontend:
         self.tracer.instant("scale_saturated", track=0, reason=reason)
         self._dump_async(reason)
 
+    # -- broadcast plane: publish / subscribe / relay (ISSUE 17) ---------
+
+    def _ensure_broadcast(self):
+        with self._lock:
+            if self.broadcast is None:
+                from dvf_tpu.broadcast import BroadcastPlane
+
+                sc = self.config.serve
+                self.broadcast = BroadcastPlane(
+                    audit_wire=sc.broadcast_audit_wire,
+                    chaos=self.config.chaos,
+                    ingest_depth=sc.broadcast_ingest_depth,
+                    sub_queue=sc.broadcast_sub_queue,
+                    evict_after=sc.broadcast_evict_after,
+                    keyframe_interval=sc.broadcast_keyframe_interval)
+            return self.broadcast
+
+    def publish_stream(self, session_id: str, channel: str,
+                       tiers=None, poll_interval_s: float = 0.005) -> None:
+        """Register a fleet session's output as broadcast channel
+        ``channel``. Unlike the serve tier (an in-process tap on the
+        delivery loop), the fleet front door only sees frames when
+        someone polls — so publishing hands the session's polling to a
+        dedicated pump thread that drains ``poll(session_id)`` into
+        the channel. The publisher stops polling this session itself;
+        watchers attach with :meth:`subscribe`."""
+        plane = self._ensure_broadcast()
+        self._session(session_id)  # raises on unknown sid, before publish
+        plane.publish(channel, publisher=session_id, tiers=tiers or ())
+        tap = plane.tap(channel)
+        stop_evt = threading.Event()
+        t = threading.Thread(
+            target=self._pump_loop,
+            args=(channel, session_id, stop_evt, tap),
+            name=f"dvf-fleet-bcast-{channel}", daemon=True)
+        with self._lock:
+            self._publish_pumps[channel] = {
+                "thread": t, "stop": stop_evt, "session": session_id}
+        t.start()
+
+    def _pump_loop(self, channel: str, session_id: str,
+                   stop_evt: threading.Event, tap) -> None:
+        while not stop_evt.is_set() and not self._stop.is_set():
+            try:
+                got = self.poll(session_id)
+            except Exception:  # noqa: BLE001 — session released/lost:
+                # the channel stays subscribable (no new frames), the
+                # pump just ends; counted for stats.
+                with self._lock:
+                    self._pump_errors += 1
+                return
+            if not got:
+                stop_evt.wait(0.005)
+                continue
+            for d in got:
+                tap(d.index, d.frame, d.capture_ts)
+
+    def unpublish_stream(self, channel: str) -> None:
+        """Stop the pump and retire the channel (subscribers detach)."""
+        with self._lock:
+            pump = self._publish_pumps.pop(channel, None)
+        if pump is not None:
+            pump["stop"].set()
+            pump["thread"].join(timeout=5.0)
+        if self.broadcast is not None:
+            self.broadcast.unpublish(channel)
+
+    def subscribe(self, channel: str, tier=None,
+                  queue_size: Optional[int] = None, abr: bool = False):
+        """Attach a watcher to a published channel (serve-tier
+        semantics: tier spec string or Tier, None = ladder top or —
+        with ``abr`` — its cheapest rung)."""
+        return self._ensure_broadcast().subscribe(
+            channel, tier=tier, queue_size=queue_size, abr=abr)
+
+    def unsubscribe(self, sub) -> None:
+        if self.broadcast is not None:
+            self.broadcast.unsubscribe(sub)
+
+    def spawn_broadcast_relay(self, channel: Optional[str] = None,
+                              source_tier=None, tiers=(),
+                              cause: str = "manual", reason: str = ""):
+        """Spawn a relay-only egress replica (the elastic plane's
+        ``relay_out`` actuator, also callable by hand). ``channel``
+        None picks the channel with the most direct subscribers — the
+        one whose fan-out the relay relieves."""
+        plane = self._ensure_broadcast()
+        if channel is None:
+            rows = plane.stats()["channels"]
+            if not rows:
+                raise ServeError("no published channel to relay")
+            channel = max(
+                sorted(rows),
+                key=lambda c: sum(
+                    t.get("subscriber_count", 0)
+                    for t in rows[c]["tiers"].values()))
+        node = plane.spawn_relay(channel, source_tier=source_tier,
+                                 tiers=tiers)
+        with self._lock:
+            self.relay_spawns += 1
+        self.tracer.instant("relay_out", track=0, relay=node.id,
+                            channel=channel, cause=cause, reason=reason)
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.RELAY_SPAWN, cause=cause,
+                replica=node.id, channel=channel, reason=reason)
+        return node
+
+    def retire_broadcast_relay(self, relay_id: Optional[str] = None,
+                               cause: str = "manual",
+                               reason: str = "") -> bool:
+        """Retire one relay (``relay_id`` None = the newest — LIFO, the
+        scale-in mirror of spawn order). Its direct subscribers are
+        evicted; the upstream channel is untouched."""
+        if self.broadcast is None:
+            return False
+        if relay_id is None:
+            stats = self.broadcast.stats()["relays"]
+            if not stats:
+                return False
+            relay_id = sorted(stats)[-1]
+        try:
+            self.broadcast.retire_relay(relay_id)
+        except KeyError:
+            return False
+        with self._lock:
+            self.relay_retires += 1
+        self.tracer.instant("relay_in", track=0, relay=relay_id,
+                            cause=cause, reason=reason)
+        if self.ledger is not None:
+            self.ledger.record(ledger_mod.RELAY_RETIRE, cause=cause,
+                               replica=relay_id, reason=reason)
+        return True
+
     # -- audit plane: cross-replica divergence (obs.audit) ---------------
 
     def _audit_signature(self) -> Optional[str]:
@@ -1453,6 +1609,23 @@ class FleetFrontend:
             "replica_rows": rows,
             "multihost_available": self._multihost_key is not None,
             "profile_device_ms": self._profile_device_ms,
+            # Relay-axis inputs (zero rows when nothing publishes:
+            # relay_pressure short-circuits and the recorded window
+            # stays replayable against pre-broadcast controllers).
+            **self._broadcast_view(),
+        }
+
+    def _broadcast_view(self) -> dict:
+        if self.broadcast is None:
+            return {"broadcast_subscribers": 0.0,
+                    "broadcast_dropped_total": 0.0,
+                    "relays_live": 0.0}
+        sig = self.broadcast.signals()
+        return {
+            "broadcast_subscribers": sig.get("broadcast_subscribers", 0.0),
+            "broadcast_dropped_total": sig.get(
+                "broadcast_dropped_total", 0.0),
+            "relays_live": sig.get("broadcast_relays", 0.0),
         }
 
     # -- observability ---------------------------------------------------
@@ -1585,6 +1758,11 @@ class FleetFrontend:
         if self.ledger is not None:
             out.update(self.ledger.signals())
         out.update(self.divergence.signals())
+        if self.broadcast is not None:
+            out.update(self.broadcast.signals())
+            out["relay_spawns_total"] = float(self.relay_spawns)
+            out["relay_retires_total"] = float(self.relay_retires)
+            out["broadcast_pump_errors_total"] = float(self._pump_errors)
         if self.elastic is not None:
             for k, v in self.elastic.signals().items():
                 out.setdefault(k, v)   # plane extras (errors,
@@ -1667,6 +1845,14 @@ class FleetFrontend:
                if self.standby is not None else {}),
             **({"elastic": self.elastic.stats()}
                if self.elastic is not None else {}),
+            **({"broadcast": {
+                **self.broadcast.stats(),
+                "relay_spawns": self.relay_spawns,
+                "relay_retires": self.relay_retires,
+                "pump_errors": self._pump_errors,
+                "pumps": {ch: p["session"]
+                          for ch, p in self._publish_pumps.items()},
+            }} if self.broadcast is not None else {}),
             **self.admission.stats(),
             "faults": merge_fault_summaries(
                 self.faults.summary(),

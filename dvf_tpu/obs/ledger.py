@@ -62,6 +62,8 @@ BUCKET_RETIRE = "bucket_retire"
 REPLICA_SPAWN = "replica_spawn"      # fleet scale-out (warm or cold)
 REPLICA_RETIRE = "replica_retire"    # fleet scale-in (drain → terminate)
 REPLICA_RESTART = "replica_restart"  # loss-path respawn
+RELAY_SPAWN = "relay_spawn"          # broadcast relay-out (third axis)
+RELAY_RETIRE = "relay_retire"        # broadcast relay-in
 
 # Causes (why the reconfiguration happened) — data, not an enum; these
 # are the spellings the runtime emits.

@@ -79,8 +79,14 @@ SERVE_COMPONENTS = ("queue_ingress", "queue_bucket", "assemble_h2d",
                     "device", "d2h", "deliver")
 WIRE_COMPONENTS = ("encode", "send")
 RPC_COMPONENT = "rpc"
+# Broadcast fan-out hops (dvf_tpu.broadcast): the tier encode reuses
+# "encode"; "fanout" is queue distribution inside a lane, "relay" the
+# egress-replica hop — a watcher's p99 through a relay still
+# decomposes additively (encode + fanout + relay + deliver).
+BROADCAST_COMPONENTS = ("fanout", "relay")
 _ORDER = {name: i for i, name in enumerate(
-    SERVE_COMPONENTS + (RPC_COMPONENT,) + WIRE_COMPONENTS)}
+    SERVE_COMPONENTS + (RPC_COMPONENT,) + WIRE_COMPONENTS
+    + BROADCAST_COMPONENTS)}
 
 
 def component_order(name: str) -> Tuple[int, str]:

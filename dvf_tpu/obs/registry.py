@@ -90,6 +90,9 @@ DYNAMIC_KEY_PARENTS = frozenset({
     "warm_replicas", "by_signature", "by_bucket", "by_session",
     "rejections_by_tier", "standby", "phases", "by_cause",
     "digests",  # audit divergence events: digest-hex → replica ids
+    # Broadcast plane: channel names, tier labels ("640x360/q60/delta"),
+    # subscriber ids, and relay ids are all data-shaped keys.
+    "channels", "tiers", "subscribers", "relays", "pumps",
 })
 
 

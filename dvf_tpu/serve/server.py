@@ -53,7 +53,7 @@ import queue
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -244,6 +244,21 @@ class ServeConfig:
     audit_tolerance: float = 2.0  # pinned max-abs-diff tolerance for
     #   chains whose compute leaves uint8 (jit-vs-unjit float rounding
     #   freedom); uint8_ok chains compare bit-exact regardless
+    broadcast_sub_queue: int = 8  # broadcast plane (dvf_tpu.broadcast,
+    #   built lazily at the first open_stream(publish=...)): default
+    #   per-subscriber drop-oldest bound — a slow watcher drops its own
+    #   frames, never the tier's
+    broadcast_ingest_depth: int = 8   # publisher-tap → fan-out worker
+    #   queue bound (drop-oldest: fan-out pressure sheds whole frames
+    #   before any tier encodes them, the publisher never blocks)
+    broadcast_evict_after: int = 32   # consecutive displaced puts before
+    #   a dead subscriber is evicted from its lane
+    broadcast_keyframe_interval: int = 16  # delta-tier keyframe cadence;
+    #   also sets the per-tier forced-keyframe cooldown (interval // 2)
+    broadcast_audit_wire: bool = False  # stamp every tier payload with
+    #   the obs.audit envelope at the tier encoder — one stamp per tier
+    #   per frame, verified by the FINAL subscriber even across relay
+    #   hops (chaos `corrupt_wire` rides config.chaos)
     ledger: bool = True           # compile & reconfiguration ledger +
     #   memory accounting (obs.ledger / obs.memory): every compile,
     #   pool acquire/evict, batch resize, quality rebind, and engine
@@ -560,6 +575,10 @@ class ServeFrontend:
         if self.config.lineage:
             self.attribution = AttributionPlane(
                 exemplar_capacity=self.config.lineage_exemplars)
+        # -- broadcast plane (dvf_tpu.broadcast) ---------------------------
+        # Built lazily at the first open_stream(publish=...): plain
+        # per-session serving pays nothing for the fan-out machinery.
+        self.broadcast: Any = None
         # -- load-adaptive control plane (dvf_tpu.control) ----------------
         # Built BEFORE the ring so the ring cadence can come from the
         # control config; the plane's decisions ride the ring's
@@ -738,6 +757,12 @@ class ServeFrontend:
             buckets = list(self._buckets)
         for _, s in sessions:
             s.finalize()
+        if self.broadcast is not None:
+            # After the session tail delivery (finalize still taps) and
+            # before device/slab release: fan-out workers, relays, and
+            # tier codecs all join here — the conftest broadcast guard
+            # pins that nothing outlives stop().
+            self.broadcast.stop(timeout=timeout)
         # Release every compiled program's device residency: pooled
         # engines free through the pool; an engine that never made it
         # into the pool (default bucket that never compiled, adoption
@@ -1015,6 +1040,8 @@ class ServeFrontend:
             out.update(self.attribution.signals())
         if self.audit is not None:
             out.update(self.audit.signals())
+        if self.broadcast is not None:
+            out.update(self.broadcast.signals())
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
@@ -1305,8 +1332,18 @@ class ServeFrontend:
         frame_dtype: Any = None,
         op_chain: Optional[str] = None,
         tier: Optional[int] = None,
+        publish: Optional[str] = None,
+        publish_tiers: Optional[Sequence] = None,
     ) -> str:
         """Admit one new stream; returns its session id.
+
+        ``publish`` registers the session's delivered output as a named
+        broadcast channel (dvf_tpu.broadcast): subscribers attach with
+        :meth:`subscribe` at a (geometry, quality, wire) tier —
+        ``publish_tiers`` pre-registers the ladder (tier specs like
+        ``"640x360/q60/delta"`` or :class:`~dvf_tpu.broadcast.Tier`).
+        The publisher's own poll()/sink delivery is unchanged; fan-out
+        rides a per-delivery tap behind it.
 
         Raises ``AdmissionError`` at the ``max_sessions`` cap — overload
         is refused at the door, not absorbed as unbounded queueing — and
@@ -1368,6 +1405,8 @@ class ServeFrontend:
                     bucket, session_id, cfg, sink)
         if bucket is not None:
             self._warm_quality_async(bucket)
+            if publish:
+                self.publish_stream(sid_out, publish, publish_tiers)
             return sid_out
         with self._lock:
             # Best-effort headroom check BEFORE the compile: a frontend
@@ -1401,7 +1440,55 @@ class ServeFrontend:
                 # WARM in the pool either way.
                 self.pool.release(create_key)
         self._warm_quality_async(bucket)
+        if publish:
+            self.publish_stream(sid_out, publish, publish_tiers)
         return sid_out
+
+    # -- broadcast plane (publish / subscribe) ---------------------------
+
+    def _ensure_broadcast(self):
+        if self.broadcast is None:
+            from dvf_tpu.broadcast import BroadcastPlane
+
+            c = self.config
+            self.broadcast = BroadcastPlane(
+                audit_wire=c.broadcast_audit_wire, chaos=c.chaos,
+                ingest_depth=c.broadcast_ingest_depth,
+                sub_queue=c.broadcast_sub_queue,
+                evict_after=c.broadcast_evict_after,
+                keyframe_interval=c.broadcast_keyframe_interval,
+                lineage=self.attribution is not None)
+        return self.broadcast
+
+    def publish_stream(self, session_id: str, channel: str,
+                       tiers: Optional[Sequence] = None) -> None:
+        """Register an open session's delivered output as broadcast
+        channel ``channel``. The session keeps its own delivery path
+        (poll/sink); the broadcast tap tees each delivered frame into
+        the channel's fan-out worker (one copy + one bounded enqueue —
+        a stalled fan-out sheds frames there, never the publisher)."""
+        plane = self._ensure_broadcast()
+        plane.publish(channel, publisher=session_id, tiers=tiers or ())
+        with self._lock:
+            s = self._sessions.get(session_id)
+        if s is None:
+            plane.unpublish(channel)
+            raise ServeError(f"no open session {session_id!r} to publish")
+        s.tap = plane.tap(channel)
+
+    def subscribe(self, channel: str, tier=None,
+                  queue_size: Optional[int] = None, abr: bool = False):
+        """Attach a watcher to a published channel at a tier (spec
+        string or :class:`~dvf_tpu.broadcast.Tier`; None = the ladder
+        top, or its cheapest rung when ``abr`` is on). Returns the
+        :class:`~dvf_tpu.broadcast.Subscription` handle (``poll`` /
+        ``stats``; pass back to :meth:`unsubscribe`)."""
+        return self._ensure_broadcast().subscribe(
+            channel, tier=tier, queue_size=queue_size, abr=abr)
+
+    def unsubscribe(self, sub) -> None:
+        if self.broadcast is not None:
+            self.broadcast.unsubscribe(sub)
 
     # -- admission internals (bucket routing) ---------------------------
 
@@ -2913,6 +3000,8 @@ class ServeFrontend:
                if self.ledger is not None else {}),
             **({"flight": self.flight.stats()}
                if self.flight is not None else {}),
+            **({"broadcast": self.broadcast.stats()}
+               if self.broadcast is not None else {}),
             **({"control": {
                     **self.control_plane.stats(),
                     "quality_rebinds": self.quality_rebinds,

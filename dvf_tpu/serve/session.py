@@ -173,6 +173,14 @@ class StreamSession:
         #   (resilience.faults) — per-tenant fault attribution, poll-able
         #   through stats() beside the aggregate counters
         self.sink_errors = 0    # contained per-frame sink failures
+        self.tap = None         # broadcast publish hook (set by the
+        #   frontend when this session publishes a channel): called per
+        #   delivered frame AFTER the session's own sink/out delivery —
+        #   the publisher's interactive path is never behind fan-out,
+        #   and the tap itself only does one frame copy + one bounded
+        #   enqueue (broadcast.channel.Channel.offer)
+        self.tap_errors = 0     # contained tap failures (same policy
+        #   as sink_errors: drop the fan-out frame, keep serving)
         self._last_deadline = float("-inf")
 
     # -- client side (any thread) --------------------------------------
@@ -361,6 +369,17 @@ class StreamSession:
                 else:
                     self.out.put(Delivery(idx, frame, ts, lat_s * 1e3,
                                           tag, lin))
+                if self.tap is not None:
+                    try:
+                        self.tap(idx, frame, ts)
+                    except Exception as e:  # noqa: BLE001 — broadcast
+                        # fan-out trouble must never kill the
+                        # publisher's own delivery (sink containment
+                        # policy, applied to the tap)
+                        with self._lock:
+                            self.tap_errors += 1
+                        print(f"[serve:tap:{self.id}] error (continuing): "
+                              f"{e!r}", file=sys.stderr, flush=True)
                 n += 1
             if closed is not None:
                 bucket = self.bucket
@@ -423,6 +442,7 @@ class StreamSession:
                 "failed": self.failed,
                 "faults": dict(self.faults),
                 "sink_errors": self.sink_errors,
+                "tap_errors": self.tap_errors,
                 "dropped_at_ingress": self.ingress.dropped,
                 "dropped_unpolled": self.out.dropped,  # delivered but
                 #   evicted from the poll queue before the client read it

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""CI broadcast smoke: prove the fan-out plane's invariants cheaply.
+
+Three bounded legs (seconds total, CPU backend), exit NONZERO on any
+miss — wired into scripts/ci_tier1.sh beside the audit smoke:
+
+1. **Encode-once fan-out**: 64 watchers across two tiers of one
+   published channel; every tier codec must run exactly once per frame
+   (``encodes_total`` == frames, never × watchers), every sampled
+   watcher must see the full stream, and a never-polling watcher must
+   be evicted from its own queue without costing anyone else a frame.
+2. **Relay hop + audit envelope**: a relay-only egress node with one
+   injected ``corrupt_wire`` bit flip on the hop; the final
+   subscriber's verifier must catch EXACTLY the flipped frame and pass
+   every other frame verbatim (stamped once, at the tier encoder).
+3. **Serve publish tee**: a ServeFrontend session published at
+   admission; a subscriber's payloads must byte-match the tier
+   re-encode of what the publisher's own client polled, and teardown
+   must leave zero live broadcast sockets, relays, or fan-out threads.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+TIER_TOP = "native/q90/jpeg"
+TIER_LOW = "24x16/q60/jpeg"
+
+
+def fail(msg: str) -> None:
+    print(f"broadcast_smoke: MISS — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def make_frames(n: int, h: int = 32, w: int = 48):
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    return [np.roll(base, shift=i, axis=1).copy() for i in range(n)]
+
+
+def poll_until(sub, want: int, deadline_s: float = 15.0):
+    got = []
+    deadline = time.time() + deadline_s
+    while len(got) < want and time.time() < deadline:
+        fresh = sub.poll(256)
+        got.extend(fresh)
+        if not fresh:
+            time.sleep(0.002)
+    return got
+
+
+def encode_once_leg() -> None:
+    from dvf_tpu.broadcast import BroadcastPlane
+
+    n_frames, n_subs = 30, 64
+    pl = BroadcastPlane(ingest_depth=256, sub_queue=256, evict_after=4)
+    try:
+        ch = pl.publish("cam", tiers=[TIER_TOP, TIER_LOW])
+        subs = [pl.subscribe("cam", tier=(TIER_TOP, TIER_LOW)[i % 2])
+                for i in range(n_subs)]
+        slow = pl.subscribe("cam", tier=TIER_TOP, queue_size=2)
+        for i, f in enumerate(make_frames(n_frames)):
+            ch.offer(i, f, time.time())
+        if not ch.flush(timeout=10.0):
+            fail("encode-once leg: fan-out never quiesced")
+        for label, lane in ch.stats()["tiers"].items():
+            if lane["encodes_total"] != n_frames:
+                fail(f"encode-once leg: tier {label} ran its codec "
+                     f"{lane['encodes_total']}x for {n_frames} frames "
+                     f"({n_subs} watchers must not multiply encodes)")
+        for s in (subs[0], subs[1], subs[-1]):
+            if len(poll_until(s, n_frames)) != n_frames:
+                fail(f"encode-once leg: watcher {s.id} lost frames")
+        if not slow.evicted:
+            fail("encode-once leg: never-polling watcher not evicted")
+        sig = pl.signals()
+        if sig["broadcast_evicted_subscribers_total"] < 1:
+            fail("encode-once leg: eviction missing from signals")
+    finally:
+        pl.stop()
+    print(f"broadcast_smoke: encode-once ({n_subs} watchers, "
+          f"{n_frames} encodes/tier, slow peer evicted)", file=sys.stderr)
+
+
+def relay_audit_leg() -> None:
+    from dvf_tpu.broadcast import BroadcastPlane
+    from dvf_tpu.obs.audit import WireIntegrityError, verify_wire
+    from dvf_tpu.resilience.chaos import FaultPlan
+
+    n_frames = 8
+    chaos = FaultPlan(seed=7).add("corrupt_wire", at=(3,))
+    pl = BroadcastPlane(audit_wire=True, ingest_depth=256, sub_queue=256)
+    try:
+        ch = pl.publish("cam", tiers=[TIER_TOP])
+        node = pl.spawn_relay("cam", chaos=chaos, sub_queue=256,
+                              upstream_queue=256)
+        rsub = node.subscribe()
+        for i, f in enumerate(make_frames(n_frames)):
+            ch.offer(i, f, time.time())
+        if not ch.flush(timeout=10.0):
+            fail("relay leg: fan-out never quiesced")
+        got = poll_until(rsub, n_frames)
+        if len(got) != n_frames:
+            fail(f"relay leg: {len(got)}/{n_frames} frames crossed the hop")
+        bad = []
+        for d in got:
+            try:
+                verify_wire(d.payload, hop="smoke-subscriber")
+            except WireIntegrityError:
+                bad.append(d.seq)
+        if bad != [3]:
+            fail(f"relay leg: verifier flagged {bad}, expected [3] "
+                 f"(one injected flip, everything else verbatim)")
+        if node.stats()["corrupted_on_hop_total"] != 1:
+            fail("relay leg: relay did not account the injected flip")
+    finally:
+        pl.stop()
+    print("broadcast_smoke: relay hop (stamped envelope end-to-end, "
+          "injected flip caught)", file=sys.stderr)
+
+
+def serve_publish_leg() -> None:
+    from dvf_tpu.broadcast.plane import live_broadcast_sockets
+    from dvf_tpu.broadcast.relay import live_relay_nodes
+    from dvf_tpu.ops import get_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+    from dvf_tpu.transport.codec import make_wire_codec
+
+    n = 12
+    fe = ServeFrontend(get_filter("invert"),
+                       ServeConfig(batch_size=4, queue_size=256,
+                                   out_queue_size=256, slo_ms=60_000.0,
+                                   broadcast_ingest_depth=256,
+                                   broadcast_sub_queue=256)).start()
+    try:
+        sid = fe.open_stream(publish="cam", publish_tiers=[TIER_TOP])
+        sub = fe.subscribe("cam")
+        for f in make_frames(n, h=16, w=24):
+            fe.submit(sid, f)
+        delivered = []
+        deadline = time.time() + 20.0
+        while len(delivered) < n and time.time() < deadline:
+            delivered.extend(fe.poll(sid))
+            time.sleep(0.002)
+        if len(delivered) < n:
+            fail("serve leg: publisher client lost frames")
+        fe.broadcast.channel("cam").flush(timeout=10.0)
+        codec = make_wire_codec("jpeg", quality=90, threads=2)
+        try:
+            expect = [codec.encode(d.frame) for d in delivered]
+        finally:
+            if hasattr(codec, "close"):
+                codec.close()
+        got = poll_until(sub, n)
+        if [d.payload for d in got] != expect:
+            fail("serve leg: subscriber bytes != tier encode of the "
+                 "publisher's own deliveries")
+    finally:
+        fe.stop()
+    if live_broadcast_sockets():
+        fail("serve leg: broadcast gate sockets survived stop()")
+    if live_relay_nodes():
+        fail("serve leg: relay nodes survived stop()")
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("dvf-bcast")]
+    if leaked:
+        fail(f"serve leg: fan-out threads survived stop(): {leaked}")
+    print("broadcast_smoke: serve tee (subscriber byte-exact, "
+          "teardown clean)", file=sys.stderr)
+
+
+def main() -> None:
+    t0 = time.time()
+    encode_once_leg()
+    relay_audit_leg()
+    serve_publish_leg()
+    print(f"broadcast_smoke: clean ({time.time() - t0:.1f}s)",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

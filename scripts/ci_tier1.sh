@@ -2,10 +2,12 @@
 # Tier-1 CI gate: the full tier-1 test suite (ROADMAP.md's verify line)
 # PLUS the audit smoke (scripts/audit_smoke.py: one shadow-replay round
 # + one injected-corruption detection, nonzero on a miss) PLUS the
-# perf-regression sentinel (benchmarks/sentinel.py --quick). Exit
-# nonzero on a test failure, an audit miss, OR a measured perf
-# regression — the same bar the GitHub Actions workflow
-# (.github/workflows/ci.yml) enforces on every push.
+# broadcast smoke (scripts/broadcast_smoke.py: encode-once fan-out,
+# relay-hop audit, serve publish tee) PLUS the perf-regression
+# sentinel (benchmarks/sentinel.py --quick). Exit nonzero on a test
+# failure, an audit/broadcast miss, OR a measured perf regression —
+# the same bar the GitHub Actions workflow (.github/workflows/ci.yml)
+# enforces on every push.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,6 +30,14 @@ arc=$?
 if [ "$arc" -ne 0 ]; then
     echo "ci_tier1: AUDIT MISS (audit_smoke rc=$arc)" >&2
     exit "$arc"
+fi
+
+echo "== broadcast smoke (encode-once fan-out + relay-hop audit) =="
+JAX_PLATFORMS=cpu python scripts/broadcast_smoke.py
+brc=$?
+if [ "$brc" -ne 0 ]; then
+    echo "ci_tier1: BROADCAST MISS (broadcast_smoke rc=$brc)" >&2
+    exit "$brc"
 fi
 
 echo "== perf-regression sentinel =="

@@ -124,6 +124,12 @@ def pytest_configure(config):
                    "corrupt_wire/corrupt_device chaos acceptance — CPU "
                    "backend, bounded wall time; run in tier-1, select "
                    "with -m audit)")
+    config.addinivalue_line(
+        "markers", "broadcast: broadcast-plane tests (encode-once tiered "
+                   "fan-out, per-subscriber isolation, late-join "
+                   "keyframe rate limiting, relay-only egress replicas, "
+                   "ZMQ gate — CPU backend, bounded wall time; run in "
+                   "tier-1, select with -m broadcast)")
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -167,6 +173,48 @@ def _fleet_resources_released():
         fleet_threads = {t for t in fleet_threads if t.is_alive()}
     assert not fleet_threads, (
         f"fleet threads leaked: {sorted(t.name for t in fleet_threads)}")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _broadcast_resources_released():
+    """Broadcast tests must not leak fan-out workers, relay pumps, or
+    gate sockets past the suite: a leaked ``dvf-bcast*`` thread means
+    some Channel/RelayNode/gate was never closed (or a plane's stop()
+    stopped sweeping them) — a long-lived publisher churning channels
+    would accumulate one worker per channel forever. Fleet publish
+    pumps (``dvf-fleet-bcast*``) ride the fleet guard's prefix; this
+    one covers the serve tier and bare-plane tests. Registry checks
+    are import-gated like the sibling guards."""
+    yield
+    import sys as _sys
+
+    deadline = time.time() + 10.0
+    mod_p = _sys.modules.get("dvf_tpu.broadcast.plane")
+    if mod_p is not None:
+        gates = mod_p.live_broadcast_sockets()
+        while gates and time.time() < deadline:
+            time.sleep(0.1)
+            gates = mod_p.live_broadcast_sockets()
+        assert not gates, (
+            f"broadcast gate sockets leaked (ZmqBroadcastGate.close not "
+            f"called?): {[g.endpoint for g in gates]}")
+    mod_r = _sys.modules.get("dvf_tpu.broadcast.relay")
+    if mod_r is not None:
+        relays = mod_r.live_relay_nodes()
+        while relays and time.time() < deadline:
+            time.sleep(0.1)
+            relays = mod_r.live_relay_nodes()
+        assert not relays, (
+            f"relay nodes leaked (RelayNode.close / plane retire_relay "
+            f"not called?): {[r.id for r in relays]}")
+    bcast_threads = {t for t in threading.enumerate()
+                     if t.name.startswith("dvf-bcast") and t.is_alive()}
+    while bcast_threads and time.time() < deadline:
+        time.sleep(0.05)
+        bcast_threads = {t for t in bcast_threads if t.is_alive()}
+    assert not bcast_threads, (
+        f"broadcast threads leaked (Channel/plane close not called?): "
+        f"{sorted(t.name for t in bcast_threads)}")
 
 
 @pytest.fixture(scope="session", autouse=True)
