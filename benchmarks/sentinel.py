@@ -160,6 +160,21 @@ def baseline_gates():
         gate("SOAK_BENCH", "controlled_hard_failures",
              acc.get("controlled_hard_failures_total") == 0,
              f"{acc.get('controlled_hard_failures_total')} == 0")
+    doc = _load("SWAP_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("measured_stall_speedup"),
+                acc.get("stall_speedup_target", 10.0))
+        gate("SWAP_BENCH", "hot_swap_stall_speedup",
+             m is not None and m >= t, f"{m} >= {t}")
+        gate("SWAP_BENCH", "hot_swap_zero_stall_events",
+             acc.get("hot_swap_stall_events_total") == 0
+             and acc.get("dwell0_soak_stall_events_total") == 0,
+             f"hot={acc.get('hot_swap_stall_events_total')} "
+             f"dwell0={acc.get('dwell0_soak_stall_events_total')} == 0")
+        m = acc.get("hot_swap_p99_over_quiesce_p99")
+        gate("SWAP_BENCH", "hot_swap_interactive_p99_held",
+             m is not None and m <= 1.25, f"{m} <= 1.25")
     doc = _load("REFERENCE_HEADTOHEAD.json")
     if doc is not None:
         m = doc.get("speedup_same_codec_low_motion_delta_anchored")
@@ -683,7 +698,8 @@ def fresh_bench_diffs():
             ("attr_bench", "ATTR_BENCH.json", "attr_bench"),
             ("ledger_bench", "LEDGER_BENCH.json", "ledger_bench"),
             ("audit_bench", "AUDIT_BENCH.json", "audit_bench"),
-            ("admit_bench", "ADMIT_BENCH.json", "admit_bench")):
+            ("admit_bench", "ADMIT_BENCH.json", "admit_bench"),
+            ("swap_bench", "SWAP_BENCH.json", "swap_bench")):
         committed = _extract_record(_load(json_name), bench)
         if committed is None:
             continue

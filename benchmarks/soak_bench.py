@@ -62,7 +62,7 @@ def _pct(xs, q):
 def run_leg(control, concurrency, duration_s, chain, shape, batch,
             slo_ms=4000.0, per_session_fps=25.0, life_s=1.5,
             burst=4, queue_size=64, seed=0, control_interval_s=0.25,
-            n_persistent=4):
+            n_persistent=4, control_config=None):
     """Persistent interactive tenants + bursty open/close churn at a
     fixed aggregate offered rate; returns per-tier latency percentiles
     + failure accounting.
@@ -103,9 +103,16 @@ def run_leg(control, concurrency, duration_s, chain, shape, batch,
                                       saturate_after=12,
                                       # A recompile on this 2-vCPU host
                                       # costs more than a better batch
-                                      # size saves at soak timescales.
+                                      # size saves at soak timescales —
+                                      # even as a hot swap, the aside-
+                                      # compile competes for the same
+                                      # two cores the batches run on.
+                                      # swap_bench's dwell~0 leg passes
+                                      # control_config to measure the
+                                      # opposite posture.
                                       resize_hold=6, resize_cooldown=40)
-                        if control else None))
+                        if control and control_config is None
+                        else control_config if control else None))
     fe = ServeFrontend(build_filter(chain), cfg)
     stop = threading.Event()
     lock = threading.Lock()
@@ -272,6 +279,21 @@ def run_leg(control, concurrency, duration_s, chain, shape, batch,
         "p50_ms": _pct(all_lat, 0.50),
         "p99_ms": _pct(all_lat, 0.99),
         "tiers": tiers,
+        # Live-reconfiguration accounting (ISSUE 18): every controller
+        # actuation lands as a hot swap / windowless rebind, so a
+        # healthy leg reports stall_events_total == 0 no matter how
+        # aggressively the hysteresis is tuned.
+        "reconfig": {
+            "swaps_total": int(st.get("swaps", 0)),
+            "swap_aborts_total": int(st.get("swap_aborts", 0)),
+            "morphs_total": int(st.get("morphs", 0)),
+            "quality_rebinds_total": int(
+                (st.get("control") or {}).get("quality_rebinds", 0)),
+            "ledger_stall_events_total": (st.get("ledger") or {}).get(
+                "stall_events_total"),
+            "ledger_stall_ms_total": (st.get("ledger") or {}).get(
+                "stall_ms_total"),
+        },
     }
     if control and "control" in st:
         ctl = st["control"]

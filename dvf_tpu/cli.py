@@ -335,6 +335,21 @@ def _cmd_serve_multi(args, filt, engine) -> int:
               f"the demo opens every stream up front, so the cap must admit "
               f"them all", file=sys.stderr)
         return 2
+    morph_after = None
+    if getattr(args, "morph_after", None):
+        # Validate BEFORE opening streams: a typo'd chain must fail the
+        # command, not surface mid-demo from a watcher thread.
+        from dvf_tpu.runtime.signature import canonical_op_chain
+
+        k_str, sep, chain_spec = args.morph_after.partition(":")
+        try:
+            if not sep:
+                raise ValueError("want K:CHAIN")
+            morph_after = (int(k_str), canonical_op_chain(chain_spec))
+        except ValueError as e:
+            print(f"error: bad --morph-after {args.morph_after!r}: {e}",
+                  file=sys.stderr)
+            return 2
     config = ServeConfig(
         batch_size=args.batch,
         max_sessions=args.max_sessions if args.max_sessions else max(16, n),
@@ -435,6 +450,26 @@ def _cmd_serve_multi(args, filt, engine) -> int:
             ]
             for t in drivers:
                 t.start()
+            morph_result: dict = {}
+            if morph_after is not None:
+                morph_k, morph_chain = morph_after
+
+                def morph_watch() -> None:
+                    deadline = time.time() + 120.0
+                    while time.time() < deadline:
+                        if delivered.get(sids[0], 0) >= morph_k:
+                            try:
+                                morph_result["applied"] = \
+                                    frontend.morph_stream(
+                                        sids[0], morph_chain,
+                                        reason="cli --morph-after")
+                            except Exception as e:  # noqa: BLE001
+                                morph_result["error"] = str(e)
+                            return
+                        time.sleep(0.01)
+                    morph_result["applied"] = False
+
+                threading.Thread(target=morph_watch, daemon=True).start()
             while any(t.is_alive() for t in drivers):
                 for sid in sids:
                     delivered[sid] = delivered.get(sid, 0) + len(frontend.poll(sid))
@@ -474,7 +509,15 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         # ({} / 0 on a clean run — see docs/GUIDE.md "Faults, chaos…").
         "faults": stats["faults"]["by_kind"],
         "recoveries": stats["recoveries"],
+        # Live reconfiguration (ISSUE 18): hot swaps committed /
+        # aborted, and mid-stream filter-chain morphs.
+        "swaps": stats["swaps"],
+        "swap_aborts": stats["swap_aborts"],
+        "morphs": stats["morphs"],
     }
+    if morph_after is not None:
+        out["morph"] = {"chain": morph_after[1],
+                        "after": morph_after[0], **morph_result}
     if args.publish and "broadcast" in stats:
         bc = stats["broadcast"]["channels"].get(args.publish, {})
         out["broadcast"] = {
@@ -998,6 +1041,18 @@ def cmd_fleet(args) -> int:
             ]
             for t in drivers:
                 t.start()
+            rollout_result: dict = {}
+            if args.rollout_after is not None:
+
+                def rollout_watch() -> None:
+                    time.sleep(max(0.0, args.rollout_after))
+                    try:
+                        rollout_result.update(fleet.rolling_rollout(
+                            reason="cli --rollout-after"))
+                    except Exception as e:  # noqa: BLE001
+                        rollout_result["error"] = str(e)
+
+                threading.Thread(target=rollout_watch, daemon=True).start()
             while any(t.is_alive() for t in drivers):
                 for sid in sids:
                     polled[sid] = polled.get(sid, 0) + len(
@@ -1047,12 +1102,16 @@ def cmd_fleet(args) -> int:
         "standby_warm": stats["standby_warm"],
         "scale_outs": stats["scale_outs"],
         "scale_ins": stats["scale_ins"],
+        "rollouts": stats["rollouts"],
+        "rollout_swaps": stats["rollout_swaps"],
         # Audit plane: the divergence detector's counters (events ride
         # /audit and the flight dumps; the demo line carries the tally).
         "audit": {k: stats["audit"][k] for k in
                   ("checks_total", "divergences_total",
                    "quarantined_total")},
     }
+    if args.rollout_after is not None:
+        out["rollout"] = rollout_result
     print(json.dumps(out, default=float))
     return 0
 
@@ -1879,6 +1938,13 @@ def main(argv=None) -> int:
                          "batch — sheds first; default 1). Under "
                          "--control overload the admission floor "
                          "refuses high tier values first")
+    sp.add_argument("--morph-after", default=None, metavar="K:CHAIN",
+                    help="multi-session demo: once the first stream has "
+                         "K deliveries, hot-swap its filter chain to "
+                         "CHAIN mid-stream (morph_stream — no "
+                         "close/reopen, indices stay monotone, the "
+                         "cutover frame rides the ledger's swap event); "
+                         "e.g. 30:invert|box_blur")
     sp.add_argument("--publish", default=None, metavar="CHANNEL",
                     help="--sessions mode: register the first stream's "
                          "output as a broadcast channel (encode-once "
@@ -2015,6 +2081,14 @@ def main(argv=None) -> int:
                          "persistent compile cache) so a scale-out is "
                          "session-rebind time, not a cold spawn; a "
                          "background thread refills taken standbys")
+    fl.add_argument("--rollout-after", type=float, default=None,
+                    metavar="S",
+                    help="S seconds into the demo, run a zero-downtime "
+                         "rolling rollout: every replica is replaced "
+                         "spawn-before-retire (warm standby adoption "
+                         "when --standby-warm is armed) with sessions "
+                         "migrated gracefully; the report rides the "
+                         "demo's JSON line")
     fl.add_argument("--multihost-hosts", type=int, default=0,
                     help=">=2 arms the bigger-replica scaling axis: "
                          "scale-outs may spawn ONE replica spanning "

@@ -16,16 +16,15 @@ already exposes:
     rows per tick — a small bucket stops inheriting the big bucket's
     batch size, closing PR 9's per-bucket autotune item), growing under
     standing queue pressure; plus the dispatch tick interval (the tick
-    budget: tighten while work is queued, relax when idle). A resize
-    quiesces its bucket for a recompile, so SHRINKS — a pure
-    compute-waste optimization — are refused while the bucket hosts any
-    interactive session, and during the whole overload episode
+    budget: tighten while work is queued, relax when idle). Resizes
+    actuate through the compile-aside HOT SWAP (the successor program
+    compiles in the background, the commit is one pointer swing between
+    ticks), so the hysteresis is safety-only: a short hold debounces
+    the occupancy EWMA, a short flip dwell keeps the ladder from
+    chattering, and SHRINKS are refused only during an overload episode
     (pressure OR a raised admission floor: floor-up calm is fake calm,
     and the shrink it invites is un-shrunk seconds later by the
-    re-admission flood — a limit cycle where every leg of the
-    oscillation stalls the bucket's tenants for a compile). A
-    direction flip (grow after shrink or vice versa) additionally
-    waits out ``resize_flip_dwell`` samples.
+    re-admission flood).
 
 :class:`QualityController`
     Per-session resolution downshift under sustained pressure, lowest
@@ -94,13 +93,22 @@ class ControlConfig:
     batch_max: int = 0             # 0 = the frontend's configured
     #   batch_size (set by the plane at attach)
     occupancy_headroom: float = 1.3   # size to EWMA occupancy × this
-    resize_hold: int = 3           # consecutive samples agreeing on the
-    #   same target before a resize is issued (a resize recompiles)
-    resize_cooldown: int = 12      # min samples between resizes/bucket
-    resize_flip_dwell: int = 36    # min samples before a bucket may
-    #   resize in the OPPOSITE direction of its last resize (the
-    #   anti-limit-cycle bound: shrink-then-grow-back pays two compile
-    #   stalls for nothing)
+    # The resize hysteresis below was sized for the QUIESCE era, when
+    # every resize paused its bucket for a recompile and a wrong
+    # decision cost two visible stalls. Resizes now ride the
+    # compile-aside hot swap (runtime.engine.prepare_swap/commit_swap):
+    # the successor compiles on a background thread while the bucket
+    # keeps serving, and the commit is one pointer swing between ticks
+    # (~0 ms). The dwell values therefore shrink to safety-only floors —
+    # enough to debounce a noisy occupancy EWMA, not to amortize a
+    # stall that no longer exists.
+    resize_hold: int = 2           # consecutive samples agreeing on the
+    #   same target before a resize is issued (debounce only)
+    resize_cooldown: int = 4       # min samples between resizes/bucket
+    resize_flip_dwell: int = 8     # min samples before a bucket may
+    #   resize in the OPPOSITE direction of its last resize (a
+    #   flip now wastes only background compile, not serving time —
+    #   this floor just keeps the ladder from chattering)
     tick_busy_s: float = 0.002     # dispatch tick while work is queued
     tick_idle_s: float = 0.01      # relaxed tick after idle_after
     idle_after: int = 5            # samples with zero queue before relax
@@ -207,13 +215,12 @@ class BatchTickController:
                 # grow toward the cap regardless of what occupancy
                 # (bounded by the CURRENT size) says.
                 target = max(target, min(int(cur) * 2, cap))
-            if target < cur and (pressure or floor is not None
-                                 or b.get("min_tier") == 0):
+            if target < cur and (pressure or floor is not None):
                 # Never shrink during an overload episode (the calm a
-                # raised floor buys is fake calm) or under an
-                # interactive tenant: a shrink saves padded-row compute
-                # but stalls the bucket for the recompile — exactly the
-                # p99 the controller exists to protect.
+                # raised floor buys is fake calm). Interactive tenants
+                # no longer block a shrink: a hot-swapped resize costs
+                # the bucket ~0 serving time, so reclaiming padded-row
+                # compute is safe even under a tier-0 session.
                 target = int(cur)
             if target == cur:
                 self._want.pop(label, None)

@@ -252,6 +252,8 @@ class FleetFrontend:
         self.scale_ins = 0                # applied retire_replica calls
         self.standby_adoptions = 0        # scale-outs served warm (the
         #   standby pool had a pre-spawned replica ready)
+        self.rollouts = 0                 # completed rolling_rollout calls
+        self.rollout_swaps = 0            # replicas replaced across them
         self._replicas: "Dict[str, ReplicaHandle]" = {}
         self._load: Dict[str, int] = {}
         self._replica_load: Dict[str, dict] = {}  # per-replica load rows
@@ -1377,6 +1379,68 @@ class FleetFrontend:
             finally:
                 self._retiring.discard(rid)
 
+    def rolling_rollout(self, flavor: Optional[str] = None,
+                        reason: Optional[str] = None) -> dict:
+        """Zero-downtime config/version rollout: replace every live
+        replica one at a time, spawn-before-retire, behind the warm
+        standby pool (ISSUE 18).
+
+        Per replica the sequence is the serve tier's hot swap lifted a
+        level: ``spawn_replica`` brings a successor up (adopting a warm
+        standby when one is ready — the fleet-scale analogue of
+        compiling aside) while the incumbent keeps serving; only once
+        the successor is HEALTHY does ``retire_replica`` drain the
+        incumbent, migrating its bound sessions gracefully. Capacity
+        never dips below N, so sessions observe a migration (already a
+        no-stall path) rather than an outage.
+
+        A replica that fails to spawn a successor aborts the rollout
+        for the REMAINING incumbents (the fleet never trades a known-
+        good replica for nothing); a retire that returns False (the
+        incumbent died or started draining mid-rollout) is skipped —
+        the loss path owns it. Both outcomes land in the summary
+        ``swap`` ledger event, cause ``rollout``."""
+        t0 = time.time()
+        with self._lock:
+            targets = [rid for rid, r in sorted(self._replicas.items())
+                       if r.state == HEALTHY]
+        swapped: List[dict] = []
+        aborted: Optional[str] = None
+        for rid in targets:
+            try:
+                new_rid = self.spawn_replica(
+                    flavor=flavor, cause=ledger_mod.CAUSE_ROLLOUT,
+                    reason=reason)
+            except Exception as e:  # noqa: BLE001 — spawn failed: keep
+                aborted = f"spawn failed at {rid}: {e!r}"  # the incumbent
+                break
+            retired = self.retire_replica(
+                rid, cause=ledger_mod.CAUSE_ROLLOUT, reason=reason)
+            swapped.append({"old": rid, "new": new_rid,
+                            "retired": retired})
+            self.rollout_swaps += 1
+        self.rollouts += 1
+        record = {
+            "targets": len(targets),
+            "swapped": [s for s in swapped if s["retired"]],
+            "skipped": [s for s in swapped if not s["retired"]],
+            "aborted": aborted,
+            "wall_ms": round((time.time() - t0) * 1e3, 3),
+        }
+        self.tracer.instant("rolling_rollout", track=0,
+                            targets=len(targets),
+                            swapped=len(record["swapped"]),
+                            aborted=aborted)
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.SWAP, cause=ledger_mod.CAUSE_ROLLOUT,
+                targets=len(targets), swapped=len(record["swapped"]),
+                skipped=len(record["skipped"]), flavor=flavor,
+                aborted=True if aborted else None,
+                wall_ms=record["wall_ms"], reason=reason or aborted,
+                t0=t0)
+        return record
+
     def flight_trip(self, reason: str) -> None:
         """Elastic-plane observability tap (scale saturation: pressure
         with every replica spawned): same off-thread fleet flight dump
@@ -1739,6 +1803,7 @@ class FleetFrontend:
             "scale_out_total": float(self.scale_outs),
             "scale_in_total": float(self.scale_ins),
             "standby_adoptions_total": float(self.standby_adoptions),
+            "rollout_swaps_total": float(self.rollout_swaps),
             "admission_refusals_total": float(self.admission.rejections),
             # Cached per-replica load aggregates (RPC-free; summed
             # counters dip on a replica restart/retire — the idiomatic
@@ -1841,6 +1906,8 @@ class FleetFrontend:
             "scale_outs": self.scale_outs,
             "scale_ins": self.scale_ins,
             "standby_adoptions": self.standby_adoptions,
+            "rollouts": self.rollouts,
+            "rollout_swaps": self.rollout_swaps,
             **({"standby": self.standby.stats()}
                if self.standby is not None else {}),
             **({"elastic": self.elastic.stats()}

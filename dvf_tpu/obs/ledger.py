@@ -64,6 +64,14 @@ REPLICA_RETIRE = "replica_retire"    # fleet scale-in (drain → terminate)
 REPLICA_RESTART = "replica_restart"  # loss-path respawn
 RELAY_SPAWN = "relay_spawn"          # broadcast relay-out (third axis)
 RELAY_RETIRE = "relay_retire"        # broadcast relay-in
+SWAP = "swap"                        # compile-aside + atomic hot swap: the
+#   stall-free substitution path. Carries compile_aside_ms (background
+#   compile, nobody blocked), migrate_ms (device-to-device state move),
+#   and stall_ms — here the MEASURED commit duration on the dispatch
+#   thread (the pointer swing), recorded directly rather than via a
+#   stall window: a hot swap never quiesces the bucket, so there is no
+#   dispatch gap to measure, only the tick-boundary commit cost (~0).
+#   Aborted swaps ledger with aborted=True and the old program serving.
 
 # Causes (why the reconfiguration happened) — data, not an enum; these
 # are the spellings the runtime emits.
@@ -75,6 +83,8 @@ CAUSE_PRECOMPILE = "precompile"
 CAUSE_CAPACITY = "capacity"
 CAUSE_AUTOSCALE = "autoscale"
 CAUSE_MANUAL = "manual"
+CAUSE_MORPH = "morph"        # live session filter-chain swap (morph_stream)
+CAUSE_ROLLOUT = "rollout"    # fleet rolling config/version rollout
 
 # The dedicated trace lane reconfiguration events land on (serve's
 # stage lanes are 0..4; lineage uses none; 6 keeps clear of all).

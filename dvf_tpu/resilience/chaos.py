@@ -99,6 +99,11 @@ SITE_KINDS = {
     # is exactly what the audit acceptance tests measure.
     "corrupt_wire": FaultKind.INTEGRITY,
     "corrupt_device": FaultKind.INTEGRITY,
+    # Hot-swap sites (runtime.engine double-buffer): event 0 of a swap
+    # is the aside-compile (prepare_swap), event 1 the mid-migrate
+    # commit — a rule's ``at=`` indices pick which half fails. Either
+    # failure must leave the OLD program serving untouched.
+    "swap": FaultKind.COMPUTE,
 }
 
 

@@ -199,6 +199,9 @@ class ShardedBatchFetcher:
 
     def _streamable(self, result: Any) -> bool:
         return (self.effective_mode == "streamed"
+                and self._pool is not None  # released mid-flight (egress
+                #   degradation, hot swap): a plan-pinned fetcher must
+                #   fall back per batch, not scatter into freed slabs
                 and hasattr(result, "addressable_shards")
                 and getattr(result, "is_fully_addressable", True)
                 and tuple(result.shape) == self.out_shape)

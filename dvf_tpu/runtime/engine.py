@@ -119,6 +119,17 @@ class Engine:
         #   filter state (summed leaf nbytes at compile) — the per-
         #   engine half of the memory accounting; free() folds it into
         #   the process-wide freed counter
+        # Double-buffered program swap (stall-free reconfiguration):
+        # prepare_swap() compiles a successor engine ASIDE (background
+        # thread, nothing blocked), commit_swap() adopts its program
+        # fields in place between ticks. The lock serializes staging
+        # bookkeeping and the commit's field swing against run_probe
+        # (the audit worker must never read a half-adopted program).
+        self._swap_lock = threading.RLock()
+        self._staged: Optional["Engine"] = None
+        self._preparing: Dict[Tuple, threading.Event] = {}
+        self.swap_count = 0
+        self.last_swap: Optional[dict] = None
 
     # ------------------------------------------------------------------
 
@@ -408,20 +419,28 @@ class Engine:
         operands are its own fresh device buffers. Blocking
         (materializes the result) — callers are off the hot path by
         contract (swap guards, divergence probes)."""
-        if self.freed:
-            raise RuntimeError("cannot probe a freed engine")
-        if self._step is None or self._signature is None:
-            raise RuntimeError("cannot probe an uncompiled engine")
-        if self._exec_filter.stateful:
-            raise ValueError(
-                f"cannot probe stateful filter {self.filter.name!r}: the "
-                f"probe would consume (donated) live temporal state")
-        if (tuple(batch.shape), np.dtype(batch.dtype)) != self._signature:
-            raise ValueError(
-                f"probe batch {batch.shape}/{batch.dtype} does not match "
-                f"the compiled signature {self._signature}")
-        x = jax.device_put(np.ascontiguousarray(batch), self._sharding)
-        y, _ = self._step(x, self._state)
+        # Under the swap lock: commit_swap swings every program field
+        # as one atomic update, and a probe racing it must read either
+        # the old program wholesale or the new one — never a mix.
+        with self._swap_lock:
+            if self.freed:
+                raise RuntimeError("cannot probe a freed engine")
+            if self._step is None or self._signature is None:
+                raise RuntimeError("cannot probe an uncompiled engine")
+            if self._exec_filter.stateful:
+                raise ValueError(
+                    f"cannot probe stateful filter {self.filter.name!r}: "
+                    f"the probe would consume (donated) live temporal "
+                    f"state")
+            if (tuple(batch.shape),
+                    np.dtype(batch.dtype)) != self._signature:
+                raise ValueError(
+                    f"probe batch {batch.shape}/{batch.dtype} does not "
+                    f"match the compiled signature {self._signature}")
+            x = jax.device_put(np.ascontiguousarray(batch),
+                               self._sharding)
+            step, state = self._step, self._state
+        y, _ = step(x, state)
         return np.asarray(y)
 
     def cost_analysis(self) -> Optional[dict]:
@@ -469,6 +488,183 @@ class Engine:
             fresh.compile(shape, dtype)
         return fresh
 
+    # -- double-buffered hot swap (stall-free reconfiguration) ----------
+
+    def prepare_swap(self, batch_shape: Tuple[int, ...], dtype=np.uint8,
+                     force: bool = False) -> dict:
+        """Compile the successor program for ``batch_shape``/``dtype``
+        ASIDE — a fresh engine traced, compiled, warmed, and calibrated
+        on THIS (background) thread while the live program keeps
+        serving. Nothing the serving path reads is touched until
+        :meth:`commit_swap` adopts the staged successor between ticks.
+
+        ``force=True`` prepares even at the live signature (a fresh
+        program + fresh state at the same shape — the supervised-
+        recovery rebuild, compiled aside instead of in place).
+
+        Concurrent prepares for the same successor signature dedup onto
+        one compile via a per-signature latch (the engine-level mirror
+        of ``ProgramPool.acquire``'s per-key latch); a prepare for a
+        DIFFERENT signature supersedes the previously staged successor
+        (its buffers are freed — last prepare wins).
+
+        Returns ``{"compile_aside_ms", "staged", "cache"}``; ``staged``
+        False means the live program already serves this signature and
+        nothing was built. Raises on compile failure (and on the chaos
+        ``swap`` site) with the live program untouched.
+        """
+        if self.freed:
+            raise RuntimeError("cannot prepare a swap on a freed engine")
+        sig = (tuple(batch_shape), np.dtype(dtype))
+        if sig == self._signature and not force:
+            return {"compile_aside_ms": 0.0, "staged": False,
+                    "cache": "live"}
+        while True:
+            with self._swap_lock:
+                st = self._staged
+                if st is not None and st._signature == sig and not force:
+                    return {"compile_aside_ms": 0.0, "staged": True,
+                            "cache": "staged"}
+                latch = self._preparing.get(sig)
+                if latch is None:
+                    self._preparing[sig] = latch = threading.Event()
+                    break
+            # Another thread is building this successor: wait it out,
+            # then re-check (it staged the program, or died and we
+            # build).
+            latch.wait(timeout=300.0)
+        t0 = time.perf_counter()
+        try:
+            if self.chaos is not None:
+                self.chaos.fire("swap")  # injection site: aside-compile
+                #   failure — the old program must keep serving
+            succ = Engine(self.filter, mesh=self.mesh,
+                          out_uint8=self.out_uint8, chaos=self.chaos,
+                          op_chain=self.op_chain)
+            succ.compile(tuple(batch_shape), dtype)
+        except BaseException:
+            with self._swap_lock:
+                self._preparing.pop(sig, None)
+            latch.set()
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._swap_lock:
+            old, self._staged = self._staged, succ
+            self._preparing.pop(sig, None)
+        latch.set()
+        if old is not None and old is not succ:
+            old.free()  # superseded staging
+        return {"compile_aside_ms": ms, "staged": True, "cache": "miss"}
+
+    @property
+    def swap_staged(self) -> bool:
+        """Whether a prepared successor is waiting for commit_swap."""
+        with self._swap_lock:
+            return self._staged is not None
+
+    def commit_swap(self, migrate_state: bool = True) -> dict:
+        """Adopt the staged successor program atomically: ONE lock-
+        guarded field swing — call from the thread that owns submits
+        (the serving dispatch thread), so a batch never straddles the
+        old and new programs. In-flight batches already submitted on
+        the old program hold their own result references and drain
+        normally; the old program's handles drop here and its buffers
+        free once they do.
+
+        Device-resident filter state migrates device-to-device when the
+        successor's state tree matches shape-for-shape
+        (``migrate_state=True``); a geometry-changing swap (or
+        ``migrate_state=False`` — supervised recovery, whose old state
+        is poisoned by definition) keeps the successor's fresh state.
+
+        Returns ``{"migrate_ms", "stall_ms", "migrated"}`` — stall_ms
+        is the measured wall duration of this call, the ONLY serving
+        time the swap consumes. Raises (chaos ``swap`` site mid-
+        migrate, a failed device copy) with the live program untouched
+        and the staged successor freed: a failed swap leaves the old
+        program serving.
+        """
+        with self._swap_lock:
+            succ = self._staged
+            if succ is None:
+                raise RuntimeError(
+                    "no staged successor program (prepare_swap first)")
+            self._staged = None
+            t0 = time.perf_counter()
+            migrate_ms = 0.0
+            migrated = False
+            try:
+                if self.chaos is not None:
+                    self.chaos.fire("swap")  # injection site: mid-
+                    #   migrate failure — abort, old program serving
+                if migrate_state and self._exec_filter.stateful \
+                        and self._state is not None \
+                        and succ._exec_filter.stateful:
+                    t_m = time.perf_counter()
+                    migrated = self._migrate_state_to(succ)
+                    if migrated:
+                        migrate_ms = (time.perf_counter() - t_m) * 1e3
+            except BaseException:
+                succ.free()
+                raise
+            # The swing: adopt every program field the serving/egress
+            # paths read. In place — the engine OBJECT survives, so
+            # pool leases, bucket bindings, and probe callers keep one
+            # stable identity across any number of swaps.
+            for name in ("_step", "_signature", "_state", "_sharding",
+                         "_exec_filter", "out_shape", "out_dtype",
+                         "_out_sharding", "h2d_block_ms", "d2h_block_ms",
+                         "step_block_ms", "last_compile_ms",
+                         "state_bytes"):
+                setattr(self, name, getattr(succ, name))
+            self.stats.compile_count += succ.stats.compile_count
+            # Neuter the successor shell: its device buffers now belong
+            # to this engine — its free() must not free them.
+            succ._step = None
+            succ._state = None
+            succ._sharding = None
+            succ._out_sharding = None
+            succ.state_bytes = 0
+            succ.freed = True
+            self.swap_count += 1
+            stall_ms = (time.perf_counter() - t0) * 1e3
+            self.last_swap = {"migrate_ms": round(migrate_ms, 3),
+                              "stall_ms": round(stall_ms, 3),
+                              "migrated": migrated}
+            return dict(self.last_swap)
+
+    def _migrate_state_to(self, succ: "Engine") -> bool:
+        """Device-to-device re-placement of the live filter state under
+        the successor's shardings — only when the trees match leaf-for-
+        leaf (same structure, shapes, dtypes). False = shapes diverged
+        (the successor keeps its fresh init state; a geometry change
+        resets temporal state by definition)."""
+        old_leaves = jax.tree_util.tree_leaves(self._state)
+        new_leaves = jax.tree_util.tree_leaves(succ._state)
+        if len(old_leaves) != len(new_leaves):
+            return False
+        for a, b in zip(old_leaves, new_leaves):
+            if (tuple(getattr(a, "shape", ())) != tuple(
+                    getattr(b, "shape", ()))
+                    or getattr(a, "dtype", None) != getattr(b, "dtype",
+                                                            None)):
+                return False
+        succ._state = jax.device_put(self._state,
+                                     succ._state_shardings())
+        jax.block_until_ready(succ._state)
+        return True
+
+    def abort_swap(self) -> bool:
+        """Free a staged successor without adopting it (the owner
+        decided against the swap, or its commit precondition failed).
+        True when something was staged."""
+        with self._swap_lock:
+            succ, self._staged = self._staged, None
+        if succ is not None:
+            succ.free()
+            return True
+        return False
+
     def free(self) -> None:
         """Release this engine's device residency: the compiled program
         handle, the device-resident state, and the warmup-derived
@@ -480,6 +676,10 @@ class Engine:
         if self.freed:
             return
         self.freed = True
+        with self._swap_lock:
+            staged, self._staged = self._staged, None
+        if staged is not None:
+            staged.free()  # an un-committed successor must not leak
         self._step = None
         self._state = None
         self._sharding = None

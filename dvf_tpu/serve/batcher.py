@@ -83,6 +83,12 @@ class BatchPlan:
     #   session_id, frame_index, lineage), ...]; the collect side pairs
     #   each with its DELIVERED output and hands the pair to the replay
     #   worker. None = audit off or nothing sampled (zero cost).
+    fetcher: Any = None  # the egress fetcher THIS batch was prefetched
+    #   into, pinned at dispatch: a hot program swap may replace
+    #   ``bucket.fetcher`` (new output signature) while this batch is
+    #   still in flight, and the collect side must fetch from the one
+    #   the D2H was actually issued on. None = monolithic egress (the
+    #   collect side falls back to np.asarray).
 
 
 class ContinuousBatcher:

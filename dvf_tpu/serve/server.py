@@ -126,6 +126,13 @@ TRACK_DISPATCH, TRACK_DEVICE, TRACK_H2D, TRACK_D2H = 0, 1, 3, 4
 COMPILE_MS_BOUNDS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                      1000.0, 2500.0, 5000.0, 10000.0)
 
+# dvf_swap_stall_ms histogram bounds: a hot swap's serving cost is the
+# tick-boundary commit (a pointer swing + optional device-to-device
+# state migration) — sub-millisecond to a few ms; anything in the
+# hundreds means the compile leaked back onto the dispatch thread.
+SWAP_STALL_MS_BOUNDS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                        25.0, 50.0, 100.0, 250.0)
+
 
 @dataclasses.dataclass
 class ServeConfig:
@@ -313,13 +320,11 @@ class _Bucket:
         #   retirement, unlike a per-live-session sum
         self.batch_size = config.batch_size  # per-bucket device batch
         #   rows — the control plane's batch controller resizes this
-        #   from measured occupancy (initiated by the dispatch thread
-        #   only while nothing is in flight: a resize recompiles the
-        #   program, and in-flight batches must not straddle shapes)
-        self.resizing = False  # guarded by the frontend lock: a resize
-        #   recompile is running on its own thread — dispatch skips the
-        #   bucket (keeping it quiescent) so the OTHER buckets' ticks
-        #   never stall behind this bucket's compile
+        #   from measured occupancy via a HOT SWAP: the successor
+        #   program compiles aside while this bucket keeps dispatching
+        #   at the old size; the commit swings the program pointer
+        #   between ticks, and in-flight batches drain on the old
+        #   program (their collect fetches through plan.fetcher)
         self.mean_valid_rows: Optional[float] = None  # EWMA of VALID
         #   rows per served batch — the occupancy signal batch sizing
         #   divides by (rows beyond it are padding the device computes
@@ -331,6 +336,10 @@ class _Bucket:
         self.assembler: Optional[ShardedBatchAssembler] = None
         self.ingest_stats: Optional[IngestStats] = None
         self.fetcher: Optional[ShardedBatchFetcher] = None
+        self.draining_fetchers: List[ShardedBatchFetcher] = []  # egress
+        #   fetchers retired by a hot swap while batches prefetched into
+        #   them were still in flight (those fetch through plan.fetcher);
+        #   released by collect once the bucket's window drains to zero
         self.egress_stats: Optional[EgressStats] = None
         self._tick_cost_ms: Optional[float] = None  # live EWMA
         self.last_dispatch_t: Optional[float] = None  # wall clock of
@@ -399,6 +408,14 @@ class _Bucket:
     def reset_inflight(self) -> None:
         with self._count_lock:
             self.inflight_batches = 0
+
+    def release_drained_fetchers(self) -> None:
+        """Free swap-retired egress fetchers; call only when no batch
+        prefetched into them can still be in flight (window at zero, or
+        the bucket is being torn down)."""
+        drained, self.draining_fetchers = self.draining_fetchers, []
+        for f in drained:
+            f.release()
 
     # -- signature -------------------------------------------------------
 
@@ -544,6 +561,7 @@ class ServeFrontend:
         # -- compile & reconfiguration ledger + memory accounting ----------
         self.ledger: Optional[ReconfigLedger] = None
         self.compile_hist = None
+        self.swap_hist = None
         self._leak_watch: Optional[LeakTrendWatch] = None
         if self.config.ledger:
             self.ledger = ReconfigLedger(tracer=self.tracer)
@@ -552,6 +570,11 @@ class ServeFrontend:
             # distribution the hot-swap work will be judged against.
             self.compile_hist = self.registry.histogram(
                 "compile_ms", COMPILE_MS_BOUNDS)
+            # Per-swap serving cost (the commit's measured wall on the
+            # dispatch thread): the distribution the "stall-free"
+            # claim is audited against — dvf_swap_stall_ms on /metrics.
+            self.swap_hist = self.registry.histogram(
+                "swap_stall_ms", SWAP_STALL_MS_BOUNDS)
             self.pool.observer = self._on_pool_event
             attach_memory_provider(self.registry,
                                    bucket_rows_fn=self._memory_bucket_rows)
@@ -588,11 +611,25 @@ class ServeFrontend:
         #   set admission floor: open_stream refuses tier > floor
         self._tick_s = self.config.tick_s  # live dispatch tick (the
         #   control plane's tick-budget actuator writes it)
-        self._pending_resizes: Dict[_Bucket, int] = {}  # applied by the
-        #   dispatch thread when the bucket has nothing in flight
+        self._pending_resizes: Dict[_Bucket, Any] = {}  # bucket →
+        #   (n, reason): the dispatch thread kicks each off as a
+        #   compile-aside (Engine.prepare_swap on a background thread;
+        #   the bucket KEEPS dispatching at the old size throughout)
         self._pending_rebinds: "queue.Queue" = queue.Queue()  # (sid,
-        #   key, level) quality moves — applied by the dispatch thread,
-        #   which owns the session pending deques being flushed
+        #   key, level, reason, morph_chain) quality moves / morphs —
+        #   applied by the dispatch thread, which owns the session
+        #   pending deques being flushed
+        self._pending_commits: "queue.Queue" = queue.Queue()  # staged
+        #   hot swaps whose aside-compile finished: the dispatch thread
+        #   commits each between ticks (one pointer swing — a batch
+        #   never straddles the old and new programs)
+        self._preparing_swaps: set = set()  # buckets with an aside-
+        #   prepare in flight (one at a time per bucket; a newer
+        #   pending resize waits its turn)
+        self.swaps = 0        # committed hot swaps
+        self.swap_aborts = 0  # failed prepares/commits (old program
+        #   kept serving — the contained-abort contract)
+        self.morphs = 0       # committed live filter-chain morphs
         self.quality_rebinds = 0
         self.quality_rebinds_dropped = 0
         self._warmed_quality: set = set()   # quality keys pre-compiled
@@ -781,6 +818,7 @@ class ServeFrontend:
                 a.release()
             if f is not None:
                 f.release()
+            b.release_drained_fetchers()
             if self.ledger is not None:
                 self.ledger.abandon_stalls(b.label())
         if self.config.profile_dir:
@@ -1000,6 +1038,11 @@ class ServeFrontend:
             "compile_cache_misses_total": float(self.pool.misses),
             "pool_evictions_total": float(self.pool.evictions),
             "pool_size": float(len(self.pool)),
+            # Hot-swap plane: committed program swaps, contained aborts
+            # (old program kept serving), live filter-chain morphs.
+            "swaps_total": float(self.swaps),
+            "swap_aborts_total": float(self.swap_aborts),
+            "morphs_total": float(self.morphs),
         }
         if self._supervisor is not None:
             out["stalls_total"] = float(self._supervisor.stalls)
@@ -1237,6 +1280,16 @@ class ServeFrontend:
         if self.compile_hist is not None and compile_ms is not None:
             self.compile_hist.observe(
                 float(compile_ms),
+                labels={"signature": signature or "unpinned",
+                        "cause": cause or "unknown"})
+
+    def _observe_swap(self, stall_ms, signature, cause) -> None:
+        """The ``dvf_swap_stall_ms`` histogram: the measured serving
+        time one hot swap consumed (the commit's pointer swing — ~0),
+        NOT the aside-compile (nobody was blocked for that)."""
+        if self.swap_hist is not None and stall_ms is not None:
+            self.swap_hist.observe(
+                float(stall_ms),
                 labels={"signature": signature or "unpinned",
                         "cause": cause or "unknown"})
 
@@ -1642,6 +1695,7 @@ class ServeFrontend:
             a.release()
         if f is not None:
             f.release()
+        bucket.release_drained_fetchers()
         if self.ledger is not None:
             label = bucket.label()
             # A retired bucket never dispatches again: close out any
@@ -1784,13 +1838,15 @@ class ServeFrontend:
 
     def request_batch_size(self, bucket_label: str, n: int,
                            reason: Optional[str] = None) -> bool:
-        """Queue a per-bucket batch resize; the dispatch thread applies
-        it once that bucket has nothing in flight (a resize recompiles
-        the program — through the pool and the persistent cache, so a
-        previously-seen size costs a deserialize). False = no such
-        bucket (it retired between decide and apply). ``reason``
-        (the controller's decision rationale) rides into the ledger's
-        batch_resize event."""
+        """Queue a per-bucket batch resize, served as a HOT SWAP: the
+        dispatch thread kicks the new size's program compile to a
+        background thread (through the pool and the persistent cache,
+        so a previously-seen size costs a deserialize) while the bucket
+        keeps serving at the old size, then commits the staged program
+        with one pointer swing between ticks — no quiesce, no stall
+        window. False = no such bucket (it retired between decide and
+        apply). ``reason`` (the controller's decision rationale) rides
+        into the ledger's ``swap`` event."""
         n = max(1, int(n))
         with self._lock:
             for b in self._buckets:
@@ -1858,7 +1914,62 @@ class ServeFrontend:
             self._ensure_quality_bucket(key, base_chain, level)
         except AdmissionError:
             return False
-        self._pending_rebinds.put((session_id, key, level, reason))
+        self._pending_rebinds.put((session_id, key, level, reason, None))
+        return True
+
+    def morph_stream(self, session_id: str, op_chain: str,
+                     reason: Optional[str] = None) -> bool:
+        """Swap one live session's FILTER CHAIN mid-stream — no
+        close/reopen, no index reset. The target chain's program is
+        built or leased HERE (caller thread — a compile must not stall
+        dispatch; through the pool it is usually a warm hit), then the
+        cutover rides the rebind queue: the dispatch thread flushes the
+        session's queued frames (old chain — they cannot enter the new
+        program), swings the bucket binding between ticks, and ledgers
+        a ``swap`` event (cause=morph) with the cutover frame index.
+        Indices stay monotone: frames before the ledgered
+        ``cutover_index`` were filtered by the old chain, frames at and
+        after it by the new one. The adopted program carries a
+        swap-guard equivalence verdict like every other substitution.
+        False = impossible right now (session gone/closing, nothing
+        flowed yet, malformed chain raises ServeError, bucket cap with
+        no idle victim)."""
+        try:
+            chain = canonical_op_chain(op_chain)
+        except Exception as e:  # noqa: BLE001 — surface as admission
+            raise ServeError(f"morph_stream: bad op_chain "
+                             f"{op_chain!r}: {e}") from None
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is None or s.state != OPEN:
+                return False
+            if s.base_sig is None:
+                bucket = s.bucket if s.bucket is not None \
+                    else self._buckets[0]
+                pinned = bucket.pinned_signature()
+                if pinned is None:
+                    return False  # nothing has flowed yet — no geometry
+                s.base_sig = pinned
+                s.base_chain = bucket.op_chain
+            if chain == s.base_chain:
+                return True  # already serving this chain
+            shape, dtype = s.base_sig
+            level = s.quality_level
+        # The morph preserves the session's quality level: the target
+        # key decimates the NEW chain at the same ladder rung.
+        key = self._quality_key(chain, shape, dtype, level)
+        if key is None:
+            key = self._quality_key(chain, shape, dtype, 0)
+            level = 0  # geometry stopped dividing under the new chain:
+            #   morph to full quality rather than refuse the morph
+        if key is None:
+            return False
+        try:
+            self._ensure_quality_bucket(key, chain, level,
+                                        cause=ledger_mod.CAUSE_MORPH)
+        except AdmissionError:
+            return False
+        self._pending_rebinds.put((session_id, key, level, reason, chain))
         return True
 
     def _quality_key(self, base_chain: str, shape: tuple, dtype,
@@ -1938,12 +2049,15 @@ class ServeFrontend:
                 name=key.op_chain)
 
     def _ensure_quality_bucket(self, key: SignatureKey, base_chain: str,
-                               level: int) -> None:
+                               level: int,
+                               cause: str = ledger_mod.CAUSE_QUALITY
+                               ) -> None:
         """Make a live bucket exist for ``key`` (join or create —
         open_stream's admission discipline, compile outside the lock).
         For a base chain that is NOT a registry spec (an ad-hoc filter
         name), the downshift filter is composed from the LIVE base
-        Filter object instead of build_filter."""
+        Filter object instead of build_filter. ``cause`` labels the
+        pool acquire in the ledger (quality rebind vs live morph)."""
         with self._lock:
             if self._bucket_by_key.get(key) is not None:
                 return
@@ -1951,8 +2065,7 @@ class ServeFrontend:
                 self._register_quality_chain_locked(key, base_chain,
                                                     1 << level)
             self._check_bucket_headroom_locked(key)
-        engine = self._acquire_program(key,
-                                       cause=ledger_mod.CAUSE_QUALITY)
+        engine = self._acquire_program(key, cause=cause)
         owned = False
         try:
             with self._lock:
@@ -1966,17 +2079,24 @@ class ServeFrontend:
                 #   stays warm, the live bucket keeps its own lease
 
     def _apply_rebinds_dispatch(self) -> None:
-        """Dispatch-thread half of a quality move: flush the session's
-        queued frames (OLD geometry — they cannot enter the new
-        program), swap its bucket binding, set the level. Atomic with
-        submit's decimate+enqueue under ``_lock``. A target bucket that
-        retired between request and apply drops the move (counted); the
-        controller re-decides from a later window."""
+        """Dispatch-thread half of a quality move or a live morph:
+        flush the session's queued frames (OLD geometry/chain — they
+        cannot enter the new program), swap its bucket binding, set the
+        level. Atomic with submit's decimate+enqueue under ``_lock``.
+        The target bucket's program was compiled ASIDE before the
+        request was queued (``_ensure_quality_bucket``), so the cutover
+        here is one binding swing between ticks — no stall window is
+        opened; the MEASURED swing duration is ledgered as the event's
+        ``stall_ms`` (~0). A target bucket that retired between request
+        and apply drops the move (counted); the controller re-decides
+        from a later window."""
         while True:
             try:
-                sid, key, level, reason = self._pending_rebinds.get_nowait()
+                (sid, key, level, reason,
+                 morph_chain) = self._pending_rebinds.get_nowait()
             except queue.Empty:
                 return
+            t_c = time.time()
             with self._lock:
                 s = self._sessions.get(sid)
                 if s is None or s.state == CLOSED:
@@ -1994,51 +2114,69 @@ class ServeFrontend:
                     old.sessions.pop(sid, None)
                     target.sessions[sid] = s
                     s.bucket = target
+                if morph_chain is not None:
+                    # Live morph: from here on the session's quality
+                    # ladder decimates from the NEW chain; frame
+                    # indices stay monotone (submitted is untouched).
+                    s.base_chain = morph_chain
+                    cutover = s.submitted
+                    self.morphs += 1
+                else:
+                    s.quality_shifts += 1
+                    self.quality_rebinds += 1
                 s.quality_level = level
-                s.quality_shifts += 1
-                self.quality_rebinds += 1
-                stall_from = (target.last_dispatch_t
-                              if target.last_dispatch_t is not None
-                              else time.time())
+            stall_ms = round((time.time() - t_c) * 1e3, 3)
             if self.ledger is not None:
-                # Stall window on the TARGET bucket: the gap until the
-                # downshifted program first serves — the tenant-visible
-                # cost of the move (its compile was ledgered separately
-                # under cause=quality when the bucket was built/warmed).
-                self.ledger.record(
-                    ledger_mod.QUALITY_REBIND,
-                    cause=ledger_mod.CAUSE_QUALITY,
-                    signature=key.render(), bucket=target.label(),
-                    session=sid, level=level, frames_flushed=flushed,
-                    reason=reason, stall_from=stall_from)
+                if morph_chain is not None:
+                    self.ledger.record(
+                        ledger_mod.SWAP, cause=ledger_mod.CAUSE_MORPH,
+                        signature=key.render(), bucket=target.label(),
+                        session=sid, cutover_index=cutover,
+                        frames_flushed=flushed, stall_ms=stall_ms,
+                        reason=reason, t0=t_c)
+                    self._observe_swap(stall_ms, key.render(),
+                                       ledger_mod.CAUSE_MORPH)
+                else:
+                    # The rebind's tenant-visible cost is the MEASURED
+                    # binding swing (the target program was compiled
+                    # aside) — no stall window: the target bucket never
+                    # stopped dispatching.
+                    self.ledger.record(
+                        ledger_mod.QUALITY_REBIND,
+                        cause=ledger_mod.CAUSE_QUALITY,
+                        signature=key.render(), bucket=target.label(),
+                        session=sid, level=level, frames_flushed=flushed,
+                        stall_ms=stall_ms, reason=reason, t0=t_c)
             if self.audit is not None:
-                # Equivalence verdict for the quality program the
-                # session was just rebound onto — vs the golden path
-                # of ITS OWN (decimate+upscale) chain: a rebind is by
-                # design not equivalent to the base program, but the
-                # substituted program must still compute its chain.
-                # Async: this is the dispatch thread — the probe runs
-                # on the audit worker (the bucket keeps its engine
-                # leased; a raced retirement yields probe_failed, not
-                # a crash).
+                # Equivalence verdict for the program the session was
+                # just rebound onto — vs the golden path of ITS OWN
+                # chain: a rebind/morph is by design not equivalent to
+                # the base program, but the substituted program must
+                # still compute its chain. Async: this is the dispatch
+                # thread — the probe runs on the audit worker (the
+                # bucket keeps its engine leased; a raced retirement
+                # yields probe_failed, not a crash).
                 self.audit.swap_guard(
                     engine=target.engine, filt=target.filter,
-                    kind="quality_rebind",
-                    cause=ledger_mod.CAUSE_QUALITY,
+                    kind="morph" if morph_chain is not None
+                    else "quality_rebind",
+                    cause=(ledger_mod.CAUSE_MORPH
+                           if morph_chain is not None
+                           else ledger_mod.CAUSE_QUALITY),
                     signature=key.render(), bucket=target.label(),
                     reason=reason, asynchronous=True)
 
     def _apply_resizes_dispatch(self) -> None:
-        """Dispatch-thread half of a batch resize: initiated only while
-        the bucket has nothing in flight (batches must not straddle
-        program shapes); otherwise retried next tick. The recompile
-        itself runs on a short-lived background thread with the bucket
-        marked ``resizing`` — dispatch skips a resizing bucket, so the
-        OTHER buckets' ticks never stall behind this one's compile (on
-        the dispatch thread, a 300 ms compile would hole EVERY bucket's
-        p99, which is exactly the latency the controller is trying to
-        protect). The compile serializes with supervised recovery via
-        ``_recover_lock``."""
+        """Dispatch-thread half of a batch resize, hot-swap edition:
+        kick the successor program's compile ASIDE on a short-lived
+        background thread (``Engine.prepare_swap`` — through the
+        persistent compilation cache, so a previously-seen size costs a
+        deserialize) while the bucket KEEPS dispatching at the old
+        size. When the aside-compile lands, the staged commit comes
+        back through ``_pending_commits`` and
+        :meth:`_apply_commits_dispatch` swings the program pointer
+        between ticks — no quiesce, no stall window, in-flight batches
+        on the old program drain and collect normally."""
         with self._lock:
             pending = list(self._pending_resizes.items())
         for bucket, (n, reason) in pending:
@@ -2051,8 +2189,9 @@ class ServeFrontend:
                 if bucket not in self._buckets:
                     self._pending_resizes.pop(bucket, None)
                     continue
-                if bucket.resizing or bucket.inflight_batches != 0:
-                    continue  # retry next tick
+                if bucket in self._preparing_swaps:
+                    continue  # an aside-prepare is already in flight;
+                    #   this (possibly newer) target waits its turn
                 if self._pending_resizes.get(bucket) != (n, reason):
                     continue  # superseded since the snapshot above
                 self._pending_resizes.pop(bucket, None)
@@ -2068,89 +2207,122 @@ class ServeFrontend:
                             bucket=bucket.label(), batch_size=n,
                             wall_ms=0.0, reason=reason)
                     continue
-                bucket.resizing = True
+                self._preparing_swaps.add(bucket)
                 shape = (n, *bucket.frame_shape)
                 dtype = np.dtype(bucket.frame_dtype)
-                # The stall the ledger will charge this resize: from
-                # the bucket's last dispatch tick before it went
-                # quiescent to its first tick after the swap.
-                stall_from = (bucket.last_dispatch_t
-                              if bucket.last_dispatch_t is not None
-                              else time.time())
             threading.Thread(
-                target=self._resize_compile,
-                args=(bucket, n, shape, dtype, stall_from, reason),
-                name="dvf-serve-resize", daemon=True).start()
+                target=self._swap_prepare_resize,
+                args=(bucket, n, shape, dtype, reason),
+                name="dvf-serve-swap-prepare", daemon=True).start()
 
-    def _resize_compile(self, bucket: "_Bucket", n: int,
-                        shape: tuple, dtype,
-                        stall_from: Optional[float] = None,
-                        reason: Optional[str] = None) -> None:
-        """Off-dispatch half of a batch resize (see
-        ``_apply_resizes_dispatch``): compile the bucket's program at
-        the new batch shape while dispatch keeps the bucket quiescent,
-        then swap the size in. Through the pool's persistent
-        compilation cache a previously-seen size costs a deserialize.
-        Failure is contained — the old size keeps serving."""
+    def _swap_prepare_resize(self, bucket: "_Bucket", n: int,
+                             shape: tuple, dtype,
+                             reason: Optional[str] = None) -> None:
+        """Background half of a hot resize: capture the OLD program's
+        probe row (the swap guard's bit-identity reference), compile
+        the successor at the new batch shape aside, then hand the
+        staged commit to the dispatch thread. A failed aside-compile
+        is contained — the staged successor is discarded, the old
+        program never stopped serving, and the abort is ledgered."""
         t0 = time.time()
         try:
             # Swap guard (obs.audit): the OLD program's probe output
-            # must be captured BEFORE ensure_compiled replaces it in
-            # place — the resize substitutes a program under live
-            # tenants, which is only safe if equivalence is proven.
+            # captured BEFORE the swap can land — the resize
+            # substitutes a program under live tenants, which is only
+            # safe if equivalence is proven.
             old_row = (self.audit.probe_row(bucket.engine)
                        if self.audit is not None else None)
-            before = bucket.engine.stats.compile_count
             with self._recover_lock:
-                bucket.engine.ensure_compiled(shape, dtype)
-            self._adopt_bucket_key(bucket)  # takes self._lock itself
-            with self._lock:
-                bucket.batch_size = n
-                bucket.assembler = None  # staging re-derives from the
-                #   new program's sharding in _builder_for (which finds
-                #   the compile already done)
-            if self.ledger is not None:
-                compiled = bucket.engine.stats.compile_count != before
-                compile_ms = (bucket.engine.last_compile_ms
-                              if compiled else 0.0)
-                label = bucket.label()
-                self.ledger.record(
-                    ledger_mod.BATCH_RESIZE,
-                    cause=ledger_mod.CAUSE_RESIZE,
-                    signature=label, bucket=label, batch_size=n,
-                    wall_ms=(time.time() - t0) * 1e3,
-                    compile_ms=(round(float(compile_ms), 3)
-                                if compile_ms is not None else None),
-                    cache=("miss" if compiled else "hit"),
-                    reason=reason, t0=t0, stall_from=stall_from)
-                if compiled:
-                    self._observe_compile(compile_ms, label,
-                                          ledger_mod.CAUSE_RESIZE)
-            if self.audit is not None:
-                # Equivalence verdict for the adopted program: probe
-                # through the new program vs the golden path (and
-                # bit-identity vs the old program's probe row — same
-                # per-frame geometry across a batch resize). Ledgered
-                # as a swap_guard event: zero unaudited substitutions.
-                self.audit.swap_guard(
-                    engine=bucket.engine, filt=bucket.filter,
-                    kind="batch_resize", cause=ledger_mod.CAUSE_RESIZE,
-                    signature=bucket.label(), bucket=bucket.label(),
-                    old_row=old_row, reason=reason)
-        except Exception:  # noqa: BLE001 — counted, never raised into
-            with self._lock:               # the serving path
+                prep = bucket.engine.prepare_swap(shape, dtype)
+        except Exception as e:  # noqa: BLE001 — counted, never raised
+            with self._lock:                # into the serving path
                 self.resize_compile_errors += 1
+                self.swap_aborts += 1
+                self._preparing_swaps.discard(bucket)
             if self.ledger is not None:
                 self.ledger.record(
-                    ledger_mod.BATCH_RESIZE,
-                    cause=ledger_mod.CAUSE_RESIZE,
+                    ledger_mod.SWAP, cause=ledger_mod.CAUSE_RESIZE,
                     bucket=bucket.label(), batch_size=n,
-                    wall_ms=(time.time() - t0) * 1e3,
-                    reason="resize compile failed (old size keeps "
-                           "serving)", t0=t0)
-        finally:
+                    wall_ms=(time.time() - t0) * 1e3, aborted=True,
+                    reason=f"aside compile failed (old program keeps "
+                           f"serving): {e!r}", t0=t0)
+            return
+        self._pending_commits.put(
+            ("resize", bucket, n, prep, old_row, reason, t0))
+
+    def _apply_commits_dispatch(self) -> None:
+        """Dispatch-thread commit of staged hot swaps: one pointer
+        swing per swap, between ticks — the only serving time a swap
+        consumes, measured and ledgered as its ``stall_ms``."""
+        while True:
+            try:
+                item = self._pending_commits.get_nowait()
+            except queue.Empty:
+                return
+            if item[0] == "resize":
+                self._commit_resize_swap(*item[1:])
+
+    def _commit_resize_swap(self, bucket: "_Bucket", n: int, prep: dict,
+                            old_row, reason: Optional[str],
+                            t0: float) -> None:
+        with self._lock:
+            live = bucket in self._buckets
+            self._preparing_swaps.discard(bucket)
+        if not live:
+            bucket.engine.abort_swap()  # retired between prepare and
+            return                      # commit: staging must not leak
+        try:
+            res = (bucket.engine.commit_swap()
+                   if bucket.engine.swap_staged
+                   else {"migrate_ms": 0.0, "stall_ms": 0.0,
+                         "migrated": False})
+        except Exception as e:  # noqa: BLE001 — abort contained: the
+            #   old program is serving, untouched (commit_swap's
+            #   failure contract); only the abort is ledgered
             with self._lock:
-                bucket.resizing = False
+                self.resize_compile_errors += 1
+                self.swap_aborts += 1
+            if self.ledger is not None:
+                self.ledger.record(
+                    ledger_mod.SWAP, cause=ledger_mod.CAUSE_RESIZE,
+                    bucket=bucket.label(), batch_size=n,
+                    wall_ms=(time.time() - t0) * 1e3, aborted=True,
+                    reason=f"swap commit failed (old program keeps "
+                           f"serving): {e!r}", t0=t0)
+            return
+        self._adopt_bucket_key(bucket)  # takes self._lock itself
+        with self._lock:
+            bucket.batch_size = n
+            bucket.assembler = None  # staging re-derives from the new
+            #   program's sharding in _builder_for; the egress fetcher
+            #   re-derives at the next dispatch (in-flight batches keep
+            #   fetching through the fetcher pinned on their plan)
+            self.swaps += 1
+        if self.ledger is not None:
+            label = bucket.label()
+            self.ledger.record(
+                ledger_mod.SWAP, cause=ledger_mod.CAUSE_RESIZE,
+                signature=label, bucket=label, batch_size=n,
+                wall_ms=(time.time() - t0) * 1e3,
+                compile_aside_ms=round(
+                    float(prep.get("compile_aside_ms", 0.0)), 3),
+                migrate_ms=res["migrate_ms"],
+                stall_ms=res["stall_ms"],
+                cache=prep.get("cache"), reason=reason, t0=t0)
+            self._observe_swap(res["stall_ms"], label,
+                               ledger_mod.CAUSE_RESIZE)
+        if self.audit is not None:
+            # Equivalence verdict for the adopted program: probe
+            # through the new program vs the golden path (and
+            # bit-identity vs the old program's probe row — same
+            # per-frame geometry across a batch resize). Async: this
+            # is the dispatch thread. Ledgered as a swap_guard event:
+            # zero unaudited substitutions.
+            self.audit.swap_guard(
+                engine=bucket.engine, filt=bucket.filter,
+                kind="batch_resize", cause=ledger_mod.CAUSE_RESIZE,
+                signature=bucket.label(), bucket=bucket.label(),
+                old_row=old_row, reason=reason, asynchronous=True)
 
     def submit(self, session_id: str, frame: np.ndarray,
                ts: Optional[float] = None, tag: Any = None) -> int:
@@ -2349,6 +2521,13 @@ class ServeFrontend:
             return None
         f = bucket.fetcher
         if f is None or f.out_shape != tuple(shape):
+            if f is not None:
+                # Output signature changed under a hot swap: batches
+                # already prefetched into the old fetcher are still in
+                # flight (their plans pin it) — park it for release
+                # once the bucket's window drains instead of freeing
+                # slabs the collect side is about to read.
+                bucket.draining_fetchers.append(f)
             bucket.egress_stats = EgressStats(
                 requested_mode=self.config.egress,
                 d2h_block_ms=bucket.engine.d2h_block_ms)
@@ -2558,15 +2737,38 @@ class ServeFrontend:
                     # probe failing is itself expected here).
                     old_row = (self.audit.probe_row(b.engine)
                                if self.audit is not None else None)
-                    b.engine = b.engine.rebuild()
-                    if b._pooled and b.key is not None:
+                    swapped = False
+                    sig = b.engine.signature
+                    if sig is not None:
+                        # Double-buffered rebuild: compile the fresh
+                        # program aside, then adopt it in place —
+                        # Engine identity stays stable, so pool leases
+                        # (and any other bucket sharing the lease)
+                        # survive without pool.replace. force=True:
+                        # the live program is suspect, a same-signature
+                        # short-circuit would hand it right back.
+                        # migrate_state=False: suspect state must not
+                        # be carried into the replacement.
+                        shape, dtype = sig
                         try:
-                            self.pool.replace(b.key, b.engine)
-                        except RuntimeError:
-                            # Pool closed mid-recovery (owner stopping):
-                            # replace() freed the rebuilt engine — the
-                            # frontend is past serving this bucket.
-                            pass
+                            b.engine.prepare_swap(shape, dtype,
+                                                  force=True)
+                            b.engine.commit_swap(migrate_state=False)
+                            swapped = True
+                            self.swaps += 1
+                        except Exception:  # noqa: BLE001 — fall back
+                            b.engine.abort_swap()   # to the cold path
+                    if not swapped:
+                        b.engine = b.engine.rebuild()
+                        if b._pooled and b.key is not None:
+                            try:
+                                self.pool.replace(b.key, b.engine)
+                            except RuntimeError:
+                                # Pool closed mid-recovery (owner
+                                # stopping): replace() freed the rebuilt
+                                # engine — the frontend is past serving
+                                # this bucket.
+                                pass
                     a, b.assembler = b.assembler, None
                     f, b.fetcher = b.fetcher, None  # re-derive from the
                     #   fresh engine's re-calibrated d2h_block_ms; slabs
@@ -2576,6 +2778,8 @@ class ServeFrontend:
                         a.release()
                     if f is not None:
                         f.release()
+                    b.release_drained_fetchers()  # window fully shed:
+                    #   nothing in flight can still pin them
                     if self.ledger is not None:
                         label = b.label()
                         compile_ms = b.engine.last_compile_ms
@@ -2588,6 +2792,7 @@ class ServeFrontend:
                             compile_ms=(round(float(compile_ms), 3)
                                         if compile_ms is not None
                                         else None),
+                            swap=swapped or None,
                             t0=t_rb, stall_from=stall_from)
                         if compile_ms is not None:
                             self._observe_compile(
@@ -2657,26 +2862,28 @@ class ServeFrontend:
                 if self._supervisor is not None:
                     self._supervisor.beat("dispatch")
                 # Control-plane actuations owned by THIS thread: quality
-                # rebinds (flush + bucket swap touch the session pending
-                # deques only dispatch may touch) and batch resizes
-                # (only safe while the bucket has nothing in flight —
-                # a resize recompiles, and a batch must not straddle
-                # the old and new program shapes).
+                # rebinds / morphs (flush + bucket swap touch the
+                # session pending deques only dispatch may touch),
+                # batch-resize aside-prepares (kicked to a background
+                # thread; the bucket keeps serving), and staged swap
+                # commits (the atomic pointer swing between ticks).
                 if not self._pending_rebinds.empty():
                     self._apply_rebinds_dispatch()
                 if self._pending_resizes:
                     self._apply_resizes_dispatch()
+                if not self._pending_commits.empty():
+                    # Staged hot swaps land HERE, between ticks: one
+                    # pointer swing per swap — the only serving time a
+                    # reconfiguration consumes on this thread.
+                    self._apply_commits_dispatch()
                 with self._lock:
-                    # A bucket mid-resize is quiescent by contract: its
-                    # program is being recompiled on the resize thread
-                    # and a batch must not straddle the old and new
-                    # shapes. Its sessions keep queueing; EDF picks the
-                    # backlog up the tick the swap lands.
+                    # Buckets with an aside-prepare in flight keep
+                    # dispatching at the OLD size/program — a hot swap
+                    # never quiesces; the commit lands between ticks.
                     bucket_sessions = [
                         (b, [s for s in b.sessions.values()
                              if s.state != CLOSED])
-                        for b in self._buckets
-                        if b.sessions and not b.resizing]
+                        for b in self._buckets if b.sessions]
                 plan = None
                 if bucket_sessions:
                     # One bucket per tick (one compiled program per
@@ -2778,6 +2985,10 @@ class ServeFrontend:
                     fetcher = self._fetcher_for(bucket)
                     if fetcher is not None:
                         fetcher.prefetch(result)
+                    plan.fetcher = fetcher  # pinned: a hot swap may
+                    #   re-derive bucket.fetcher (new output signature)
+                    #   while this batch is in flight — collect must
+                    #   fetch from the one the D2H was issued on
                     self.tracer.complete("serve_dispatch", t0, time.time(),
                                          TRACK_DISPATCH, seq=seq,
                                          frames=plan.valid,
@@ -2848,7 +3059,11 @@ class ServeFrontend:
                         break
                     continue
                 bucket = plan.bucket
-                fetcher = bucket.fetcher if bucket is not None else None
+                fetcher = (plan.fetcher if plan.fetcher is not None
+                           else (bucket.fetcher if bucket is not None
+                                 else None))  # plan-pinned first: the
+                #   bucket's fetcher may already belong to a hot-swapped
+                #   successor program with a different output signature
                 if plan.lin_marks is not None and block_until_ready is not None:
                     try:
                         block_until_ready(result)
@@ -2927,6 +3142,12 @@ class ServeFrontend:
                                 bucket=bucket.label(), lineage=lin,
                                 out_uint8=bucket.engine.out_uint8)
                 self.router.route(plan, out)
+                if bucket is not None and bucket.draining_fetchers \
+                        and bucket.inflight_batches == 0:
+                    # The last pre-swap batch just routed (route copies
+                    # rows out of the slab, so it is quiescent now):
+                    # the old program's egress slabs can finally go.
+                    bucket.release_drained_fetchers()
                 # A materialized batch is proof of engine progress: the
                 # consecutive-stall escalation counter starts over.
                 self._stalls_since_progress = 0
@@ -2968,6 +3189,12 @@ class ServeFrontend:
             "faults": self.faults.summary(),
             "fault_budget": self._budget.summary(),
             "recoveries": self.recoveries,
+            # Hot-swap plane: committed stall-free substitutions (resize
+            # / morph / recovery), contained aborts (old program kept
+            # serving), and live chain morphs.
+            "swaps": self.swaps,
+            "swap_aborts": self.swap_aborts,
+            "morphs": self.morphs,
             "engine_batches": sum(b.engine.stats.batches for b in buckets),
             "engine_frames": sum(b.engine.stats.frames for b in buckets),
             # Multi-signature plane: one row per live bucket (keyed by

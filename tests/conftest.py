@@ -130,6 +130,12 @@ def pytest_configure(config):
                    "keyframe rate limiting, relay-only egress replicas, "
                    "ZMQ gate — CPU backend, bounded wall time; run in "
                    "tier-1, select with -m broadcast)")
+    config.addinivalue_line(
+        "markers", "swap: live-reconfiguration tests (compile-aside "
+                   "program double-buffering, atomic hot swap, "
+                   "mid-stream filter morph, chaos-injected swap "
+                   "aborts, swap_bench schema — CPU backend, bounded "
+                   "wall time; run in tier-1, select with -m swap)")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -506,11 +506,14 @@ class TestSwapGuardCoverage:
                         kind=FaultKind.COMPUTE, bucket=bucket)
             assert fe.audit.drain(30.0)
             events = fe.ledger.snapshot()
+            # The resize substitution now lands as a hot-swap event
+            # (kind=swap, cause=resize); rebind and rebuild keep their
+            # kinds. Every one still carries a swap_guard verdict.
             subs = [e for e in events if e["kind"] in
-                    ("batch_resize", "quality_rebind", "engine_rebuild")]
+                    ("swap", "quality_rebind", "engine_rebuild")]
             guards = [e for e in events if e["kind"] == "swap_guard"]
             kinds = {e["kind"] for e in subs}
-            assert kinds == {"batch_resize", "quality_rebind",
+            assert kinds == {"swap", "quality_rebind",
                              "engine_rebuild"}
             # ZERO unaudited substitutions: every substitution kind has
             # a guard verdict, and no guard mismatched on this clean

@@ -480,6 +480,85 @@ class TestElasticFleetLocal:
             assert fleet.retire_replica("nope") is False
             assert fleet.stats()["order_violations"] == 0
 
+    def test_rolling_rollout_zero_downtime(self):
+        """ISSUE 18: rolling_rollout replaces every live replica spawn-
+        before-retire while interactive traffic flows. Every replica id
+        changes, sessions keep streaming across their migration with
+        indices exactly 0..N-1 and content bit-exact (the interactive
+        SLO: no loss, no reorder, no outage window), and the summary
+        ``swap`` ledger event (cause=rollout) reports the fleet-level
+        substitution."""
+        fleet = self._fleet(replicas=2, autoscale=None, standby_warm=1,
+                            serve=serve_cfg(max_sessions=4, ledger=True))
+        n_frames = 24
+        deliveries: dict = {}
+        with fleet:
+            sids = [fleet.open_stream() for _ in range(2)]
+            before = set(fleet.stats()["replicas"])
+            stop = threading.Event()
+            errors: list = []
+
+            def pump():
+                try:
+                    j = 0
+                    while j < n_frames and not stop.is_set():
+                        for k, sid in enumerate(sids):
+                            fleet.submit(sid, tagged_frame(k, j))
+                        j += 1
+                        time.sleep(0.01)  # paced interactive cadence
+                except Exception as e:  # noqa: BLE001 — fail the test
+                    errors.append(e)
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            try:
+                report = fleet.rolling_rollout(reason="version bump")
+            finally:
+                t.join(timeout=60)
+                stop.set()
+            assert not errors, errors
+            deadline = time.time() + 60
+            while time.time() < deadline and not all(
+                    len(deliveries.get(s, [])) >= n_frames for s in sids):
+                for sid in sids:
+                    deliveries.setdefault(sid, []).extend(fleet.poll(sid))
+                time.sleep(0.005)
+            st = fleet.stats()
+            ledger_doc = fleet.ledger.document()
+
+        # Every incumbent was replaced; the fleet still holds 2 live.
+        assert report["aborted"] is None, report
+        assert len(report["swapped"]) == len(before) == 2, report
+        after = set(st["replicas"])
+        assert after.isdisjoint(before), (before, after)
+        assert len(after) == 2
+        # Interactive SLO across the rollout: all frames delivered, in
+        # order, bit-exact — the sessions only saw graceful migrations.
+        for k, sid in enumerate(sids):
+            got = deliveries[sid]
+            assert [d.index for d in got] == list(range(n_frames)), (
+                f"session {sid}: {[d.index for d in got]}")
+            for d in got:
+                np.testing.assert_array_equal(
+                    d.frame, 255 - tagged_frame(k, d.index))
+            assert st["sessions"][sid]["migrations"] >= 1
+        assert st["order_violations"] == 0
+        assert st["rollouts"] == 1
+        assert st["rollout_swaps"] == 2
+        # Ledger: the rollout summary rides the swap kind, and the per-
+        # replica spawn/retire events carry cause=rollout.
+        events = ledger_doc["events"]
+        swaps = [e for e in events if e["kind"] == "swap"
+                 and e.get("cause") == "rollout"]
+        assert len(swaps) == 1 and swaps[0]["swapped"] == 2, events
+        assert not swaps[0].get("aborted")
+        spawn_causes = [e.get("cause") for e in events
+                        if e["kind"] == "replica_spawn"]
+        retire_causes = [e.get("cause") for e in events
+                         if e["kind"] == "replica_retire"]
+        assert spawn_causes.count("rollout") == 2, events
+        assert retire_causes.count("rollout") == 2, events
+
 
 # ------------------------------------------- the bigger-replica flavor
 

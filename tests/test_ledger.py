@@ -224,10 +224,13 @@ class TestServeLedger:
 
     def test_chaos_mix_rebuild_resize_downshift(self, tmp_path):
         """ACCEPTANCE: one engine rebuild + one batch resize + one
-        quality downshift in a single run — every ledger event carries
-        cause + compile_ms + measured stall_ms > 0, the events appear
-        in the merged Perfetto trace on the dedicated lane, and the
-        flight dump carries ledger.json."""
+        quality downshift in a single run. The resize rides the
+        compile-aside hot swap (kind=swap, measured stall_ms ≈ 0, NO
+        stall window), the rebind's cutover cost is its measured
+        binding swing, and only the recovery rebuild — a real quiesce —
+        opens a stall window; events appear in the merged Perfetto
+        trace on the dedicated lane, and the flight dump carries
+        ledger.json."""
         from dvf_tpu.control import ControlConfig
 
         # control=True arms the quality-rebind submit path (decimation
@@ -243,23 +246,23 @@ class TestServeLedger:
             for j in range(3):  # healthy warm-up, pins the bucket
                 _drive_sync(fe, sid, frame_u8(0, j))
 
-            # -- leg 1: batch resize (PR 10's controller actuation) ----
+            # -- leg 1: batch resize (hot swap: compile-aside + atomic
+            # commit — the bucket never quiesces) ----------------------
             label = next(iter(fe.stats()["buckets"]))
             assert fe.request_batch_size(label, 1,
                                         reason="test resize")
-            _wait(lambda: _events(fe, ledger_mod.BATCH_RESIZE),
-                  msg="resize event never landed")
-            for j in range(3, 6):   # post-resize traffic closes the
-                _drive_sync(fe, sid, frame_u8(0, j))  # stall window
-            _wait(lambda: all(
-                "stall_ms" in e
-                for e in _events(fe, ledger_mod.BATCH_RESIZE)),
-                msg="resize stall window never closed")
-            resize = _events(fe, ledger_mod.BATCH_RESIZE)[0]
-            assert resize["cause"] == "resize"
-            assert resize["compile_ms"] is not None
-            assert resize["stall_ms"] > 0
-            assert resize["reason"] == "test resize"
+            _wait(lambda: _events(fe, ledger_mod.SWAP),
+                  msg="swap event never landed")
+            for j in range(3, 6):   # post-swap traffic (new program)
+                _drive_sync(fe, sid, frame_u8(0, j))
+            swap = _events(fe, ledger_mod.SWAP)[0]
+            assert swap["cause"] == "resize"
+            assert swap["compile_aside_ms"] > 0   # background compile
+            assert 0 <= swap["stall_ms"] < 1000.0  # measured commit
+            #   swing, recorded directly — NOT a dispatch-gap window
+            assert swap["reason"] == "test resize"
+            assert not swap.get("aborted")
+            assert fe.swaps >= 1
 
             # -- leg 2: forced engine rebuild (compute budget overflow)
             def dead_step(*a, **k):
@@ -281,30 +284,30 @@ class TestServeLedger:
             assert rebuild["compile_ms"] > 0
             assert rebuild["stall_ms"] > 0
 
-            # -- leg 3: quality downshift (PR 10's other actuation) ----
+            # -- leg 3: quality downshift (tier rebind WITHOUT a bucket
+            # pause: the target program was compiled aside, the cutover
+            # cost is the measured binding swing) -----------------------
             assert fe.request_session_quality(sid, 1,
                                               reason="test downshift")
             _wait(lambda: _events(fe, ledger_mod.QUALITY_REBIND),
                   msg="rebind event never landed")
             for j in range(12, 15):
                 _drive_sync(fe, sid, frame_u8(0, j))
-            _wait(lambda: all(
-                "stall_ms" in e
-                for e in _events(fe, ledger_mod.QUALITY_REBIND)),
-                msg="rebind stall window never closed")
             rebind = _events(fe, ledger_mod.QUALITY_REBIND)[0]
             assert rebind["cause"] == "quality"
             assert rebind["level"] == 1 and rebind["session"] == sid
-            assert rebind["stall_ms"] > 0
+            assert 0 <= rebind["stall_ms"] < 1000.0  # measured swing
             # Its program compile was ledgered under cause=quality.
             qcompiles = [e for e in _events(fe, ledger_mod.COMPILE)
                          if e["cause"] == "quality"]
             assert qcompiles and qcompiles[0]["compile_ms"] > 0
 
             # Every event in the ledger carries a cause or kind + the
-            # thread that ran it; the export walks clean.
+            # thread that ran it; the export walks clean. Only the
+            # recovery rebuild — a true quiesce — opened a stall
+            # window; the resize and rebind were stall-free.
             summary = fe.ledger.summary()
-            assert summary["stall_events_total"] >= 3
+            assert summary["stall_events_total"] >= 1
             assert not walk_export(summary), walk_export(summary)
 
             # -- merged Perfetto trace: dedicated reconfig lane --------
@@ -312,7 +315,7 @@ class TestServeLedger:
 
             doc = merge_tracer_snapshots([fe.tracer.snapshot()])
             names = {e.get("name") for e in doc["traceEvents"]}
-            assert "reconfig:batch_resize" in names
+            assert "reconfig:swap" in names
             assert "reconfig:engine_rebuild" in names
             assert "reconfig:quality_rebind" in names
             assert "reconfig_stall_closed" in names
@@ -326,7 +329,7 @@ class TestServeLedger:
             assert dump is not None
             led_doc = json.load(open(os.path.join(dump, "ledger.json")))
             kinds = {e["kind"] for e in led_doc["events"]}
-            assert {"batch_resize", "engine_rebuild",
+            assert {"swap", "engine_rebuild",
                     "quality_rebind"} <= kinds
 
             # -- trace-view renders the events inline ------------------
@@ -424,8 +427,8 @@ class TestServeLedger:
                 _drive_sync(fe, sid, frame_u8(0, j))
             label = next(iter(fe.stats()["buckets"]))
             assert fe.request_batch_size(label, 1, reason="mid-run")
-            _wait(lambda: _events(fe, ledger_mod.BATCH_RESIZE),
-                  msg="resize never landed")
+            _wait(lambda: _events(fe, ledger_mod.SWAP),
+                  msg="resize swap never landed")
             for j in range(4, 10):
                 _drive_sync(fe, sid, frame_u8(0, j))
             got = drain(fe, sid, 10)
@@ -434,7 +437,7 @@ class TestServeLedger:
                 assert d.lineage is not None
                 assert sum(d.lineage.components_ms().values()) == \
                     pytest.approx(d.latency_ms, abs=1e-6)
-            assert fe.ledger.summary()["by_kind"]["batch_resize"] >= 1
+            assert fe.ledger.summary()["by_kind"]["swap"] >= 1
             assert not walk_export(fe.stats())
 
 
