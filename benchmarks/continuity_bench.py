@@ -1,0 +1,494 @@
+"""Continuity chaos-soak bench: the session continuity plane's
+acceptance run (ISSUE 19).
+
+Two legs, one committed document (benchmarks/CONTINUITY_BENCH.json):
+
+- **chaos_soak**: a fleet under seeded wire + replica chaos
+  (``net_partition`` darkens poll hops, ``net_dup`` / ``net_reorder``
+  inject at-least-once delivery noise, and the ``replica`` site
+  SIGKILLs a serving replica mid-traffic). Every client is a
+  :class:`~dvf_tpu.resilience.continuity.ResumableStream`: dedup by
+  delivery index, resubmit exactly the source frames still missing
+  after a loss window. Acceptance: each session's ASSEMBLED stream is
+  byte-identical (blake2b over the frames in source order) and
+  gap-free against a fault-free run of the same harness, every
+  recorded fault carries a known taxonomy kind, and there are zero
+  hard session failures.
+
+- **frontdoor_recovery**: the snapshot plane armed
+  (``state_path`` + 50 ms cadence), traffic flowing, then ``kill -9``
+  on the FRONT DOOR (``FleetFrontend.crash()`` — replica children
+  abandoned alive on their reattach listeners). A restarted
+  ``FleetFrontend(resume_state=True)`` must re-adopt every still-live
+  replica and session from the snapshot, honor the pre-crash resume
+  token, keep the fleet index space monotone across the crash, and
+  ledger the resumes. The headline gate: reconnect-to-first-frame is
+  >= 10x faster than the cold re-open (adoption skips process spawn,
+  jax init, and program compile — the whole cold tax).
+
+CPU-runnable; ``quick=True`` (``--smoke``) shrinks the soak to local
+replicas and seconds for the CI leg (scripts/ci_tier1.sh) — the
+committed document comes from the full process-mode run. Absolute
+latencies on this steal-drifted host wobble; the RATIO and the
+zero/identical invariants are the claim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+OUT_PATH = os.path.join(_HERE, "CONTINUITY_BENCH.json")
+
+
+def _known_fault_kinds():
+    from dvf_tpu.resilience.faults import FaultKind
+
+    return {v for k, v in vars(FaultKind).items()
+            if k.isupper() and isinstance(v, str)}
+
+
+def _session_frames(seed: int, n: int, shape) -> list:
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, shape, dtype=np.uint8)
+            for _ in range(n)]
+
+
+def _digest(rs) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for d in rs.assembled():
+        h.update(np.ascontiguousarray(d.frame).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: chaos soak (ResumableStream clients, byte-identical acceptance)
+# ---------------------------------------------------------------------------
+
+
+def drive_sessions(fleet, frames_by_sid: dict, settle_s: float,
+                   pace_s: float = 0.002):
+    """Interleaved ResumableStream clients over one fleet: submit the
+    sessions' frames round-robin, then settle — poll, and resubmit
+    exactly the missing source frames (throttled) until every session
+    is complete or the deadline passes. Any exception on a live
+    session op is a HARD failure (the thing the continuity plane
+    exists to rule out); chaos-delayed or chaos-dropped deliveries are
+    not — they must heal through replay/resubmission."""
+    from dvf_tpu.resilience.continuity import ResumableStream
+
+    rs_by = {sid: ResumableStream() for sid in frames_by_sid}
+    hard = 0
+
+    def _submit(sid, n):
+        nonlocal hard
+        try:
+            idx = fleet.submit(sid, frames_by_sid[sid][n])
+            rs_by[sid].note_submit(idx, n)
+        except Exception as e:  # noqa: BLE001 — accounting, not control
+            hard += 1
+            print(f"[continuity_bench] hard submit failure {sid}#{n}: "
+                  f"{e!r}", file=sys.stderr)
+
+    def _poll(sid):
+        nonlocal hard
+        try:
+            rs_by[sid].absorb(fleet.poll(sid))
+        except Exception as e:  # noqa: BLE001
+            hard += 1
+            print(f"[continuity_bench] hard poll failure {sid}: {e!r}",
+                  file=sys.stderr)
+
+    n_frames = max(len(v) for v in frames_by_sid.values())
+    for n in range(n_frames):
+        for sid, frames in frames_by_sid.items():
+            if n < len(frames):
+                _submit(sid, n)
+        for sid in frames_by_sid:
+            _poll(sid)
+        time.sleep(pace_s)  # paced offer, not a queue-stuffing burst —
+        #   the pacing also keeps traffic IN FLIGHT across the health
+        #   monitor's replica-kill tick, so the SIGKILL lands mid-stream
+
+    def _done():
+        return all(rs_by[sid].delivered_count() >= len(frames)
+                   for sid, frames in frames_by_sid.items())
+
+    deadline = time.time() + settle_s
+    last_resubmit = 0.0
+    while time.time() < deadline and not _done():
+        progressed = False
+        for sid in frames_by_sid:
+            before = rs_by[sid].delivered_count()
+            _poll(sid)
+            progressed = progressed or rs_by[sid].delivered_count() > before
+        if progressed:
+            continue
+        now = time.time()
+        if now - last_resubmit >= 0.25:
+            # Idle and incomplete: resubmit EXACTLY the source frames
+            # still undelivered (lost in a kill/partition window) —
+            # the replay-window dedup makes the retry safe even when
+            # the original delivery is merely late, not lost.
+            last_resubmit = now
+            for sid, frames in frames_by_sid.items():
+                for n in rs_by[sid].missing(len(frames)):
+                    _submit(sid, n)
+        time.sleep(0.01)
+    return rs_by, hard
+
+
+def run_soak_leg(mode: str, sessions: int, frames_per_session: int,
+                 shape, chaos_spec, chaos_seed: int, settle_s: float,
+                 replicas: int = 2, health_poll_s: float = 0.25,
+                 pace_s: float = 0.002):
+    """One soak run (reference when ``chaos_spec`` is None); returns
+    per-session digests + fault/continuity accounting."""
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.resilience.chaos import FaultPlan
+    from dvf_tpu.serve import ServeConfig
+
+    chaos = (FaultPlan.parse(chaos_spec, seed=chaos_seed)
+             if chaos_spec else None)
+    cfg = FleetConfig(
+        replicas=replicas, mode=mode,
+        serve=ServeConfig(batch_size=2, queue_size=512, slo_ms=120_000.0,
+                          max_sessions=max(8, 2 * sessions),
+                          telemetry_sample_s=0.0),
+        filter_spec=("invert", {}),
+        health_poll_s=health_poll_s,
+        chaos=chaos, chaos_seed=chaos_seed,
+    )
+    frames_by_sid = {}
+    t0 = time.perf_counter()
+    fleet = FleetFrontend(config=cfg)
+    try:
+        fleet.start()
+        for i in range(sessions):
+            sid = fleet.open_stream(session_id=f"soak-{i}",
+                                    frame_shape=shape)
+            # Frame content keyed by (session, frame) seed only — the
+            # reference and chaos runs stream IDENTICAL pixels, so the
+            # assembled digests are comparable byte-for-byte.
+            frames_by_sid[sid] = _session_frames(
+                1_000 + 7 * i, frames_per_session, shape)
+        rs_by, hard = drive_sessions(fleet, frames_by_sid, settle_s,
+                                     pace_s=pace_s)
+        st = fleet.stats()
+        known = _known_fault_kinds()
+        by_kind = (st.get("faults") or {}).get("by_kind", {})
+        unclassified = sum(v for k, v in by_kind.items()
+                           if k not in known or k == "internal")
+        out = {
+            "mode": mode,
+            "replicas": replicas,
+            "sessions": sessions,
+            "frames_per_session": frames_per_session,
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "chaos_spec": chaos_spec,
+            "chaos_seed": chaos_seed,
+            "chaos_fired": (chaos.summary()["fired"] if chaos else {}),
+            "hard_failures_total": hard,
+            "order_violations_total": int(st.get("order_violations", 0)),
+            "faults_by_kind": by_kind,
+            "unclassified_faults_total": int(unclassified),
+            "continuity": st.get("continuity", {}),
+            "sessions_detail": {},
+        }
+        for sid, rs in rs_by.items():
+            nf = len(frames_by_sid[sid])
+            out["sessions_detail"][sid] = {
+                "delivered": rs.delivered_count(),
+                "expected": nf,
+                "gaps": rs.missing(nf),
+                "digest": _digest(rs),
+                "submitted": rs.submitted,
+                "resubmitted": rs.resubmitted,
+                "dup_drops": rs.dup_drops,
+            }
+        return out
+    finally:
+        fleet.stop()
+
+
+def leg_chaos_soak(quick: bool) -> dict:
+    """Fault-free reference run, then the chaos run, same harness —
+    the acceptance diff is digest-for-digest."""
+    if quick:
+        # The CI smoke: local replicas (replica chaos still kills and
+        # migrates, just without a process to SIGKILL), small frames,
+        # a few seconds end to end. The kill rule's event index is
+        # small (the replica site counts health-monitor events, one
+        # per replica per 0.2 s tick) so it lands INSIDE the paced
+        # traffic window.
+        mode, sessions, nf, shape = "local", 2, 24, (32, 32, 3)
+        spec = ("net_partition:every=6,net_dup:every=5,"
+                "net_reorder:every=7,replica:at=2:count=1")
+        settle, pace, poll_s = 15.0, 0.02, 0.2
+    else:
+        # The committed run: process replicas — the replica site's kill
+        # is a real SIGKILL on a child pid, and its respawn pays the
+        # full process + compile tax inside the settle window. ~4 s of
+        # paced traffic; the kill fires ~1 s in.
+        mode, sessions, nf, shape = "process", 3, 80, (48, 48, 3)
+        spec = ("net_partition:every=9,net_dup:every=6,"
+                "net_reorder:every=8,replica:at=6:count=1")
+        settle, pace, poll_s = 60.0, 0.05, 0.25
+    reference = run_soak_leg(mode, sessions, nf, shape, None, 0,
+                             settle_s=settle, health_poll_s=poll_s,
+                             pace_s=pace)
+    chaos = run_soak_leg(mode, sessions, nf, shape, spec, 7,
+                         settle_s=settle, health_poll_s=poll_s,
+                         pace_s=pace)
+    per_session = {}
+    bit_identical = True
+    gap_free = True
+    for sid, row in chaos["sessions_detail"].items():
+        ref = reference["sessions_detail"].get(sid, {})
+        same = (row["digest"] == ref.get("digest")
+                and row["delivered"] == row["expected"])
+        no_gap = not row["gaps"]
+        bit_identical = bit_identical and same
+        gap_free = gap_free and no_gap
+        per_session[sid] = {"bit_identical": same, "gap_free": no_gap}
+    return {
+        "reference": reference,
+        "chaos": chaos,
+        "acceptance": {
+            "bit_identical": bit_identical,
+            "gap_free": gap_free,
+            "per_session": per_session,
+            "hard_failures_total": chaos["hard_failures_total"],
+            "unclassified_faults_total":
+                chaos["unclassified_faults_total"],
+            "order_violations_total": chaos["order_violations_total"],
+            "faults_injected": chaos["chaos_fired"],
+            # Guard against a vacuous pass: every chaos family in the
+            # spec must have actually FIRED — a kill rule whose event
+            # index lands past the traffic window proves nothing.
+            "all_chaos_sites_fired": all(
+                any(k.startswith(site + ":")
+                    for k in chaos["chaos_fired"])
+                for site in ("net_partition", "net_dup", "net_reorder",
+                             "replica")),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: front-door kill -9 + --resume-state recovery
+# ---------------------------------------------------------------------------
+
+
+def _first_frame_s(fleet, sid, frame, t0, deadline_s=120.0):
+    """Submit one frame, poll to first delivery; returns (elapsed since
+    ``t0``, delivery index) — the -to-first-frame clock both the cold
+    and resumed paths are measured on."""
+    fleet.submit(sid, frame)
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        got = fleet.poll(sid)
+        if got:
+            return time.perf_counter() - t0, got[0].index
+        time.sleep(0.002)
+    raise TimeoutError("no delivery within the first-frame deadline")
+
+
+def _reap_abandoned(fleet) -> None:
+    """Best-effort cleanup if the resume half fails: crash() leaves
+    worker children alive on purpose, so a bench error must not leak
+    them past the run."""
+    for r in list(getattr(fleet, "_replicas", {}).values()):
+        try:
+            r.kill()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+def leg_frontdoor_recovery(quick: bool) -> dict:
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.serve import ServeConfig
+
+    shape = (32, 32, 3) if quick else (48, 48, 3)
+    n_warm = 4 if quick else 12
+    state_dir = tempfile.mkdtemp(prefix="dvf-continuity-bench-")
+    cfg = FleetConfig(
+        replicas=2, mode="process",
+        serve=ServeConfig(batch_size=2, queue_size=256, slo_ms=120_000.0,
+                          max_sessions=8, telemetry_sample_s=0.0),
+        filter_spec=("invert", {}),
+        health_poll_s=0.25,
+        state_path=os.path.join(state_dir, "fleet-state.json"),
+        snapshot_interval_s=0.05,
+        reattach_grace_s=30.0,
+    )
+    frames = _session_frames(42, n_warm + 2, shape)
+    f1 = f2 = None
+    try:
+        # -- cold open: process spawn + jax init + compile + 1st frame.
+        t0 = time.perf_counter()
+        f1 = FleetFrontend(config=cfg).start()
+        sid = f1.open_stream(session_id="recover-0", frame_shape=shape)
+        cold_s, first_idx = _first_frame_s(f1, sid, frames[0], t0)
+
+        # -- warm traffic so the crash lands mid-stream, then the
+        # pre-crash credentials/watermarks the resumed door must honor.
+        pre_max_idx = first_idx
+        for n in range(1, n_warm):
+            f1.submit(sid, frames[n])
+        deadline = time.time() + 60.0
+        seen = 1
+        while seen < n_warm and time.time() < deadline:
+            got = f1.poll(sid)
+            for d in got:
+                pre_max_idx = max(pre_max_idx, d.index)
+            seen += len(got)
+            if not got:
+                time.sleep(0.005)
+        token = f1.resume_token(sid)
+        time.sleep(max(0.3, 6 * cfg.snapshot_interval_s))  # quiesce: the
+        #   snapshot thread has flushed the final pre-crash registry
+        f1.crash()
+
+        # -- kill -9 recovery: adopt still-live workers, honor the old
+        # token, continue the same index space.
+        cfg2 = dataclasses.replace(cfg, resume_state=True)
+        t0 = time.perf_counter()
+        f2 = FleetFrontend(config=cfg2).start()
+        token_ok = True
+        try:
+            replayed = f2.resume_stream(sid, token, from_index=0)
+        except Exception:  # noqa: BLE001 — a rejected pre-crash token
+            token_ok = False  # IS the failure mode under test
+            replayed = []
+        resume_s, resumed_idx = _first_frame_s(f2, sid, frames[n_warm],
+                                               t0)
+        post_idx = [d.index for d in replayed] + [resumed_idx]
+        got2 = f2.poll(sid)
+        post_idx += [d.index for d in got2]
+        cont = f2.continuity.summary()
+        led = (f2.ledger.summary() if f2.ledger is not None else {})
+        resume_events = int((led.get("by_kind") or {}).get("resume", 0))
+        ratio = cold_s / resume_s if resume_s > 0 else None
+        out = {
+            "cold_open_to_first_frame_s": round(cold_s, 4),
+            "resume_to_first_frame_s": round(resume_s, 4),
+            "resume_speedup_ratio": (round(ratio, 2)
+                                     if ratio is not None else None),
+            "target_resume_speedup_ratio": 10.0,
+            "adopted_replicas": int(cont.get("adopted_replicas", 0)),
+            "adopted_sessions": int(cont.get("adopted_sessions", 0)),
+            "sessions_pre_crash": 1,
+            "replayed_on_resume": len(replayed),
+            "pre_crash_max_index": int(pre_max_idx),
+            "post_resume_indices": [int(i) for i in sorted(post_idx)],
+            "resume_ledger_events": resume_events,
+            "acceptance": {
+                "resume_speedup_ge_10x": bool(ratio and ratio >= 10.0),
+                "zero_session_loss":
+                    int(cont.get("adopted_sessions", 0)) == 1,
+                "replicas_readopted":
+                    int(cont.get("adopted_replicas", 0)) == 2,
+                "token_survives_restart": token_ok,
+                "indices_monotone_across_crash": bool(
+                    post_idx and min(post_idx) > pre_max_idx),
+                "resume_events_ledgered": resume_events >= 1,
+            },
+        }
+        f2.stop()
+        f2 = None
+        return out
+    finally:
+        if f2 is not None:
+            try:
+                f2.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        elif f1 is not None:
+            # f2 never came up (or failed): the crashed door's children
+            # may still be alive — reap them.
+            _reap_abandoned(f1)
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick: bool = False) -> dict:
+    import jax
+
+    soak = leg_chaos_soak(quick)
+    recovery = leg_frontdoor_recovery(quick)
+    sa, ra = soak["acceptance"], recovery["acceptance"]
+    return {
+        "schema": "dvf.continuity_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "quick": bool(quick),
+        "chaos_soak": soak,
+        "frontdoor_recovery": recovery,
+        "acceptance": {
+            # The gates scripts/ci_tier1.sh + benchmarks/sentinel.py pin.
+            "soak_bit_identical": sa["bit_identical"],
+            "soak_gap_free": sa["gap_free"],
+            "soak_hard_failures_total": sa["hard_failures_total"],
+            "soak_unclassified_faults_total":
+                sa["unclassified_faults_total"],
+            "soak_all_chaos_sites_fired": sa["all_chaos_sites_fired"],
+            "resume_speedup_ratio": recovery["resume_speedup_ratio"],
+            "target_resume_speedup_ratio":
+                recovery["target_resume_speedup_ratio"],
+            "recovery_zero_session_loss": ra["zero_session_loss"],
+            "recovery_indices_monotone":
+                ra["indices_monotone_across_crash"],
+            "recovery_resume_events_ledgered":
+                ra["resume_events_ledgered"],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = ("--quick" in argv) or ("--smoke" in argv)
+    doc = run(quick=quick)
+    acc = doc["acceptance"]
+    ok = (acc["soak_bit_identical"] and acc["soak_gap_free"]
+          and acc["soak_hard_failures_total"] == 0
+          and acc["soak_unclassified_faults_total"] == 0
+          and acc["soak_all_chaos_sites_fired"]
+          and acc["recovery_zero_session_loss"]
+          and acc["recovery_indices_monotone"]
+          and acc["recovery_resume_events_ledgered"]
+          and (acc["resume_speedup_ratio"] or 0)
+          >= acc["target_resume_speedup_ratio"])
+    if quick and "--write" not in argv:
+        # The CI smoke gates but does not overwrite the committed
+        # (full-run) document.
+        print(json.dumps(doc["acceptance"], indent=2))
+    else:
+        with open(OUT_PATH, "w") as f:
+            json.dump(doc, f, indent=2, default=float)
+            f.write("\n")
+        print(json.dumps(doc["acceptance"], indent=2))
+        print(f"wrote {OUT_PATH}", file=sys.stderr)
+    print("continuity_bench: " + ("clean" if ok else "FAILED"),
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

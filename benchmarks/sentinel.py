@@ -1,7 +1,8 @@
 """Continuous perf-regression sentinel: nonzero exit = perf regressed.
 
 The repo's perf claims live in committed bench JSON (ADMIT / ATTR /
-ELASTIC / SOAK / LEDGER / the anchored head-to-head). Nothing re-reads
+ELASTIC / SOAK / LEDGER / CONTINUITY / the anchored head-to-head).
+Nothing re-reads
 them, so a change can quietly regress the very numbers the ROADMAP
 cites. This sentinel is the CI gate that re-reads — and re-measures:
 
@@ -160,6 +161,34 @@ def baseline_gates():
         gate("SOAK_BENCH", "controlled_hard_failures",
              acc.get("controlled_hard_failures_total") == 0,
              f"{acc.get('controlled_hard_failures_total')} == 0")
+    doc = _load("CONTINUITY_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("resume_speedup_ratio"),
+                acc.get("target_resume_speedup_ratio", 10.0))
+        gate("CONTINUITY_BENCH", "resume_speedup_ratio",
+             m is not None and m >= t, f"{m} >= {t}")
+        gate("CONTINUITY_BENCH", "soak_bit_identical_and_gap_free",
+             bool(acc.get("soak_bit_identical"))
+             and bool(acc.get("soak_gap_free")),
+             f"bit_identical {acc.get('soak_bit_identical')}, "
+             f"gap_free {acc.get('soak_gap_free')}")
+        gate("CONTINUITY_BENCH", "soak_hard_failures",
+             acc.get("soak_hard_failures_total") == 0,
+             f"{acc.get('soak_hard_failures_total')} == 0")
+        gate("CONTINUITY_BENCH", "soak_faults_classified",
+             acc.get("soak_unclassified_faults_total") == 0
+             and bool(acc.get("soak_all_chaos_sites_fired")),
+             f"unclassified {acc.get('soak_unclassified_faults_total')} "
+             f"== 0, all sites fired "
+             f"{acc.get('soak_all_chaos_sites_fired')}")
+        gate("CONTINUITY_BENCH", "recovery_zero_session_loss",
+             bool(acc.get("recovery_zero_session_loss"))
+             and bool(acc.get("recovery_indices_monotone"))
+             and bool(acc.get("recovery_resume_events_ledgered")),
+             f"loss-free {acc.get('recovery_zero_session_loss')}, "
+             f"monotone {acc.get('recovery_indices_monotone')}, "
+             f"ledgered {acc.get('recovery_resume_events_ledgered')}")
     doc = _load("SWAP_BENCH.json")
     if doc is not None:
         acc = doc.get("acceptance", {})

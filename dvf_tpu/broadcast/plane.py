@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Sequence, Union
 from dvf_tpu.broadcast.abr import BroadcastAbrConfig, SubscriberAbr
 from dvf_tpu.broadcast.channel import Channel, Subscription, Tier
 from dvf_tpu.broadcast.relay import RelayNode
+from dvf_tpu.resilience.continuity import ContinuityStats, LivenessMonitor
 
 _LIVE_GATES: "weakref.WeakSet" = weakref.WeakSet()
 
@@ -331,10 +332,18 @@ class ZmqBroadcastGate:
     frames at the gate (counted), and one that stops reading entirely
     is evicted by the lane like any local subscriber — remote watchers
     get the exact isolation contract local ones do. ``{"op": "bye"}``
-    detaches."""
+    detaches.
+
+    Liveness (resilience.continuity): ``{"op": "hb"}`` is answered with
+    a pong, and EVERY control message beats the sender's liveness
+    clock. With ``liveness_timeout_s > 0`` the serve loop reaps
+    subscribers silent beyond the timeout — a watcher that vanished
+    without a bye (crash, partition) stops pinning a lane slot and is
+    counted as a partition instead of lingering forever. 0 keeps the
+    legacy posture (eviction by send-pressure only)."""
 
     def __init__(self, plane: BroadcastPlane, endpoint: str,
-                 name: str = "gate"):
+                 name: str = "gate", liveness_timeout_s: float = 0.0):
         import zmq
 
         self._zmq = zmq
@@ -343,6 +352,9 @@ class ZmqBroadcastGate:
         self.closed = False
         self.send_drops = 0
         self.hellos = 0
+        self.continuity = ContinuityStats()
+        self._liveness = (LivenessMonitor(liveness_timeout_s)
+                          if liveness_timeout_s > 0 else None)
         self._subs: Dict[bytes, Subscription] = {}
         self._lock = threading.Lock()
         self._ctx = zmq.Context.instance()
@@ -386,12 +398,25 @@ class ZmqBroadcastGate:
                 parts = self._sock.recv_multipart()
                 ident, body = parts[0], parts[-1]
                 try:
+                    if self._liveness is not None:
+                        self._liveness.beat(ident)
                     msg = json.loads(body)
                     if msg.get("op") == "hello":
                         self._handle_hello(ident, msg)
+                    elif msg.get("op") == "hb":
+                        # Heartbeat pong: the quiet-link liveness beat
+                        # (data frames also count — the client only
+                        # needs hb when it is not being shipped frames).
+                        self.continuity.inc("heartbeats")
+                        self._sock.send_multipart(
+                            [ident, json.dumps(
+                                {"ok": True, "op": "hb"}).encode()],
+                            flags=zmq.NOBLOCK)
                     elif msg.get("op") == "bye":
                         with self._lock:
                             sub = self._subs.pop(ident, None)
+                        if self._liveness is not None:
+                            self._liveness.forget(ident)
                         if sub is not None:
                             self.plane.unsubscribe(sub)
                 except Exception as e:  # noqa: BLE001 — one bad peer
@@ -420,6 +445,20 @@ class ZmqBroadcastGate:
                         shipped += 1
                     except zmq.ZMQError:
                         self.send_drops += 1
+            if self._liveness is not None:
+                # Reap watchers silent beyond the liveness timeout: a
+                # peer that crashed (or partitioned) without a bye must
+                # not pin its lane slot until send-pressure eviction
+                # happens to notice. Clients of an armed gate beat with
+                # {"op": "hb"} — receiving frames is not proof the peer
+                # still exists (ROUTER sends never block on a ghost).
+                for ident in self._liveness.dead():
+                    self._liveness.forget(ident)
+                    with self._lock:
+                        sub = self._subs.pop(ident, None)
+                    if sub is not None:
+                        self.plane.unsubscribe(sub)
+                        self.continuity.inc("partitions")
             if not shipped:
                 self._stop.wait(0.005)
 
@@ -428,7 +467,8 @@ class ZmqBroadcastGate:
             n = len(self._subs)
         return {"endpoint": self.endpoint, "remote_subscribers": n,
                 "hellos_total": self.hellos,
-                "send_drops_total": self.send_drops}
+                "send_drops_total": self.send_drops,
+                "continuity": self.continuity.summary()}
 
     def close(self, timeout: float = 5.0) -> None:
         if self.closed:

@@ -579,12 +579,31 @@ def cmd_subscribe(args) -> int:
         t0 = time.time()
         got = frames_bytes = keyframes = integrity_errors = 0
         deadline = t0 + args.timeout
+        # Liveness (continuity plane): heartbeat the gate on quiet links
+        # — the pong (or any frame) proves the gate is alive, and a
+        # gate armed with --liveness-timeout needs our beats to keep us
+        # subscribed. A gate that stops answering for idle_timeout is
+        # DEAD, and that is exit 3, not a zero-frame success hang.
+        idle_timeout = max(0.1, args.idle_timeout)
+        hb_interval = max(0.25, min(2.0, idle_timeout / 4.0))
+        last_rx = last_hb = time.time()
         while got < args.frames and time.time() < deadline:
+            now = time.time()
+            if now - last_hb >= hb_interval:
+                last_hb = now
+                sock.send(json.dumps({"op": "hb"}).encode())
             if not sock.poll(200):
+                if time.time() - last_rx > idle_timeout:
+                    print(f"error: gate {args.endpoint} silent for "
+                          f"{idle_timeout:g}s (no frames, no heartbeat "
+                          f"reply): partitioned or dead",
+                          file=sys.stderr)
+                    return 3
                 continue
             parts = sock.recv_multipart()
+            last_rx = time.time()
             if len(parts) < 2:
-                continue
+                continue   # hb pong / control noise: liveness, not data
             head, payload = json.loads(parts[0]), parts[1]
             frames_bytes += len(payload)
             if meta.get("audit") and is_stamped(payload):
@@ -971,6 +990,9 @@ def cmd_fleet(args) -> int:
         flight_dir=args.flight_dir,
         audit_interval_s=args.audit_interval,
         audit_quarantine=args.audit_quarantine,
+        state_path=args.state_path,
+        resume_state=args.resume_state,
+        snapshot_interval_s=args.snapshot_interval,
         telemetry_sample_s=(1.0 if args.metrics_port is not None else 0.0),
         precompile=_load_manifest(args.precompile),
         # Process-mode replicas share the persistent compilation cache
@@ -1187,6 +1209,28 @@ def cmd_worker(args) -> int:
         flight = FlightRecorder(args.flight_dir, label="worker",
                                 trace_fn=lambda: [worker.tracer.snapshot()],
                                 stats_fn=worker.stats, ring=ring)
+    # SIGTERM/SIGINT → graceful stop: the run loop exits at the next
+    # poll tick, completed encodes flush through drain_egress(), and the
+    # final stats land on stdout — a supervisor's `kill` gets the same
+    # clean accounting as a test's max_frames exit. A second signal
+    # aborts (the loop may be wedged mid-compile). Handlers go in
+    # BEFORE the serving banner: the banner is the readiness signal a
+    # supervisor keys its kill on, so it must never precede them.
+    import signal
+
+    def _graceful(signum, frame):
+        if worker._stop.is_set():
+            raise KeyboardInterrupt
+        print(f"\n[worker] signal {signum}: draining…",
+              file=sys.stderr, flush=True)
+        worker.stop()
+
+    old = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            old[sig] = signal.signal(sig, _graceful)
+        except ValueError:
+            pass  # not the main thread (embedded use)
     print(
         f"TPU worker serving {filt.name} on "
         f"tcp://{args.host}:{args.distribute_port} → :{args.collect_port}",
@@ -1194,6 +1238,11 @@ def cmd_worker(args) -> int:
     )
     try:
         worker.run()
+        # Ship every encode the codec pool already finished before the
+        # stats line claims the totals (satellite: no frames stranded in
+        # the egress plane on SIGTERM).
+        worker.drain_egress()
+        print(json.dumps(worker.stats(), default=float))
     except KeyboardInterrupt:
         pass
     except Exception as e:  # noqa: BLE001 — dump, then re-raise
@@ -1201,6 +1250,8 @@ def cmd_worker(args) -> int:
             flight.trigger(f"worker failed: {e!r}")
         raise
     finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
         if exporter is not None:
             exporter.stop()
         if ring is not None:
@@ -1984,6 +2035,12 @@ def main(argv=None) -> int:
                     help="gate-side drop-oldest queue depth for this "
                          "watcher (small = freshest, large = fewest "
                          "drops)")
+    sb.add_argument("--idle-timeout", type=float, default=5.0,
+                    help="declare the gate dead (exit 3) after this "
+                         "many seconds with no frames AND no heartbeat "
+                         "reply — a mid-stream gate death exits "
+                         "promptly instead of running out the --timeout "
+                         "deadline")
 
     fl = sub.add_parser(
         "fleet", parents=[plat, ing, res, obsp, sig],
@@ -2097,6 +2154,20 @@ def main(argv=None) -> int:
                          "the first --precompile manifest signature; "
                          "the elasticity controller chooses the axis "
                          "from measured --profile-dir stage costs")
+    fl.add_argument("--state-path", default=None, metavar="FILE",
+                    help="arm the continuity snapshot plane: the front "
+                         "door periodically writes a crash-consistent "
+                         "snapshot (session registry, placement map, "
+                         "replica incarnations) here, and orphaned "
+                         "workers wait for re-adoption instead of dying "
+                         "with a crashed front door")
+    fl.add_argument("--resume-state", action="store_true",
+                    help="on start, re-adopt still-live replicas and "
+                         "their sessions from --state-path (the recovery "
+                         "half: a front door killed -9 mid-traffic comes "
+                         "back without losing a session)")
+    fl.add_argument("--snapshot-interval", type=float, default=1.0,
+                    metavar="S", help="continuity snapshot cadence")
 
     cp = sub.add_parser(
         "camera",  # host-only (no jax): the --platform flag would be a no-op

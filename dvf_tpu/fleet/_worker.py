@@ -36,6 +36,45 @@ def _serve_config(fields: dict, chaos_spec, chaos_seed: int,
     return ServeConfig(**fields, chaos=chaos, replica_label=replica_id)
 
 
+def _await_adoption(reattach, grace_s: float, replica_id: str):
+    """Parent lost mid-serve: wait up to ``grace_s`` on the reattach
+    listener for a restarted front door to adopt this worker
+    (continuity plane, ISSUE 19). The worker keeps its frontend — and
+    every open session's queued deliveries — warm for the whole grace
+    window. Returns the adopted RPC socket, or None (grace unarmed /
+    expired / bad handshake): the caller shuts down."""
+    if reattach is None or grace_s <= 0:
+        return None
+    import socket
+
+    from dvf_tpu.fleet.replica import recv_msg, send_msg
+
+    reattach.settimeout(grace_s)
+    try:
+        sock, _ = reattach.accept()
+    except OSError:   # timeout included: orphaned for good
+        return None
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(10)
+        hello = recv_msg(sock)
+        if not (isinstance(hello, tuple) and len(hello) >= 2
+                and hello[0] == "adopt" and hello[1] == replica_id):
+            send_msg(sock, ("err", "ServeError",
+                            f"adoption refused: {hello!r}"))
+            sock.close()
+            return None
+        send_msg(sock, ("adopted", os.getpid()))
+        sock.settimeout(None)
+        return sock
+    except Exception:  # noqa: BLE001 — a bad suitor, not a shutdown
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
@@ -50,6 +89,7 @@ def main(argv=None) -> int:
     sock = socket.create_connection((args.host, args.port), timeout=30)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     frontend = None
+    reattach = None
     try:
         send_msg(sock, ("hello", os.getpid()))
         op = recv_msg(sock)
@@ -83,14 +123,39 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001 — startup failure → parent
             send_msg(sock, ("err", type(e).__name__, str(e)))
             return 2
-        send_msg(sock, ("ready", os.getpid()))
+        # Continuity plane: with a reattach grace armed (the fleet sets
+        # it when its snapshot plane is on), bind our OWN listener so a
+        # restarted front door can adopt this worker instead of losing
+        # every session with the old one. The port rides the ready
+        # tuple's trailing extras dict (older parents only read
+        # ready[0]).
+        grace_s = float(cfg.get("reattach_grace_s") or 0.0)
+        replica_id = cfg.get("replica_id", args.replica_id)
+        extras = {}
+        if grace_s > 0:
+            reattach = socket.socket()
+            reattach.bind((args.host, 0))
+            reattach.listen(1)
+            extras["reattach_port"] = reattach.getsockname()[1]
+            # Parent loss must surface as EOF/RST (the kernel closes a
+            # killed front door's sockets promptly), never as an idle-
+            # timeout false positive that abandons a live parent.
+            sock.settimeout(None)
+        send_msg(sock, ("ready", os.getpid(), extras))
         submit_errors = 0
 
         while True:
             try:
                 op = recv_msg(sock)
             except (ConnectionError, OSError):
-                break  # parent went away: shut down with it
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = _await_adoption(reattach, grace_s, replica_id)
+                if sock is None:
+                    break  # parent went away for good: shut down with it
+                continue
             kind = op[0]
             if kind == "submit1":
                 # One-way hot path: NO reply (the fleet index is parent-
@@ -188,10 +253,12 @@ def main(argv=None) -> int:
                 frontend.stop(timeout=5.0)
             except Exception:  # noqa: BLE001 — exit-path best effort
                 pass
-        try:
-            sock.close()
-        except OSError:
-            pass
+        for s in (sock, reattach):
+            try:
+                if s is not None:
+                    s.close()
+            except OSError:
+                pass
     return 0
 
 

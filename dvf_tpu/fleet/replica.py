@@ -63,6 +63,21 @@ class ReplicaLostError(ServeError):
     fleet tier's signal to drain, migrate, and restart."""
 
 
+def pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe — what an ADOPTED replica (continuity
+    plane: the front door restarted, the worker didn't) has instead of
+    a ``Popen`` to poll."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True      # exists, just not ours to signal
+    except OSError:
+        return False
+    return True
+
+
 # -- wire protocol (ProcessReplica <-> fleet._worker) --------------------
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
@@ -314,17 +329,29 @@ class ProcessReplica(ReplicaHandle):
         env: Optional[Dict[str, str]] = None,
         startup_timeout_s: float = 120.0,
         rpc_timeout_s: float = 60.0,
+        rpc_op_timeout_s: float = 5.0,
+        rpc_lock_timeout_s: float = 5.0,
     ):
         super().__init__(replica_id)
         self._wire_config = dict(wire_config, replica_id=replica_id)
         self._env = dict(env) if env is not None else None
         self._startup_timeout_s = startup_timeout_s
         self._rpc_timeout_s = rpc_timeout_s
+        # Bounded control-plane RPCs (health, begin_drain, stats pulls):
+        # previously hardcoded 5.0s constants — promoted to knobs
+        # (FleetConfig.rpc_op_timeout_s / rpc_lock_timeout_s) so slow
+        # deployments can widen the monitor's patience, and exported in
+        # the fleet's stats()["fleet"] provenance.
+        self._rpc_op_timeout_s = rpc_op_timeout_s
+        self._rpc_lock_timeout_s = rpc_lock_timeout_s
         self._proc: Optional[subprocess.Popen] = None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._lost = False
         self.pid: Optional[int] = None
+        self.reattach_port: Optional[int] = None  # the worker's own
+        #   listener for front-door crash recovery (continuity plane);
+        #   None when the worker predates it or the grace is unarmed
 
     def _child_env(self) -> Dict[str, str]:
         env = dict(os.environ)
@@ -392,18 +419,71 @@ class ProcessReplica(ReplicaHandle):
         if not (isinstance(ready, tuple) and ready[0] == "ready"):
             raise ReplicaLostError(
                 f"replica {self.id}: worker failed to start: {ready!r}")
+        # Trailing extras dict since the continuity plane (the worker's
+        # reattach listener port); a 2-tuple from an older worker still
+        # reads as ready, just never adoptable.
+        extras = ready[2] if len(ready) > 2 and isinstance(ready[2], dict) \
+            else {}
+        self.reattach_port = extras.get("reattach_port")
         self._sock.settimeout(self._rpc_timeout_s)
         self._lost = False
         self.state = HEALTHY
         self.started_at = time.monotonic()
         return self
 
+    def adopt(self, pid: int, reattach_port: int) -> "ProcessReplica":
+        """Re-attach to a still-running worker left behind by a crashed
+        front door (continuity plane): dial the worker's own reattach
+        listener instead of spawning. No ``Popen`` exists for an
+        adopted child — liveness degrades to a signal-0 probe and stop
+        falls back to a pid wait + SIGKILL."""
+        sock = socket.create_connection(
+            ("127.0.0.1", int(reattach_port)),
+            timeout=min(self._startup_timeout_s, 10.0))
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(min(self._startup_timeout_s, 10.0))
+            send_msg(sock, ("adopt", self.id))
+            reply = recv_msg(sock)
+            if not (isinstance(reply, tuple) and reply[0] == "adopted"):
+                raise ReplicaLostError(
+                    f"replica {self.id}: adoption refused: {reply!r}")
+        except Exception:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self.pid = int(pid)
+        self.reattach_port = int(reattach_port)
+        self._proc = None
+        self._sock = sock
+        self._sock.settimeout(self._rpc_timeout_s)
+        self._lost = False
+        self.state = HEALTHY
+        self.started_at = time.monotonic()
+        return self
+
+    def abandon(self) -> None:
+        """Front-door crash simulation (FleetFrontend.crash): drop the
+        RPC channel and FORGET the child without a stop op — the worker
+        sees a parent loss and waits on its reattach listener for the
+        next front-door incarnation to adopt it."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._proc = None
+        self.state = DEAD
+
     def stop(self, timeout: float = 10.0) -> None:
         self.state = DEAD
         sock, self._sock = self._sock, None
         if sock is not None:
             try:
-                sock.settimeout(min(timeout, 5.0))
+                sock.settimeout(min(timeout, self._rpc_op_timeout_s))
                 send_msg(sock, ("stop",))
                 recv_msg(sock)
             except Exception:  # noqa: BLE001 — it may already be dead
@@ -419,6 +499,30 @@ class ProcessReplica(ReplicaHandle):
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5.0)
+        elif self.pid is not None:
+            # Adopted child: no Popen to reap — wait for the pid to
+            # exit on its own stop, then escalate to SIGKILL. When the
+            # worker is OUR child (in-process crash simulation: the
+            # same process abandoned and re-adopted it), it zombifies
+            # until reaped, and a zombie still answers signal 0 — so
+            # try waitpid first and fall back to the signal-0 probe for
+            # true cross-process adoption (init reaps that one).
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    done, _ = os.waitpid(self.pid, os.WNOHANG)
+                    if done == self.pid:
+                        return
+                except ChildProcessError:
+                    if not pid_alive(self.pid):
+                        return
+                except OSError:
+                    return
+                time.sleep(0.05)
+            try:
+                os.kill(self.pid, 9)
+            except OSError:
+                pass
 
     def restart(self) -> None:
         self.stop(timeout=5.0)
@@ -436,6 +540,11 @@ class ProcessReplica(ReplicaHandle):
                 self._proc.kill()
             except OSError:
                 pass
+        elif self.pid is not None:   # adopted child: kill by pid
+            try:
+                os.kill(self.pid, 9)
+            except OSError:
+                pass
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -444,8 +553,14 @@ class ProcessReplica(ReplicaHandle):
             self._sock = None
 
     def alive(self) -> bool:
-        return (not self._lost and self._proc is not None
-                and self._proc.poll() is None)
+        if self._lost:
+            return False
+        if self._proc is not None:
+            return self._proc.poll() is None
+        # Adopted child (no Popen): the connected RPC socket plus a
+        # signal-0 probe stand in for poll().
+        return (self._sock is not None and self.pid is not None
+                and pid_alive(self.pid))
 
     def _rpc(self, op: Tuple, timeout: Optional[float] = None,
              lock_timeout: Optional[float] = None) -> Any:
@@ -534,7 +649,8 @@ class ProcessReplica(ReplicaHandle):
         return self._rpc(("drain", timeout), timeout=timeout + 10.0)
 
     def begin_drain(self) -> None:
-        self._rpc(("begin_drain",), timeout=5.0, lock_timeout=5.0)
+        self._rpc(("begin_drain",), timeout=self._rpc_op_timeout_s,
+                  lock_timeout=self._rpc_lock_timeout_s)
 
     def health(self) -> dict:
         # Short timeouts on BOTH the socket and the channel lock: the
@@ -543,7 +659,8 @@ class ProcessReplica(ReplicaHandle):
         # retry next tick"; liveness and the submit path's own socket
         # timeout still catch real deaths).
         t0 = time.time()
-        out = self._rpc(("health",), timeout=5.0, lock_timeout=5.0)
+        out = self._rpc(("health",), timeout=self._rpc_op_timeout_s,
+                        lock_timeout=self._rpc_lock_timeout_s)
         t1 = time.time()
         if isinstance(out, dict):
             wall = out.get("wall_time_s")
@@ -568,7 +685,8 @@ class ProcessReplica(ReplicaHandle):
         # would answer the NEXT request), so it must keep meaning
         # replica loss — and a scrape must not be able to declare a
         # merely-slow replica dead.
-        return self._rpc(("stats",), lock_timeout=5.0)
+        return self._rpc(("stats",),
+                         lock_timeout=self._rpc_lock_timeout_s)
 
     def trace_snapshot(self) -> dict:
         # Same bound discipline as stats_full: busy channel → benign
@@ -576,13 +694,15 @@ class ProcessReplica(ReplicaHandle):
         # Dump pulls run off the monitor/loss paths (router dumps are
         # off-thread), so the worst case blocks a dump thread, not
         # supervision.
-        return self._rpc(("trace",), lock_timeout=5.0)
+        return self._rpc(("trace",),
+                         lock_timeout=self._rpc_lock_timeout_s)
 
     def audit_probe(self, signature=None) -> dict:
         # Bounded like the monitor's health probe: a divergence check
         # runs at the monitor's cadence and must degrade to "replica
         # unprobeable this round" behind a busy submit, never wedge.
-        return self._rpc(("audit_probe", signature), lock_timeout=5.0)
+        return self._rpc(("audit_probe", signature),
+                         lock_timeout=self._rpc_lock_timeout_s)
 
 
 def live_worker_processes() -> List[subprocess.Popen]:

@@ -70,7 +70,16 @@ from dvf_tpu.obs import ledger as ledger_mod
 from dvf_tpu.obs.ledger import ReconfigLedger
 from dvf_tpu.obs.registry import MetricsRegistry, TimeSeriesRing
 from dvf_tpu.obs.trace import Tracer, merge_tracer_snapshots
-from dvf_tpu.resilience.faults import FaultKind, FaultStats
+from dvf_tpu.resilience.continuity import (
+    ContinuityStats,
+    ReplayRing,
+    atomic_write_json,
+    check_resume_token,
+    load_json,
+    make_resume_token,
+    new_secret,
+)
+from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats
 from dvf_tpu.serve import ServeConfig
 from dvf_tpu.serve.session import (
     AdmissionError,
@@ -110,6 +119,14 @@ class FleetConfig:
     #   bench pins; serving defaults don't)
     startup_timeout_s: float = 120.0
     rpc_timeout_s: float = 60.0
+    rpc_op_timeout_s: float = 5.0   # bounded control-plane RPCs (health
+    #   probe, begin_drain): the socket deadline for ops the monitor
+    #   must never sit behind (previously a hardcoded constant inside
+    #   ProcessReplica — promoted so a deployment with slow replicas can
+    #   widen it; exported in stats()["fleet"] provenance)
+    rpc_lock_timeout_s: float = 5.0  # channel-lock bound for the same
+    #   ops: how long a probe/stats pull may queue behind a busy submit
+    #   before degrading to "try next tick" instead of wedging
     drain_timeout_s: float = 10.0
     max_retired: int = 64         # closed sessions kept poll-able; the
     #   oldest (and its salvaged tail frames) evicted beyond this —
@@ -171,6 +188,22 @@ class FleetConfig:
     #   drained and retired (the existing scale-in machinery) instead
     #   of just flagged — a replica provably computing WRONG pixels
     #   has no business taking traffic
+    state_path: Optional[str] = None  # continuity plane (ISSUE 19): the
+    #   front door periodically snapshots its session registry,
+    #   placement map, and each process replica's incarnation (pid +
+    #   reattach port) to this file — crash-consistent (atomic tmp +
+    #   rename), so a kill -9 at any instant leaves a loadable
+    #   document. None = the continuity snapshot plane is off.
+    snapshot_interval_s: float = 1.0  # snapshot cadence (state_path set)
+    resume_state: bool = False    # start() re-adopts still-live process
+    #   replicas (and their open sessions) from state_path instead of
+    #   spawning cold — the recovery half of the snapshot plane. A
+    #   replica whose worker died (or whose reattach grace expired)
+    #   falls back to a cold start; its sessions are gone with it.
+    reattach_grace_s: float = 30.0  # how long an orphaned worker waits
+    #   on its reattach listener for a restarted front door before
+    #   shutting itself down (armed only when state_path is set —
+    #   without a snapshot nobody can ever adopt it)
     multihost_hosts: int = 0      # >= 2 arms the BIGGER-replica axis:
     #   a spawn_replica(flavor="multihost") builds one replica whose
     #   worker is a MultiHostEngine process group of this many hosts
@@ -188,10 +221,11 @@ class _FleetSession:
                  "next_index", "last_index", "slo_ms", "frame_shape",
                  "frame_dtype", "op_chain", "tier", "lock", "tail",
                  "migrations", "lost", "polled", "closed", "orphaned",
-                 "load_counted")
+                 "load_counted", "replay")
 
     def __init__(self, sid: str, replica_id: str, slo_ms, frame_shape,
-                 frame_dtype, op_chain=None, tier=None):
+                 frame_dtype, op_chain=None, tier=None,
+                 replay_window: int = 0):
         self.sid = sid
         self.replica_id = replica_id
         self.replica_sid = sid           # sid@gN after migrations
@@ -215,6 +249,11 @@ class _FleetSession:
         self.closed = False
         self.orphaned = False            # no replica could take it
         self.load_counted = True         # guards double-decrement
+        self.replay = (ReplayRing(replay_window) if replay_window > 0
+                       else None)        # delivered-tail ring, FLEET
+        #   index space — lives in the fleet session record, so it
+        #   survives replica migration (the replica-side ring dies with
+        #   the replica) and serves resume_stream() replays
 
 
 class FleetFrontend:
@@ -254,6 +293,14 @@ class FleetFrontend:
         #   standby pool had a pre-spawned replica ready)
         self.rollouts = 0                 # completed rolling_rollout calls
         self.rollout_swaps = 0            # replicas replaced across them
+        # -- continuity plane (ISSUE 19): resume tokens + crash recovery.
+        # The signing secret rides the state snapshot, so tokens issued
+        # by a previous front-door incarnation still verify after a
+        # --resume-state restart.
+        self.continuity = ContinuityStats()
+        self._token_secret = new_secret()
+        self._snapshot_thread: Optional[threading.Thread] = None
+        self._snapshot_stop = threading.Event()
         self._replicas: "Dict[str, ReplicaHandle]" = {}
         self._load: Dict[str, int] = {}
         self._replica_load: Dict[str, dict] = {}  # per-replica load rows
@@ -469,10 +516,18 @@ class FleetFrontend:
                     "chaos_seed": self.config.chaos_seed + index,
                     "cpu_affinity": affinity,
                     "precompile": self.config.precompile,
+                    # Orphaned-worker grace: armed only when the
+                    # snapshot plane is on (without a snapshot nobody
+                    # can ever come back to adopt this worker).
+                    "reattach_grace_s": (self.config.reattach_grace_s
+                                         if self.config.state_path
+                                         else 0.0),
                 },
                 env=self.config.replica_env,
                 startup_timeout_s=self.config.startup_timeout_s,
                 rpc_timeout_s=self.config.rpc_timeout_s,
+                rpc_op_timeout_s=self.config.rpc_op_timeout_s,
+                rpc_lock_timeout_s=self.config.rpc_lock_timeout_s,
             )
         return LocalReplica(rid, self._local_factory(rid, index))
 
@@ -520,8 +575,44 @@ class FleetFrontend:
             raise ServeError("fleet already started")
         self._started = True
         errors: List[BaseException] = []
+        # Front-door crash recovery (ISSUE 19): a --resume-state start
+        # loads the previous incarnation's snapshot, re-keys its token
+        # secret, and re-ADOPTS every process replica whose worker is
+        # still alive on its reattach listener — instead of spawning
+        # cold over the top of it. Replicas the snapshot doesn't cover
+        # (or whose worker died / grace expired) start cold as usual.
+        state: Optional[dict] = None
+        adoptable: Dict[str, dict] = {}
+        if self.config.resume_state and self.config.state_path:
+            state = load_json(self.config.state_path)
+        if state is not None:
+            secret = state.get("secret")
+            if secret:
+                try:
+                    self._token_secret = bytes.fromhex(secret)
+                except ValueError:
+                    pass  # foreign snapshot: keep the fresh secret
+            if self.config.mode == "process":
+                from dvf_tpu.fleet.replica import pid_alive
+
+                for rid, row in (state.get("replicas") or {}).items():
+                    if (rid in self._replicas and row.get("pid")
+                            and row.get("reattach_port")
+                            and pid_alive(int(row["pid"]))):
+                        adoptable[rid] = row
+
+        adopted: set = set()
 
         def boot(r: ReplicaHandle) -> None:
+            row = adoptable.get(r.id)
+            if row is not None:
+                try:
+                    r.adopt(int(row["pid"]), int(row["reattach_port"]))
+                    adopted.add(r.id)
+                    self.continuity.inc("adopted_replicas")
+                    return
+                except Exception:  # noqa: BLE001 — the worker died (or
+                    pass           # its grace expired): cold start below
             try:
                 r.start()
             except BaseException as e:  # noqa: BLE001 — surfaced below
@@ -537,6 +628,14 @@ class FleetFrontend:
         if errors:
             self.stop()
             raise ServeError(f"fleet start failed: {errors[0]!r}") from errors[0]
+        if state is not None:
+            self._resume_sessions(state, adopted)
+        if self.config.state_path:
+            self._snapshot_stop.clear()
+            self._snapshot_thread = threading.Thread(
+                target=self._snapshot_loop, name="dvf-fleet-snapshot",
+                daemon=True)
+            self._snapshot_thread.start()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="dvf-fleet-health", daemon=True)
         self._monitor.start()
@@ -551,6 +650,10 @@ class FleetFrontend:
     def stop(self, timeout: float = 15.0) -> None:
         self._stop.set()
         self._wake.set()
+        self._snapshot_stop.set()
+        if self._snapshot_thread is not None:
+            self._snapshot_thread.join(timeout=timeout)
+            self._snapshot_thread = None
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.elastic is not None:
@@ -594,6 +697,43 @@ class FleetFrontend:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def crash(self) -> None:
+        """Chaos/bench-only: die like ``kill -9`` on the FRONT DOOR.
+        Every front-door thread stops, each process replica's RPC
+        channel is dropped WITHOUT a stop op, and the child processes
+        are abandoned ALIVE — exactly the wreckage a restarted
+        ``FleetFrontend(resume_state=True)`` must re-adopt from the
+        state snapshot. Local-mode replicas have no existence outside
+        this process, so they degrade to a plain stop."""
+        self._stop.set()
+        self._wake.set()
+        self._snapshot_stop.set()
+        if self._snapshot_thread is not None:
+            self._snapshot_thread.join(timeout=5.0)
+            self._snapshot_thread = None
+        if self.telemetry is not None:
+            self.telemetry.stop()
+        if self.elastic is not None:
+            self.elastic.stop()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._lock:
+            pumps = list(self._publish_pumps.values())
+            self._publish_pumps.clear()
+        for p in pumps:
+            p["stop"].set()
+        if self.standby is not None:
+            self.standby.stop(timeout=5.0)
+        for r in list(self._replicas.values()):
+            if isinstance(r, ProcessReplica):
+                r.abandon()
+            else:
+                try:
+                    r.stop(timeout=2.0)
+                except Exception:  # noqa: BLE001 — crash teardown
+                    pass
 
     # -- client API -----------------------------------------------------
 
@@ -688,7 +828,9 @@ class FleetFrontend:
                             kn.append(key_render)
                 s = _FleetSession(sid, r.id, slo_ms, frame_shape,
                                   frame_dtype, op_chain=op_chain,
-                                  tier=tier)
+                                  tier=tier,
+                                  replay_window=self.config.serve
+                                  .replay_window)
                 with self._lock:
                     self._sessions[sid] = s
                     self._load[r.id] = self._load.get(r.id, 0) + 1
@@ -784,6 +926,30 @@ class FleetFrontend:
         measuring N replicas doesn't serialize N replicas' pixels
         through the front door."""
         s = self._session(session_id)
+        # Continuity chaos sites model the CLIENT-facing wire, so they
+        # wrap the fleet's bookkeeping: a net_partition costs this poll
+        # its delivery opportunity (frames stay queued replica-side —
+        # delay, never loss), while net_dup/net_reorder below mutate
+        # only what the client sees (the replay ring and the
+        # monotonicity watermark saw the clean stream).
+        chaos = self.config.chaos
+        if chaos is not None:
+            try:
+                chaos.fire("net_partition")
+            except FaultError as e:
+                self.continuity.inc("partitions")
+                self.faults.record(FaultKind.PARTITION, e)
+                if self.ledger is not None:
+                    self.ledger.record(
+                        ledger_mod.PARTITION,
+                        cause=ledger_mod.CAUSE_RECOVERY,
+                        sid=session_id, plane="fleet")
+                return []
+            try:
+                chaos.fire("net_delay")   # delay_s rules sleep in fire()
+            except FaultError:
+                pass  # a raising net_delay rule degrades to a no-op —
+                #   the site's contract is latency, not loss
         out: List[Delivery] = []
         with s.lock:
             if s.tail:
@@ -808,12 +974,18 @@ class FleetFrontend:
                                 self._note_loss(r, e)
                             got = []
                     out.extend(self._map_deliveries(s, got, replica=r))
+            if s.replay is not None:
+                for d in out:
+                    s.replay.push(d.index, d)
             for d in out:
                 if d.index <= s.last_index:
                     self.order_violations += 1
                 else:
                     s.last_index = d.index
             s.polled += len(out)
+        if chaos is not None and out:
+            out = chaos.dup("net_dup", out)
+            out = chaos.reorder("net_reorder", out)
         return out
 
     def _map_deliveries(self, s: _FleetSession, got: list,
@@ -891,6 +1063,47 @@ class FleetFrontend:
                         r.release(s.replica_sid)
                     except (ReplicaLostError, KeyError, ServeError):
                         pass
+
+    # -- continuity plane: resume tokens + delivered-tail replay ---------
+
+    def resume_token(self, session_id: str) -> str:
+        """Opaque resume credential for one session. The epoch is the
+        session's migration generation at issue time (informational —
+        verification keys on the MAC, so a token issued before a
+        migration still resumes the session after it). Because the
+        signing secret rides the state snapshot, tokens also survive a
+        front-door crash + ``resume_state`` restart."""
+        s = self._session(session_id)
+        return make_resume_token(session_id, s.generation,
+                                 self._token_secret)
+
+    def resume_stream(self, session_id: str, token: str,
+                      from_index: int = 0) -> list:
+        """Replay the session's delivered tail from ``from_index``
+        (fleet index space). A reconnecting client hands back its token
+        plus the first index it has NOT seen; everything retained in
+        the replay window comes back in index order — the client dedups
+        by index, which upgrades at-most-once to effectively-exactly-
+        once within the window. Raises ``ServeError`` on a bad token
+        (wrong session, wrong incarnation without a snapshot, forged)."""
+        s = self._session(session_id)
+        epoch = check_resume_token(token, session_id, self._token_secret)
+        if epoch is None:
+            self.continuity.inc("resume_rejected")
+            raise ServeError(
+                f"resume rejected for session {session_id!r}: token "
+                f"did not verify")
+        replayed = ([] if s.replay is None
+                    else [d for _, d in s.replay.replay_from(from_index)])
+        self.continuity.inc("resumes")
+        self.continuity.inc("replays")
+        self.continuity.inc("replayed_frames", len(replayed))
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.RESUME, cause=ledger_mod.CAUSE_RECOVERY,
+                sid=session_id, epoch=epoch, from_index=from_index,
+                replayed=len(replayed))
+        return replayed
 
     def open_count(self) -> int:
         with self._lock:
@@ -1092,6 +1305,116 @@ class FleetFrontend:
     def _snapshot_sessions(self) -> List[_FleetSession]:
         with self._lock:
             return list(self._sessions.values())
+
+    # -- continuity plane: crash-consistent state snapshots --------------
+
+    def snapshot_now(self) -> Optional[str]:
+        """Write one crash-consistent continuity snapshot (atomic tmp +
+        rename — either the old document or the new one is on disk, at
+        every instant): the session registry, the placement map, each
+        process replica's incarnation (pid + reattach port), and the
+        token secret. Everything a restarted front door needs to
+        re-adopt still-live replicas and their sessions without killing
+        them. Returns the path, or None when the plane is unarmed."""
+        path = self.config.state_path
+        if not path:
+            return None
+        sessions = {}
+        for s in self._snapshot_sessions():
+            with s.lock:
+                sessions[s.sid] = {
+                    "replica_id": s.replica_id,
+                    "replica_sid": s.replica_sid,
+                    "generation": s.generation,
+                    "next_index": s.next_index,
+                    "last_index": s.last_index,
+                    "slo_ms": s.slo_ms,
+                    "frame_shape": (list(s.frame_shape)
+                                    if s.frame_shape is not None
+                                    else None),
+                    "frame_dtype": (str(s.frame_dtype)
+                                    if s.frame_dtype is not None
+                                    else None),
+                    "op_chain": s.op_chain,
+                    "tier": s.tier,
+                    "migrations": s.migrations,
+                    "closed": s.closed,
+                    "orphaned": s.orphaned,
+                }
+        replicas = {}
+        for rid, r in list(self._replicas.items()):
+            replicas[rid] = {
+                "state": r.state,
+                "pid": getattr(r, "pid", None),
+                "reattach_port": getattr(r, "reattach_port", None),
+                "restarts": r.restarts,
+            }
+        atomic_write_json(path, {
+            "version": 1,
+            "secret": self._token_secret.hex(),
+            "mode": self.config.mode,
+            "wall_time_s": time.time(),
+            "sessions": sessions,
+            "replicas": replicas,
+        })
+        self.continuity.inc("snapshots")
+        return path
+
+    def _snapshot_loop(self) -> None:
+        interval = max(0.05, self.config.snapshot_interval_s)
+        while not self._snapshot_stop.wait(interval):
+            if self._stop.is_set():
+                return
+            try:
+                self.snapshot_now()
+            except Exception:  # noqa: BLE001 — the snapshot plane must
+                pass           # never take down serving
+
+    def _resume_sessions(self, state: dict, adopted: set) -> None:
+        """Rebuild the fleet-side session registry from the previous
+        incarnation's snapshot. Only sessions bound to a replica we
+        actually RE-ADOPTED come back: their replica-side halves (the
+        worker's own sessions, queued deliveries included) survived the
+        front-door death, so open frames keep flowing under the same
+        fleet indices. A session on a cold-started replica died with
+        its worker — nothing to resume."""
+        t0 = time.time()
+        for sid, row in (state.get("sessions") or {}).items():
+            if row.get("closed") or row.get("orphaned"):
+                continue
+            rid = row.get("replica_id")
+            if rid not in adopted:
+                continue
+            shape = row.get("frame_shape")
+            s = _FleetSession(
+                sid, rid, row.get("slo_ms"),
+                tuple(shape) if shape is not None else None,
+                row.get("frame_dtype"), op_chain=row.get("op_chain"),
+                tier=row.get("tier"),
+                replay_window=self.config.serve.replay_window)
+            s.replica_sid = row.get("replica_sid") or sid
+            s.generation = int(row.get("generation") or 0)
+            # The snapshot may lag real submits by one interval: a too-
+            # low next_index re-assigns indices already in flight, which
+            # the client-side dedup-by-index absorbs (the filter is
+            # deterministic, so colliding frames are identical) — delay
+            # or duplication, never divergence.
+            s.next_index = int(row.get("next_index") or 0)
+            s.last_index = int(row.get("last_index")
+                               if row.get("last_index") is not None
+                               else -1)
+            s.migrations = int(row.get("migrations") or 0)
+            with self._lock:
+                if sid in self._sessions or sid in self._retired:
+                    continue
+                self._sessions[sid] = s
+                self._load[rid] = self._load.get(rid, 0) + 1
+            self.continuity.inc("adopted_sessions")
+            if self.ledger is not None:
+                self.ledger.record(
+                    ledger_mod.RESUME, cause=ledger_mod.CAUSE_RECOVERY,
+                    sid=sid, replica=rid, from_index=s.next_index,
+                    t0=t0)
 
     def _migrate(self, s: _FleetSession, old: ReplicaHandle,
                  reachable: bool, graceful: bool = False) -> None:
@@ -1822,6 +2145,7 @@ class FleetFrontend:
             out[f"admission_refusals_{name}_total"] = float(n)
         if self.ledger is not None:
             out.update(self.ledger.signals())
+        out.update(self.continuity.signals())
         out.update(self.divergence.signals())
         if self.broadcast is not None:
             out.update(self.broadcast.signals())
@@ -1931,6 +2255,26 @@ class FleetFrontend:
             },
             "replica_restarts": sum(r.restarts
                                     for _, r in replica_items),
+            "continuity": self.continuity.summary(),
+            # Config provenance for the knobs that shape recovery
+            # behavior (the continuity bench records these next to its
+            # measurements, so a regression is attributable to a knob
+            # change, not a mystery).
+            "fleet": {
+                "mode": self.config.mode,
+                "replicas": self.config.replicas,
+                "health_poll_s": self.config.health_poll_s,
+                "startup_timeout_s": self.config.startup_timeout_s,
+                "rpc_timeout_s": self.config.rpc_timeout_s,
+                "rpc_op_timeout_s": self.config.rpc_op_timeout_s,
+                "rpc_lock_timeout_s": self.config.rpc_lock_timeout_s,
+                "drain_timeout_s": self.config.drain_timeout_s,
+                "state_path": self.config.state_path,
+                "snapshot_interval_s": self.config.snapshot_interval_s,
+                "resume_state": self.config.resume_state,
+                "reattach_grace_s": self.config.reattach_grace_s,
+                "replay_window": self.config.serve.replay_window,
+            },
             "aggregate": merge_latency_snapshots(
                 {rid: (e or {}).get("latency")
                  for rid, e in exports.items()}),

@@ -72,6 +72,14 @@ SWAP = "swap"                        # compile-aside + atomic hot swap: the
 #   stall window: a hot swap never quiesces the bucket, so there is no
 #   dispatch gap to measure, only the tick-boundary commit cost (~0).
 #   Aborted swaps ledger with aborted=True and the old program serving.
+RESUME = "resume"                    # continuity plane: a session (or the
+#   whole front door) resumed from a token/snapshot — replayed tail,
+#   re-adopted replicas, rebuilt registry. Carries sid/replica ids and
+#   replay counts so "zero session loss" is auditable after the fact.
+PARTITION = "partition"              # continuity plane: a liveness timeout
+#   declared a link partitioned; carries the peer and the reconnect
+#   outcome. Budgeted like any fault, ledgered because a partition is a
+#   reconfiguration of the wire, not a per-frame error.
 
 # Causes (why the reconfiguration happened) — data, not an enum; these
 # are the spellings the runtime emits.

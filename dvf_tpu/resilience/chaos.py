@@ -104,6 +104,18 @@ SITE_KINDS = {
     # commit — a rule's ``at=`` indices pick which half fails. Either
     # failure must leave the OLD program serving untouched.
     "swap": FaultKind.COMPUTE,
+    # Continuity-plane network sites (resilience.continuity): the delivery
+    # path between a session's engine and its client. ``net_partition``
+    # raises a ``partition`` ChaosFault at the poll/recv hop — the link
+    # goes dark and the reconnect/replay machinery must recover without
+    # losing or reordering a frame. The other three never raise: they
+    # mutate the delivery stream itself (``dup`` repeats the head,
+    # ``reorder`` rotates the window, ``delay`` sleeps), which is exactly
+    # the at-least-once noise dedup-by-index must absorb.
+    "net_partition": FaultKind.PARTITION,
+    "net_dup": FaultKind.TRANSPORT,
+    "net_reorder": FaultKind.TRANSPORT,
+    "net_delay": FaultKind.TRANSPORT,
 }
 
 
@@ -267,6 +279,26 @@ class FaultPlan:
         if rule is None:
             return parts
         return parts[:1]
+
+    def dup(self, site: str, items: list) -> list:
+        """Duplicate the head of a delivery list when a rule triggers —
+        at-least-once wire noise (``net_dup``). The duplicate is the
+        same object; dedup-by-index downstream must drop it, so sharing
+        the reference is safe and copy-free."""
+        rule = self._match(site)
+        if rule is None or not items:
+            return items
+        return [items[0]] + list(items)
+
+    def reorder(self, site: str, items: list) -> list:
+        """Rotate a delivery list one position when a rule triggers
+        (head moves to the tail) — deterministic out-of-order arrival
+        (``net_reorder``). A single rotation is enough to violate index
+        monotonicity, which is what the resequencing path must absorb."""
+        rule = self._match(site)
+        if rule is None or len(items) < 2:
+            return items
+        return list(items[1:]) + [items[0]]
 
     # -- observability ---------------------------------------------------
 

@@ -52,6 +52,14 @@ class FaultKind:
     #                          divergence from the golden path — the
     #                          pixels are WRONG even though everything
     #                          parsed and delivered
+    PARTITION = "partition"  # the continuity plane's domain
+    #                          (resilience.continuity): a peer went
+    #                          silent past its liveness timeout — the
+    #                          link is partitioned, not merely slow.
+    #                          Distinct from TRANSPORT (the bytes were
+    #                          wrong) and STALL (our own work wedged):
+    #                          nothing arrived at all, and the response
+    #                          is a budgeted reconnect, not a drop.
     INTERNAL = "internal"    # everything else (bookkeeping bugs, sinks)
 
 
@@ -59,7 +67,7 @@ ALL_KINDS = (
     FaultKind.DECODE, FaultKind.GEOMETRY, FaultKind.TRANSPORT,
     FaultKind.H2D, FaultKind.D2H, FaultKind.COMPUTE, FaultKind.OOM,
     FaultKind.STALL, FaultKind.REPLICA, FaultKind.INTEGRITY,
-    FaultKind.INTERNAL,
+    FaultKind.PARTITION, FaultKind.INTERNAL,
 )
 
 # Default classification for exceptions that carry no kind of their own,

@@ -85,6 +85,10 @@ from dvf_tpu.obs.registry import (
 )
 from dvf_tpu.obs.trace import Tracer
 from dvf_tpu.resilience.budget import ErrorBudget, escalate
+from dvf_tpu.resilience.continuity import (
+    ContinuityStats, HeartbeatConfig, ReconnectPolicy, check_resume_token,
+    make_resume_token, new_secret,
+)
 from dvf_tpu.resilience.faults import FaultError, FaultKind, FaultStats, classify
 from dvf_tpu.resilience.supervisor import InflightWindow, Supervisor
 from dvf_tpu.runtime.egress import (
@@ -153,6 +157,10 @@ class ServeConfig:
     frame_delay: int = 0          # per-session reorder cursor lag
     reorder_capacity: int = 50
     out_queue_size: int = 64      # per-session poll-side bound
+    replay_window: int = 64       # per-session delivered-tail replay ring
+    #   (resilience.continuity): resume_stream replays the retained tail
+    #   from the client's last-seen index — effectively-exactly-once
+    #   delivery within the window. 0 disables (no frames pinned).
     max_retired: int = 64         # closed sessions kept poll-able; oldest
     #   evicted beyond this (a churning long-lived server must not pin
     #   every dead tenant's tail frames forever — release() drops one
@@ -548,6 +556,13 @@ class ServeFrontend:
         self.errors = 0
         self.faults = FaultStats(replica=self.config.replica_label)
         #   per-kind counters + last errors (replica-attributed in a fleet)
+        # -- continuity plane (resilience.continuity) ----------------------
+        self.continuity = ContinuityStats()
+        self._token_secret = new_secret()  # signs this frontend's resume
+        #   tokens; a fleet snapshot persists its own fleet-level secret
+        #   so tokens survive a front-door restart — this one is
+        #   process-lifetime only (serve tier has no crash-recovery story
+        #   of its own; the session state IS this process)
         # -- telemetry plane (obs/): tracer lanes, metrics registry,
         # sliding signal window, flight recorder ---------------------------
         label = self.config.replica_label
@@ -1044,6 +1059,7 @@ class ServeFrontend:
             "swap_aborts_total": float(self.swap_aborts),
             "morphs_total": float(self.morphs),
         }
+        out.update(self.continuity.signals())
         if self._supervisor is not None:
             out["stalls_total"] = float(self._supervisor.stalls)
         if self.control_plane is not None:
@@ -1433,6 +1449,7 @@ class ServeFrontend:
             reorder_capacity=self.config.reorder_capacity,
             out_queue_size=self.config.out_queue_size,
             tier=t,
+            replay_window=self.config.replay_window,
         )
         declared = None
         if frame_shape is not None:
@@ -2396,6 +2413,45 @@ class ServeFrontend:
         retired sessions until their tail is drained)."""
         return self._session(session_id).poll(max_items)
 
+    def resume_token(self, session_id: str) -> str:
+        """A resume credential for an open (or retired-but-pollable)
+        session: a keyed MAC over the session id, verified by
+        :meth:`resume_stream`. Cheap and stateless — issue it at open
+        time and hand it to the client beside the session id."""
+        self._session(session_id)  # existence check (raises KeyError)
+        return make_resume_token(session_id, 0, self._token_secret)
+
+    def resume_stream(self, session_id: str, token: str,
+                      from_index: int = 0) -> list:
+        """Replay the session's retained delivered tail from
+        ``from_index`` (inclusive) — the reconnect path.
+
+        Returns the replayed ``Delivery`` records in index order; the
+        caller dedups by index against what it already has (duplicates
+        are EXPECTED — replay overlaps the frames that did arrive).
+        Frames older than the replay window are gone (the ring is
+        bounded); a client that reconnects within the window gets an
+        exactly-once stream, one that waited longer sees a gap it must
+        treat as at-most-once loss. Raises ``ServeError`` on a bad
+        token (counted as ``resume_rejected``), ``KeyError`` on an
+        unknown session."""
+        if check_resume_token(token, session_id, self._token_secret) is None:
+            self.continuity.inc("resume_rejected")
+            raise ServeError(
+                f"invalid resume token for session {session_id!r}")
+        s = self._session(session_id)
+        replayed = ([] if s.replay is None
+                    else [d for _, d in s.replay.replay_from(from_index)])
+        self.continuity.inc("resumes")
+        self.continuity.inc("replays")
+        self.continuity.inc("replayed_frames", len(replayed))
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.RESUME, cause=ledger_mod.CAUSE_RECOVERY,
+                sid=session_id, from_index=int(from_index),
+                replayed=len(replayed))
+        return replayed
+
     def close(self, session_id: str, drain: bool = True) -> None:
         """Per-session teardown. ``drain=True`` (graceful) serves what's
         queued and in flight first; the dispatch thread retires the
@@ -3189,6 +3245,7 @@ class ServeFrontend:
             "faults": self.faults.summary(),
             "fault_budget": self._budget.summary(),
             "recoveries": self.recoveries,
+            "continuity": self.continuity.summary(),
             # Hot-swap plane: committed stall-free substitutions (resize
             # / morph / recovery), contained aborts (old program kept
             # serving), and live chain morphs.
@@ -3269,6 +3326,7 @@ class ZmqStreamBridge:
         delta_threshold: int = 0,
         delta_degrade_after: int = 8,
         audit_wire: bool = False,
+        heartbeat: Optional[HeartbeatConfig] = None,
     ):
         import zmq
 
@@ -3337,16 +3395,58 @@ class ZmqStreamBridge:
         self.raw_size = raw_size
         self.poll_ms = poll_ms
         self.errors = 0
+        # Continuity plane (resilience.continuity): when a
+        # HeartbeatConfig is armed, silence on the DEALER beyond
+        # timeout_s is declared a PARTITION — counted, classified into
+        # the frontend's fault stats, ledgered, and answered with a
+        # jittered-backoff socket reconnect instead of pumping credits
+        # into a dead wire forever. None = legacy behavior (off).
+        self.heartbeat = heartbeat.validate() if heartbeat else None
+        self.continuity = ContinuityStats()
+        self._reconnect = (ReconnectPolicy(self.heartbeat)
+                           if self.heartbeat else None)
+        self.send_retries = 0  # zmq.Again re-sends of an already-encoded
+        #   delivery (the PR 5 single-encode cache makes these free of
+        #   re-encode cost; the counter proves the retry path is taken)
+        self._dealer_endpoint = f"tcp://{host}:{distribute_port}"
         self.ctx = zmq.Context()
         self.dealer = self.ctx.socket(zmq.DEALER)
-        self.dealer.connect(f"tcp://{host}:{distribute_port}")
+        self.dealer.connect(self._dealer_endpoint)
         self.push = self.ctx.socket(zmq.PUSH)
         self.push.setsockopt(zmq.SNDTIMEO, 1000)
         self.push.connect(f"tcp://{host}:{collect_port}")
         self._stop = threading.Event()
 
+    def _repartition_dealer(self) -> float:
+        """Declare the ingress link partitioned: count + classify +
+        ledger the event, rebuild the DEALER socket (drops the stale
+        identity and any queued credits), and return the jittered
+        backoff delay the caller should wait before resuming the pump."""
+        self.continuity.inc("partitions")
+        err = TimeoutError(
+            f"no traffic on {self._dealer_endpoint} for "
+            f"{self.heartbeat.timeout_s:.1f}s")
+        self.frontend.faults.record(FaultKind.PARTITION, err)
+        if self.frontend.ledger is not None:
+            self.frontend.ledger.record(
+                ledger_mod.PARTITION, cause=ledger_mod.CAUSE_RECOVERY,
+                peer=self._dealer_endpoint, plane="bridge",
+                attempt=self._reconnect.attempt)
+        self.dealer.close(0)
+        self.dealer = self.ctx.socket(self._zmq.DEALER)
+        self.dealer.connect(self._dealer_endpoint)
+        return self._reconnect.next_delay()
+
     def stop(self) -> None:
         self._stop.set()
+
+    def stats(self) -> dict:
+        return {
+            "errors": self.errors,
+            "send_retries": self.send_retries,
+            "wire_degraded": self.wire_degraded,
+            "continuity": self.continuity.summary(),
+        }
 
     def _delta_fault(self) -> None:
         """Count one contained delta-wire fault; past the bound, degrade
@@ -3383,6 +3483,8 @@ class ZmqStreamBridge:
         credits = 0
         served = 0
         budget = self.frontend.config.queue_size
+        last_rx = time.monotonic()  # liveness clock: any DEALER traffic
+        partitioned = False         # a reconnect is pending confirmation
         # Encoded deliveries not yet on the wire: a send timeout (stalled
         # PULL peer) must re-try them next iteration, not discard frames
         # that survived every other drop-bound in the system. Entries are
@@ -3402,6 +3504,13 @@ class ZmqStreamBridge:
                 if self.dealer.poll(self.poll_ms):
                     parts = self.dealer.recv_multipart()
                     credits = max(0, credits - 1)
+                    last_rx = time.monotonic()
+                    if partitioned:
+                        # Traffic after a partition = the reconnect took:
+                        # count it and reset the backoff ladder.
+                        partitioned = False
+                        self._reconnect.reset()
+                        self.continuity.inc("reconnects")
                     parsed = parse_frame_reply(parts)
                     if parsed is None:
                         self.errors += 1
@@ -3420,6 +3529,17 @@ class ZmqStreamBridge:
                 else:
                     credits = max(0, credits - 1)  # credit decay, see
                     #   transport.zmq_ingress._run_loop
+                    if (self.heartbeat is not None
+                            and (time.monotonic() - last_rx)
+                            > self.heartbeat.timeout_s):
+                        delay = self._repartition_dealer()
+                        partitioned = True
+                        credits = 0  # the old socket's credits died with it
+                        last_rx = time.monotonic() + delay  # next liveness
+                        #   window opens after the backoff — a dead peer
+                        #   repartitions once per (timeout + backoff), so
+                        #   the backoff ladder, not the timeout, paces it
+                        self._stop.wait(delay)
                 # All pending deliveries go to the codec plane as ONE
                 # batch encode (pool-parallel), overlapped with the next
                 # iteration's decode/submit work; raw frames ride as
@@ -3458,6 +3578,8 @@ class ZmqStreamBridge:
                         self.push.send_multipart(result_msg(
                             remote_idx, pid, t0, time.time(), payload))
                     except self._zmq.Again:
+                        self.send_retries += 1  # same encoded payload is
+                        #   re-sent next iteration — never re-encoded
                         break  # peer stalled: keep the tail, retry later
                     out_pending.popleft()
                     if self._attr is not None and d.lineage is not None:

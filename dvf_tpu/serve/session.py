@@ -35,6 +35,7 @@ import numpy as np
 
 from dvf_tpu.obs.lineage import FrameLineage
 from dvf_tpu.obs.metrics import LatencyStats
+from dvf_tpu.resilience.continuity import ReplayRing
 from dvf_tpu.sched.queues import DropOldestQueue
 from dvf_tpu.sched.reorder import ReorderBuffer
 
@@ -68,6 +69,11 @@ class SessionConfig:
     #   EDF ties in the batcher's slot pick, orders the quality
     #   controller's downshift victims, and is what the admission floor
     #   refuses by under sustained overload
+    replay_window: int = 64       # delivered-tail frames retained for
+    #   the continuity plane's resume replay (resilience.continuity):
+    #   a reconnecting client replays from its last-seen index and
+    #   dedups, upgrading delivery to effectively-exactly-once within
+    #   this window. 0 disables the ring (no frame references pinned).
 
 
 @dataclasses.dataclass
@@ -153,6 +159,13 @@ class StreamSession:
         # poll() path when no sink is attached. DropOldestQueue again: a
         # client that stops polling bounds memory and keeps freshness.
         self.out = DropOldestQueue(maxsize=self.config.out_queue_size)
+        # Delivered-tail replay ring (resilience.continuity): every
+        # delivered frame is ALSO recorded here (by index) so a resumed
+        # client can replay the tail it may have missed across a
+        # disconnect. References only — the ring pins at most
+        # replay_window frames beyond what the out queue already holds.
+        self.replay = (ReplayRing(self.config.replay_window)
+                       if self.config.replay_window > 0 else None)
         self.latency = LatencyStats()
         self._lock = threading.Lock()
         # Serializes delivery (advance → pop_ready → emit): finalize
@@ -355,6 +368,11 @@ class StreamSession:
                     if closed is None:
                         closed = []
                     closed.append((lin, lat_s * 1e3))
+                d = Delivery(idx, frame, ts, lat_s * 1e3, tag, lin)
+                if self.replay is not None:
+                    # Record BEFORE the sink/out handoff: a frame the
+                    # client's side of the wire lost is still resumable.
+                    self.replay.push(idx, d)
                 if self.sink is not None:
                     try:
                         self.sink.emit(idx, frame, ts)
@@ -367,8 +385,7 @@ class StreamSession:
                         print(f"[serve:sink:{self.id}] error (continuing): "
                               f"{e!r}", file=sys.stderr, flush=True)
                 else:
-                    self.out.put(Delivery(idx, frame, ts, lat_s * 1e3,
-                                          tag, lin))
+                    self.out.put(d)
                 if self.tap is not None:
                     try:
                         self.tap(idx, frame, ts)

@@ -120,6 +120,7 @@ class TpuZmqWorker:
         codec_assist: str = "none",
         audit_wire: bool = False,
         ledger: bool = True,
+        heartbeat=None,
     ):
         import zmq
 
@@ -147,8 +148,9 @@ class TpuZmqWorker:
                 f"the ZMQ worker pads short batches and cannot serve it"
             )
         self.ctx = zmq.Context()
+        self._dealer_endpoint = f"tcp://{host}:{distribute_port}"
         self.dealer = self.ctx.socket(zmq.DEALER)
-        self.dealer.connect(f"tcp://{host}:{distribute_port}")
+        self.dealer.connect(self._dealer_endpoint)
         self.push = self.ctx.socket(zmq.PUSH)
         # A PUSH with no live peer blocks send() forever; bound it so a dead
         # collector drops the batch into run()'s containment (at-most-once,
@@ -282,6 +284,18 @@ class TpuZmqWorker:
         self.fault_budget = fault_budget
         self.fault_window_s = fault_window_s
         self._budget = ErrorBudget(limit=fault_budget, window_s=fault_window_s)
+        # Continuity plane (resilience.continuity): an armed
+        # HeartbeatConfig turns DEALER silence beyond timeout_s into a
+        # measured PARTITION fault — budgeted like every other kind —
+        # answered by a jittered-backoff socket rebuild. None = the
+        # legacy posture (credit decay alone; a dead app is invisible).
+        from dvf_tpu.resilience.continuity import (
+            ContinuityStats, ReconnectPolicy)
+
+        self.heartbeat = heartbeat.validate() if heartbeat else None
+        self.continuity = ContinuityStats()
+        self._reconnect = (ReconnectPolicy(self.heartbeat)
+                           if self.heartbeat else None)
         self._degrade_reason: Optional[str] = None
         self._asm: Optional[ShardedBatchAssembler] = None  # per-geometry
         #   staged-batch assembler (_process_batch); replaces the old raw
@@ -758,7 +772,40 @@ class TpuZmqWorker:
         with self._run_lock:
             self._run_loop(pid, credits, pending, first_recv_t, max_frames)
 
+    def _repartition_dealer(self) -> float:
+        """Declare the ingress link partitioned (liveness timeout):
+        count + classify + budget the event, ledger it, rebuild the
+        DEALER socket (stale identity and queued credits die with it),
+        and return the jittered backoff to wait before pumping again.
+        Budget overflow escalates to a fatal fault like any other kind —
+        a permanently partitioned worker must not spin silently."""
+        self.continuity.inc("partitions")
+        err = TimeoutError(
+            f"no traffic on {self._dealer_endpoint} for "
+            f"{self.heartbeat.timeout_s:.1f}s")
+        self.faults.record(FaultKind.PARTITION, err)
+        if self.ledger is not None:
+            from dvf_tpu.obs import ledger as ledger_mod
+
+            self.ledger.record(
+                ledger_mod.PARTITION, cause=ledger_mod.CAUSE_RECOVERY,
+                peer=self._dealer_endpoint, plane="worker",
+                attempt=self._reconnect.attempt)
+        if (escalate(self._budget, FaultKind.PARTITION,
+                     lambda _k: True) == ErrorBudget.FAIL):
+            raise FaultError(
+                FaultKind.PARTITION,
+                f"partition fault budget exhausted (> {self.fault_budget} "
+                f"liveness timeouts in {self.fault_window_s:g}s); last: "
+                f"{err}", fatal=True)
+        self.dealer.close(0)
+        self.dealer = self.ctx.socket(self._zmq.DEALER)
+        self.dealer.connect(self._dealer_endpoint)
+        return self._reconnect.next_delay()
+
     def _run_loop(self, pid, credits, pending, first_recv_t, max_frames):
+        last_rx = time.monotonic()  # liveness clock (any DEALER traffic)
+        partitioned = False         # reconnect awaiting confirmation
         while not self._stop.is_set():
             try:
                 # Drain any encode batches the codec pool finished while
@@ -782,6 +829,12 @@ class TpuZmqWorker:
 
                 if self.dealer.poll(self.poll_ms):
                     parts = self.dealer.recv_multipart()
+                    last_rx = time.monotonic()
+                    if partitioned:
+                        # Traffic after a partition: the reconnect took.
+                        partitioned = False
+                        self._reconnect.reset()
+                        self.continuity.inc("reconnects")
                     if self.chaos is not None:
                         # Injection site "transport": a firing rule
                         # truncates the multipart → malformed reply below.
@@ -829,6 +882,17 @@ class TpuZmqWorker:
                     # nothing but starves the latest-wins slot: frames get
                     # overwritten while the worker sits on phantom credits.
                     credits = max(0, credits - 1)
+                    if (self.heartbeat is not None
+                            and (time.monotonic() - last_rx)
+                            > self.heartbeat.timeout_s):
+                        delay = self._repartition_dealer()
+                        partitioned = True
+                        credits = 0  # died with the old socket
+                        # Next liveness window opens after the backoff:
+                        # the reconnect ladder, not the timeout, paces a
+                        # persistently dead peer.
+                        last_rx = time.monotonic() + delay
+                        self._stop.wait(delay)
 
                 n_pending = len(self._ring) if self._ring is not None else len(pending)
                 flush = n_pending >= self.batch_size or (
@@ -969,6 +1033,7 @@ class TpuZmqWorker:
                 self._wire_out.stamped)
         if self.ledger is not None:
             out.update(self.ledger.signals())
+        out.update(self.continuity.signals())
         for kind, n in self.faults.summary()["by_kind"].items():
             out[f"fault_{kind}_total"] = float(n)
         return out
@@ -1004,6 +1069,7 @@ class TpuZmqWorker:
                              if self._fused is not None else {})}}
                if self.wire == "delta" else {}),
             "faults": self.faults.summary(),
+            "continuity": self.continuity.summary(),
             # Batch-level hop attribution (per-frame lineage is the
             # serve tier's; encode/send costs live in "egress" below —
             # they run asynchronously on the codec plane, so folding

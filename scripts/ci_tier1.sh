@@ -3,9 +3,12 @@
 # PLUS the audit smoke (scripts/audit_smoke.py: one shadow-replay round
 # + one injected-corruption detection, nonzero on a miss) PLUS the
 # broadcast smoke (scripts/broadcast_smoke.py: encode-once fan-out,
-# relay-hop audit, serve publish tee) PLUS the perf-regression
-# sentinel (benchmarks/sentinel.py --quick). Exit nonzero on a test
-# failure, an audit/broadcast miss, OR a measured perf regression —
+# relay-hop audit, serve publish tee) PLUS the continuity soak smoke
+# (benchmarks/continuity_bench.py --smoke: seeded chaos with
+# byte-identical reassembly + front-door kill -9 recovery, ~10 s)
+# PLUS the perf-regression sentinel (benchmarks/sentinel.py --quick).
+# Exit nonzero on a test failure, an audit/broadcast/continuity miss,
+# OR a measured perf regression —
 # the same bar the GitHub Actions workflow (.github/workflows/ci.yml)
 # enforces on every push.
 set -uo pipefail
@@ -38,6 +41,14 @@ brc=$?
 if [ "$brc" -ne 0 ]; then
     echo "ci_tier1: BROADCAST MISS (broadcast_smoke rc=$brc)" >&2
     exit "$brc"
+fi
+
+echo "== continuity soak smoke (seeded chaos + front-door crash recovery) =="
+JAX_PLATFORMS=cpu python benchmarks/continuity_bench.py --smoke
+crc=$?
+if [ "$crc" -ne 0 ]; then
+    echo "ci_tier1: CONTINUITY MISS (continuity_bench rc=$crc)" >&2
+    exit "$crc"
 fi
 
 echo "== perf-regression sentinel =="
