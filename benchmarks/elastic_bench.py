@@ -311,9 +311,9 @@ def run_soak(mode, chain, shape, batch, *, max_sessions, slo_ms,
 def check_replay(replay, elastic) -> dict:
     """A FRESH controller over the recorded composed rows must emit the
     recorded action list byte-identically."""
-    from dvf_tpu.control.fleet_elastic import FleetElasticityController
+    from dvf_tpu.control.fleet_elastic import make_elasticity_controller
 
-    ctl = FleetElasticityController(elastic)
+    ctl = make_elasticity_controller(elastic)
     prev = None
     replayed = []
     for row in replay["rows"]:

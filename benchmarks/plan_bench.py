@@ -1,0 +1,625 @@
+"""Auto-plan bench: the measured-profile planner, measured.
+
+Four claims, one document (``benchmarks/PLAN_BENCH.json``):
+
+**Planned vs hand-set throughput** — the point of searching at all: for
+a non-default signature/geometry, the planner's chosen operating point
+(batch x tick x ingest depth) must sustain ≥ 1.15× the throughput of
+the shipped hand-set defaults, both legs measured through the SAME
+paced-burst path the planner itself profiles with
+(``ServeFrontend._measure_plan_candidate`` — one measurement harness,
+no third copy).
+
+**Search quality at bounded cost** — the analytic pruning has to earn
+its keep: the plan the live search picks (profiling ≤ 1/3 of the
+candidate grid) must land within 5% of the best candidate found by an
+EXHAUSTIVE pass over the full grid (best-of-``repeats`` per candidate —
+the exhaustive pass is the bench's expense, never the serve path's).
+
+**Warm-restart plan step** — with the on-disk plan cache warm, a
+restart's entire plan step is one verified JSON read: the ledgered
+``plan`` event's ``wall_ms`` must be under 50 ms (vs a full search in
+the hundreds).
+
+**Feed-forward elasticity** — the predictive controller must spawn
+BEFORE admission refusals advance where the reactive one spawns after:
+a recorded step-overload window (occupancy ramping as churn tenants
+arrive) is replayed offline through fresh reactive and predictive
+controllers — byte-deterministically, twice — and the predictive
+controller's first scale-out row must precede the window's first
+refusal advance. A live predictive run of the same window shape pins
+the interactive p99 no worse than the reactive run's.
+
+CPU-runnable; ``--quick`` shrinks everything to seconds for the tier-1
+schema test (this hypervisor-oversubscribed CI box drifts with steal —
+the RATIOS, the row indices, and the determinism bits are the claims,
+not absolute fps). The recorded window rides the JSON so
+tests/test_planner.py re-replays the committed artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+# ---------------------------------------------------------------------------
+# Leg 1-3: plan search, exhaustive reference, warm restart
+# ---------------------------------------------------------------------------
+
+
+def _mk_frontend(chain, batch, cache_dir, burst):
+    from dvf_tpu.runtime.signature import build_filter
+    from dvf_tpu.serve import ServeConfig, ServeFrontend
+
+    cfg = ServeConfig(batch_size=batch, queue_size=32, out_queue_size=1024,
+                      autoplan=True, plan_cache_dir=cache_dir,
+                      autoplan_burst_frames=burst)
+    return ServeFrontend(build_filter(chain), cfg).start()
+
+
+def run_search(chain, shape, batch, cache_dir, *, burst, repeats,
+               log=None):
+    """Cold search -> exhaustive reference pass -> warm restart."""
+    from dvf_tpu.control.planner import Plan, candidate_grid
+
+    base = Plan(batch_size=batch)   # the shipped hand-set defaults
+    fe = _mk_frontend(chain, batch, cache_dir, burst)
+    try:
+        t0 = time.perf_counter()
+        doc = fe.autoplan(shape, "uint8", log=log)
+        cold_wall_ms = (time.perf_counter() - t0) * 1e3
+        # Exhaustive reference: every candidate in the grid, best of
+        # repeats, through the planner's OWN measurement path — the
+        # chosen plan and the hand-set default are scored under
+        # identical conditions, so the ratios cancel host noise. Best
+        # rather than median: burst noise on a shared host is one-sided
+        # (interference only ever SLOWS a burst), so capability is the
+        # fastest observed run; a median would hand any row with one
+        # unlucky draw a verdict its config didn't earn. The raw
+        # samples ride along so the spread is auditable.
+        sid = fe.open_stream(op_chain=chain, frame_shape=shape, tier=0,
+                             slo_ms=120_000.0)
+        frame = np.zeros(shape, np.uint8)
+        rows = []
+        try:
+            # Same grid the live search drew from (autoplan probes up
+            # to 2x the hand-set batch).
+            for plan in candidate_grid(batch_cap=2 * batch):
+                fps = sorted(
+                    r["fps"] for r in
+                    (fe._measure_plan_candidate(sid, frame, plan)
+                     for _ in range(repeats))
+                    if "fps" in r)
+                rows.append({
+                    "label": plan.label(),
+                    "fps": fps[-1] if fps else None,
+                    "samples": fps})
+        finally:
+            fe.close(sid, drain=False)
+        ledger = fe.ledger.document()["events"]
+    finally:
+        fe.stop()
+    by_label = {r["label"]: r["fps"] for r in rows
+                if r["fps"] is not None}
+    best_label = max(by_label, key=by_label.get) if by_label else None
+    cold_ev = [e for e in ledger if e["kind"] == "plan"]
+
+    # Warm restart: same signature/geometry/topology -> cache hit; the
+    # ledgered wall_ms IS the restart's whole plan step.
+    fe2 = _mk_frontend(chain, batch, cache_dir, burst)
+    try:
+        doc2 = fe2.autoplan(shape, "uint8")
+        warm_ev = [e for e in fe2.ledger.document()["events"]
+                   if e["kind"] == "plan"]
+    finally:
+        fe2.stop()
+    hit = [e for e in warm_ev if e.get("cache") == "hit"]
+    return {
+        "op_chain": chain,
+        "frame_shape": list(shape),
+        "batch_cap": batch,
+        "burst_frames": burst,
+        "cold": {
+            "plan": doc,
+            "label": Plan.from_doc(doc).label(),
+            "searched": doc["searched"],
+            "grid": doc["grid"],
+            "live_profile_frac": round(doc["searched"] / doc["grid"], 4),
+            "search_wall_ms": round(cold_wall_ms, 1),
+            "ledger_cache": (cold_ev[0].get("cache") if cold_ev
+                             else None),
+        },
+        "warm": {
+            "source": doc2["source"],
+            "label": Plan.from_doc(doc2).label(),
+            "ledger_cache": hit[0].get("cache") if hit else None,
+            "plan_step_ms": (round(hit[0]["wall_ms"], 3) if hit
+                             else None),
+            "matches_cold": doc2["batch_size"] == doc["batch_size"]
+            and doc2["tick_s"] == doc["tick_s"]
+            and doc2["ingest_depth"] == doc["ingest_depth"],
+        },
+        "exhaustive": {
+            "candidates": len(rows),
+            "repeats": repeats,
+            "rows": rows,
+            "best_label": best_label,
+            "best_fps": by_label.get(best_label),
+            "default_label": base.label(),
+            "default_fps": by_label.get(base.label()),
+            "chosen_label": Plan.from_doc(doc).label(),
+            "chosen_fps": by_label.get(Plan.from_doc(doc).label()),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Leg 4: recorded step-overload window, reactive vs predictive
+# ---------------------------------------------------------------------------
+
+
+def run_overload_window(predictive, *, chain, shape, batch, max_sessions,
+                        slo_ms, elastic, pre_s, ramp_slots, ramp_every_s,
+                        hold_s, post_s, persistent_fps, churn_fps):
+    """Calm -> churn tenants arriving one-by-one (occupancy RAMPS, so a
+    slope is visible before saturation) -> hold -> calm. Returns phase
+    latencies, the first-spawn/first-refusal wall times, and the
+    elastic plane's recorded (rows, actions) window."""
+    import dataclasses
+
+    from dvf_tpu.fleet import FleetConfig, FleetFrontend
+    from dvf_tpu.runtime.signature import build_filter
+    from dvf_tpu.serve import AdmissionError, ServeConfig
+
+    elastic = dataclasses.replace(elastic, predictive=predictive)
+    serve = ServeConfig(batch_size=batch, queue_size=256,
+                        out_queue_size=1024, slo_ms=slo_ms,
+                        max_sessions=max_sessions)
+    cfg = FleetConfig(
+        replicas=1, mode="local",
+        filter_spec=("chain", {"specs": chain.split("|")}),
+        serve=serve,
+        autoscale=(elastic.min_replicas, elastic.max_replicas),
+        standby_warm=1, elastic=elastic, health_poll_s=0.1,
+        precompile=[{"op_chain": chain, "frame_shape": list(shape)}],
+        startup_timeout_s=180.0)
+    fleet = FleetFrontend(build_filter(chain), cfg)
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat = []                       # (wall_t, ms) — interactive tier
+    marks = {"first_refusal_t": None, "hard_failures": 0,
+             "churn_opened": 0, "churn_refusals": 0}
+    frame = np.zeros(shape, np.uint8)
+
+    def persistent():
+        try:
+            sid = fleet.open_stream(op_chain=chain, frame_shape=shape,
+                                    tier=0)
+        except Exception:  # noqa: BLE001 — interactive refused IS a
+            with lock:     # hard failure: they shed last
+                marks["hard_failures"] += 1
+            return
+        period = 1.0 / persistent_fps
+        nxt = time.perf_counter()
+        try:
+            while not stop.is_set():
+                fleet.submit(sid, frame)
+                now = time.time()
+                for d in fleet.poll(sid, meta_only=True):
+                    with lock:
+                        lat.append((now, d.latency_ms))
+                nxt += period
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            fleet.close(sid, drain=True)
+        except Exception:  # noqa: BLE001
+            with lock:
+                marks["hard_failures"] += 1
+
+    def churn(start_delay_s):
+        """One churn tenant: arrives mid-burst, streams until stop.
+        Refusals back off and retry — the graceful-shed contract."""
+        time.sleep(start_delay_s)
+        period = 1.0 / churn_fps
+        sid = None
+        while not stop.is_set() and sid is None:
+            try:
+                sid = fleet.open_stream(op_chain=chain,
+                                        frame_shape=shape, tier=1)
+                with lock:
+                    marks["churn_opened"] += 1
+            except AdmissionError:
+                with lock:
+                    marks["churn_refusals"] += 1
+                    if marks["first_refusal_t"] is None:
+                        marks["first_refusal_t"] = time.time()
+                time.sleep(0.15)
+            except Exception:  # noqa: BLE001
+                with lock:
+                    marks["hard_failures"] += 1
+                time.sleep(0.25)
+        if sid is None:
+            return
+        nxt = time.perf_counter()
+        try:
+            while not stop.is_set():
+                fleet.submit(sid, frame)
+                fleet.poll(sid, meta_only=True)
+                nxt += period
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            fleet.close(sid, drain=True)
+        except Exception:  # noqa: BLE001
+            with lock:
+                marks["hard_failures"] += 1
+
+    first_spawn_t = None
+    with fleet:
+        t0 = time.time()
+        pt = threading.Thread(target=persistent, daemon=True)
+        pt.start()
+        time.sleep(pre_s)
+        t_burst = time.time()
+        threads = [threading.Thread(target=churn,
+                                    args=(i * ramp_every_s,),
+                                    daemon=True)
+                   for i in range(ramp_slots)]
+        for t in threads:
+            t.start()
+        t_end = t_burst + ramp_slots * ramp_every_s + hold_s
+        while time.time() < t_end:
+            if (first_spawn_t is None
+                    and fleet.signals()["replicas_live"]
+                    > elastic.min_replicas):
+                first_spawn_t = time.time()
+            time.sleep(0.05)
+        t_post = time.time()
+        stop.set()
+        for t in [pt] + threads:
+            t.join(timeout=15.0)
+        # Post drain: let the fleet settle before the window closes.
+        time.sleep(post_s)
+        if first_spawn_t is None and fleet.signals()["scale_out_total"]:
+            first_spawn_t = t_post   # spawned, poll loop missed it live
+        sig = fleet.signals()
+        replay = fleet.elastic.replay_window()
+        t1 = time.time()
+
+    with lock:
+        lat_rows = list(lat)
+        marks_out = dict(marks)
+    phases = {}
+    for name, (a, b) in (("pre", (t0, t_burst)),
+                         ("burst", (t_burst, t_post)),
+                         ("post", (t_post, t1 + 1))):
+        xs = [v for t, v in lat_rows if a <= t < b]
+        phases[name] = {"delivered_total": len(xs),
+                        "interactive_p50_ms": _pct(xs, 0.50),
+                        "interactive_p99_ms": _pct(xs, 0.99)}
+    p99s = [p["interactive_p99_ms"] for p in phases.values()
+            if p["interactive_p99_ms"] is not None]
+    return {
+        "predictive": bool(predictive),
+        "phases": phases,
+        "interactive_p99_worst_ms": max(p99s) if p99s else None,
+        "hard_failures_total": marks_out["hard_failures"],
+        "churn_opened_total": marks_out["churn_opened"],
+        "churn_refusals_total": marks_out["churn_refusals"],
+        "admission_refusals_total": int(sig["admission_refusals_total"]),
+        "scale_out_total": int(sig["scale_out_total"]),
+        "first_spawn_s": (round(first_spawn_t - t_burst, 3)
+                          if first_spawn_t else None),
+        "first_refusal_s": (round(marks_out["first_refusal_t"] - t_burst,
+                                  3)
+                            if marks_out["first_refusal_t"] else None),
+        "_replay": replay,
+    }
+
+
+def replay_controller(rows, elastic):
+    """A fresh controller over recorded rows -> [(row_index, kind,
+    target, value, reason)] — the offline controller-eval harness."""
+    from dvf_tpu.control.fleet_elastic import make_elasticity_controller
+
+    ctl = make_elasticity_controller(elastic)
+    prev = None
+    out = []
+    for i, row in enumerate(rows):
+        for a in ctl.step(dict(row), prev):
+            out.append([i, a.kind, a.target, a.value, a.reason])
+        prev = row
+    return out
+
+
+def eval_window(replay, elastic) -> dict:
+    """Offline claims over ONE recorded reactive window: the recorded
+    run replays byte-identically, and a fresh PREDICTIVE controller
+    over the same rows scales out before the window's first refusal
+    advance (and no later than the reactive controller did)."""
+    import dataclasses
+
+    rows = replay["rows"]
+    recorded = [list(a) for a in replay["actions"]]
+    reactive_cfg = dataclasses.replace(elastic, predictive=False)
+    predictive_cfg = dataclasses.replace(elastic, predictive=True)
+    reactive = replay_controller(rows, reactive_cfg)
+    pred_1 = replay_controller(rows, predictive_cfg)
+    pred_2 = replay_controller(rows, predictive_cfg)
+
+    def first_out(actions):
+        for i, kind, *_ in actions:
+            if kind == "scale_out":
+                return i
+        return None
+
+    first_refusal = None
+    base = None
+    for i, row in enumerate(rows):
+        v = row.get("admission_refusals_total")
+        if v is None:
+            continue
+        if base is None:
+            base = float(v)
+        elif float(v) > base:
+            first_refusal = i
+            break
+    r_out, p_out = first_out(reactive), first_out(pred_1)
+    return {
+        "rows": len(rows),
+        # The raw recorded rows travel in the committed doc so the
+        # tier-1 regression test replays this exact window offline.
+        "recorded_rows": [dict(r) for r in rows],
+        "recorded_actions": recorded,
+        "reactive_match": [a[1:] for a in reactive] == recorded,
+        "predictive_actions": pred_1,
+        "predictive_deterministic": pred_1 == pred_2,
+        "first_refusal_row": first_refusal,
+        "reactive_first_out_row": r_out,
+        "predictive_first_out_row": p_out,
+        "predictive_before_refusal": (
+            p_out is not None
+            and (first_refusal is None or p_out < first_refusal)),
+        "predictive_no_later_than_reactive": (
+            p_out is not None and (r_out is None or p_out <= r_out)),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(quick=False):
+    import tempfile
+
+    import jax
+
+    from dvf_tpu.control.fleet_elastic import ElasticConfig
+
+    if quick:
+        # 64-frame bursts: at the ~4k fps these candidates run, a
+        # shorter burst measures single milliseconds of wall and the
+        # 36-row exhaustive max becomes an extreme-value statistic.
+        chain, shape, batch = "invert", (32, 32, 3), 4
+        burst, repeats = 256, 2
+        window_kw = dict(
+            chain="invert", shape=(32, 32, 3), batch=2, max_sessions=3,
+            slo_ms=30_000.0, pre_s=1.0, ramp_slots=6, ramp_every_s=0.25,
+            hold_s=2.5, post_s=4.0, persistent_fps=20.0, churn_fps=10.0)
+        # max_replicas=2: both runs spawn exactly once, so the p99
+        # comparison isolates spawn TIMING (predictive spawns into the
+        # ramp, reactive into saturation) instead of replica count on
+        # an oversubscribed host.
+        elastic = ElasticConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.1,
+            out_after=2, out_cooldown=4, in_after=30, in_cooldown=3,
+            in_occupancy_frac=0.6, predict_slope_window=3,
+            predict_horizon=4)
+    else:
+        chain, shape, batch = "gaussian_blur|invert", (48, 48, 3), 8
+        burst, repeats = 768, 3
+        window_kw = dict(
+            chain="invert", shape=(32, 32, 3), batch=2, max_sessions=3,
+            slo_ms=30_000.0, pre_s=2.0, ramp_slots=6, ramp_every_s=0.35,
+            hold_s=4.0, post_s=6.0, persistent_fps=20.0, churn_fps=10.0)
+        elastic = ElasticConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.1,
+            out_after=2, out_cooldown=4, in_after=60, in_cooldown=3,
+            in_occupancy_frac=0.6, predict_slope_window=3,
+            predict_horizon=4)
+
+    cache_dir = tempfile.mkdtemp(prefix="dvf-plan-bench-")
+    search = run_search(chain, shape, batch, cache_dir, burst=burst,
+                        repeats=repeats)
+    ex = search["exhaustive"]
+    planned_ratio = (round(ex["chosen_fps"] / ex["default_fps"], 3)
+                     if ex["chosen_fps"] and ex["default_fps"] else None)
+    chosen_frac = (round(ex["chosen_fps"] / ex["best_fps"], 3)
+                   if ex["chosen_fps"] and ex["best_fps"] else None)
+
+    # Two windows per arm: a single window's tail percentile on a
+    # shared small-CPU host jitters by double digits, so each arm's
+    # p99 claim uses its better window (symmetric — neither arm gets a
+    # retry the other doesn't). The offline-replay claims use whichever
+    # reactive window actually recorded a refusal advance, so the
+    # "spawn precedes refusal" comparison is never vacuous.
+    import dataclasses as _dc
+
+    n_win = 2 if quick else 3
+    reactive_runs = [run_overload_window(False, elastic=elastic,
+                                         **window_kw)
+                     for _ in range(n_win)]
+    predictive_runs = [run_overload_window(True, elastic=elastic,
+                                           **window_kw)
+                      for _ in range(n_win)]
+
+    def _has_refusal(w):
+        base = None
+        for row in w["_replay"]["rows"]:
+            v = row.get("admission_refusals_total")
+            if v is None:
+                continue
+            if base is None:
+                base = float(v)
+            elif float(v) > base:
+                return True
+        return False
+
+    reactive = next((w for w in reactive_runs if _has_refusal(w)),
+                    reactive_runs[0])
+    window = eval_window(reactive["_replay"], elastic)
+    # Every live predictive run must also replay byte-identically.
+    live_ok = True
+    for w in predictive_runs:
+        rep = w["_replay"]
+        live = replay_controller(rep["rows"],
+                                 _dc.replace(elastic, predictive=True))
+        live_ok = live_ok and ([a[1:] for a in live]
+                               == [list(a) for a in rep["actions"]])
+    window["predictive_live_match"] = live_ok
+    for w in reactive_runs + predictive_runs:
+        w.pop("_replay", None)
+
+    r_p99s = [w["interactive_p99_worst_ms"] for w in reactive_runs
+              if w["interactive_p99_worst_ms"] is not None]
+    p_p99s = [w["interactive_p99_worst_ms"] for w in predictive_runs
+              if w["interactive_p99_worst_ms"] is not None]
+    p99_r = min(r_p99s) if r_p99s else None
+    p99_p = min(p_p99s) if p_p99s else None
+    predictive = min(
+        predictive_runs,
+        key=lambda w: w["interactive_p99_worst_ms"] or float("inf"))
+    # 10% band: both runs ride the same oversubscribed host; earlier
+    # capacity can only help the tail, noise can wiggle it.
+    p99_ok = (p99_r is not None and p99_p is not None
+              and p99_p <= p99_r * 1.10)
+    return {
+        "schema": "dvf.plan_bench.v1",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00",
+                                      time.gmtime()),
+        "platform": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "device_count": jax.device_count(),
+        "quick": bool(quick),
+        "search": search,
+        "controller": {
+            # The FULL config, so an offline replayer reconstructs the
+            # exact controller this window was recorded under.
+            "elastic": _dc.asdict(elastic),
+            "window_kw": {k: (list(v) if isinstance(v, tuple) else v)
+                          for k, v in window_kw.items()},
+            "reactive": reactive,
+            "predictive": predictive,
+            "reactive_p99_runs_ms": r_p99s,
+            "predictive_p99_runs_ms": p_p99s,
+            "window": window,
+        },
+        "acceptance": {
+            "planned_vs_default_ratio": planned_ratio,
+            "target_planned_vs_default_ratio": 1.15,
+            "chosen_vs_best_frac": chosen_frac,
+            "target_chosen_vs_best_frac": 0.95,
+            "live_profile_frac": search["cold"]["live_profile_frac"],
+            "target_live_profile_frac_max": round(1 / 3, 4),
+            "warm_plan_step_ms": search["warm"]["plan_step_ms"],
+            "target_warm_plan_step_ms_max": 50.0,
+            "replay_deterministic": (window["reactive_match"]
+                                     and window[
+                                         "predictive_deterministic"]
+                                     and window["predictive_live_match"]),
+            "predictive_spawn_before_refusal":
+                window["predictive_before_refusal"],
+            "predictive_no_later_than_reactive":
+                window["predictive_no_later_than_reactive"],
+            "reactive_p99_worst_ms": p99_r,
+            "predictive_p99_worst_ms": p99_p,
+            "predictive_p99_no_worse": p99_ok,
+        },
+    }
+
+
+def check(doc) -> list:
+    """[(metric, ok, detail)] over a plan-bench document — shared by
+    --check here, the sentinel gate, and the tier-1 schema test."""
+    acc = doc.get("acceptance", {})
+    out = []
+
+    def gate(metric, ok, detail):
+        out.append((metric, bool(ok), detail))
+
+    m, t = (acc.get("planned_vs_default_ratio"),
+            acc.get("target_planned_vs_default_ratio", 1.15))
+    gate("planned_vs_default_ratio", m is not None and m >= t,
+         f"{m} >= {t}")
+    m, t = (acc.get("chosen_vs_best_frac"),
+            acc.get("target_chosen_vs_best_frac", 0.95))
+    gate("chosen_vs_best_frac", m is not None and m >= t, f"{m} >= {t}")
+    m, t = (acc.get("live_profile_frac"),
+            acc.get("target_live_profile_frac_max", 1 / 3))
+    gate("live_profile_frac", m is not None and m <= t + 1e-9,
+         f"{m} <= {t}")
+    m, t = (acc.get("warm_plan_step_ms"),
+            acc.get("target_warm_plan_step_ms_max", 50.0))
+    gate("warm_plan_step_ms", m is not None and m <= t, f"{m} <= {t}")
+    for key in ("replay_deterministic", "predictive_spawn_before_refusal",
+                "predictive_no_later_than_reactive",
+                "predictive_p99_no_worse"):
+        gate(key, acc.get(key) is True, f"{acc.get(key)} is True")
+    return out
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = os.path.join(_HERE, "PLAN_BENCH.json")
+    if "--check" in argv:
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"[plan_bench] --check: cannot read {out_path}: {e}")
+            return 2
+        rows = check(doc)
+        for metric, ok, detail in rows:
+            print(f"[plan_bench] {'ok ' if ok else 'FAIL'} {metric}: "
+                  f"{detail}")
+        return 0 if all(ok for _, ok, _ in rows) else 1
+    quick = "--quick" in argv
+    doc = run(quick=quick)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, default=float)
+        f.write("\n")
+    acc, w = doc["acceptance"], doc["controller"]["window"]
+    print(f"[plan_bench] planned/default "
+          f"{acc['planned_vs_default_ratio']}x (target >= "
+          f"{acc['target_planned_vs_default_ratio']}), chosen/best "
+          f"{acc['chosen_vs_best_frac']} over "
+          f"{doc['search']['cold']['searched']}/"
+          f"{doc['search']['cold']['grid']} live-profiled; warm plan "
+          f"step {acc['warm_plan_step_ms']} ms; predictive first out "
+          f"row {w['predictive_first_out_row']} vs first refusal row "
+          f"{w['first_refusal_row']} (reactive out row "
+          f"{w['reactive_first_out_row']}); p99 predictive "
+          f"{acc['predictive_p99_worst_ms']} vs reactive "
+          f"{acc['reactive_p99_worst_ms']} ms; wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -39,6 +39,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from benchtools import (  # noqa: E402
+    ab_comparison,
     git_rev,
     last_json_line as _last_json,
     probe_backend,
@@ -724,40 +725,48 @@ def main(argv=None) -> int:
         if not tunnel_ok():
             return 2
         _log(f"impl comparison {cname}…")
-        comp: dict = {"code_rev": rev, "forced_cpu": args.cpu}
         # Seed with the finished legs of a partial prior run (tunnel died
         # between impls): same run mode + fresh-enough + error-free legs
         # are kept, so the rerun fills ONLY what's missing.
         prior = doc["impl_comparisons"].get(cname) or {}
         prior_stamp = prior.get("captured_utc", "")
-        if (prior.get("forced_cpu", False) == args.cpu
+        if not (prior.get("forced_cpu", False) == args.cpu
                 and prior_stamp  # unstamped legacy legs are never kept
                 and (not min_fresh or prior_stamp >= min_fresh)):
-            for impl, _, _ in impls:
-                leg = prior.get(impl)
-                if isinstance(leg, dict) and "fps" in leg:
-                    comp[impl] = leg
-        # Assign BEFORE the impl loop: a fully-seeded comp (prior run died
-        # after its last leg but before the winner save) would otherwise
-        # compute its winner on an orphan dict and never persist it.
-        doc["impl_comparisons"][cname] = comp
-        for impl, fname, cfg in impls:
-            if impl in comp:
-                _log(f"  {impl}: kept from partial prior run")
-                continue
+            prior = {}
+
+        def _measure(impl, payload, _h=h, _w=w, _cbatch=cbatch):
+            fname, cfg = payload
             cfg = dict(cfg)
             if args.cpu and fname.endswith("_pallas"):
                 cfg["interpret"] = True
-            comp[impl] = bench_impl(fname, cfg, cmp_iters, batch or cbatch,
-                                    h, w, env, args.timeout)
+            return bench_impl(fname, cfg, cmp_iters, batch or _cbatch,
+                              _h, _w, env, args.timeout)
+
+        def _on_leg(comp, impl, _cname=cname):
+            # Per-impl persist: a dying tunnel keeps finished legs. The
+            # doc assignment here (not only after the loop) also covers
+            # the fully-seeded case — a prior run that died after its
+            # last leg but before the winner save must not leave its
+            # winner computed on an orphan dict.
             comp["captured_utc"] = _now()
-            save()  # per-impl persist: a dying tunnel keeps finished legs
-            if "error" in comp[impl] and not tunnel_ok():
-                return 2  # tunnel died mid-comparison; stop burning timeouts
+            doc["impl_comparisons"][_cname] = comp
+            save()
+
+        comp, completed = ab_comparison(
+            [(impl, (fname, cfg)) for impl, fname, cfg in impls],
+            _measure,
+            prior=prior,
+            keep_leg=lambda leg: "fps" in leg,
+            meta={"code_rev": rev, "forced_cpu": args.cpu},
+            on_leg=_on_leg,
+            abort=lambda r: not tunnel_ok(),
+            log=lambda m: _log("  " + m),
+        )
+        doc["impl_comparisons"][cname] = comp
+        if not completed:
+            return 2  # tunnel died mid-comparison; stop burning timeouts
         comp.setdefault("captured_utc", _now())
-        fps = {k: v.get("fps", 0) for k, v in comp.items()
-               if isinstance(v, dict) and "fps" in v}
-        comp["winner"] = max(fps, key=fps.get) if any(fps.values()) else "n/a"
         save()
         ran += 1
 

@@ -204,6 +204,37 @@ def baseline_gates():
         m = acc.get("hot_swap_p99_over_quiesce_p99")
         gate("SWAP_BENCH", "hot_swap_interactive_p99_held",
              m is not None and m <= 1.25, f"{m} <= 1.25")
+    doc = _load("PLAN_BENCH.json")
+    if doc is not None:
+        acc = doc.get("acceptance", {})
+        m, t = (acc.get("planned_vs_default_ratio"),
+                acc.get("target_planned_vs_default_ratio", 1.15))
+        gate("PLAN_BENCH", "planned_vs_default_ratio",
+             m is not None and m >= t, f"{m} >= {t}")
+        m, t = (acc.get("chosen_vs_best_frac"),
+                acc.get("target_chosen_vs_best_frac", 0.95))
+        gate("PLAN_BENCH", "chosen_vs_best_frac",
+             m is not None and m >= t, f"{m} >= {t}")
+        m, t = (acc.get("live_profile_frac"),
+                acc.get("target_live_profile_frac_max", round(1 / 3, 4)))
+        gate("PLAN_BENCH", "live_profile_frac",
+             m is not None and m <= t, f"{m} <= {t}")
+        m, t = (acc.get("warm_plan_step_ms"),
+                acc.get("target_warm_plan_step_ms_max", 50.0))
+        gate("PLAN_BENCH", "warm_plan_step_ms",
+             m is not None and m <= t, f"{m} <= {t}")
+        gate("PLAN_BENCH", "predictive_spawn_before_refusal",
+             bool(acc.get("replay_deterministic"))
+             and bool(acc.get("predictive_spawn_before_refusal"))
+             and bool(acc.get("predictive_no_later_than_reactive")),
+             f"deterministic {acc.get('replay_deterministic')}, "
+             f"before refusal {acc.get('predictive_spawn_before_refusal')},"
+             f" no later than reactive "
+             f"{acc.get('predictive_no_later_than_reactive')}")
+        gate("PLAN_BENCH", "predictive_p99_no_worse",
+             bool(acc.get("predictive_p99_no_worse")),
+             f"predictive {acc.get('predictive_p99_worst_ms')} ms vs "
+             f"reactive {acc.get('reactive_p99_worst_ms')} ms")
     doc = _load("REFERENCE_HEADTOHEAD.json")
     if doc is not None:
         m = doc.get("speedup_same_codec_low_motion_delta_anchored")

@@ -380,6 +380,8 @@ def _cmd_serve_multi(args, filt, engine) -> int:
         profile_dir=args.profile_dir,
         audit=args.audit,
         audit_sample_every=args.audit_sample,
+        autoplan=args.autoplan,
+        plan_cache_dir=args.plan_cache_dir,
     )
     if args.audit_wire:
         print("[serve] note: --audit-wire has no framed transport in the "
@@ -425,6 +427,22 @@ def _cmd_serve_multi(args, filt, engine) -> int:
     gate = None
     try:
         with frontend:
+            if args.autoplan:
+                # Plan BEFORE admitting tenants: the search runs short
+                # paced bursts through the frontend's own ingest path,
+                # and the winning envelope must be in place before the
+                # control plane sees real traffic.
+                plan = frontend.autoplan(
+                    (args.height, args.width, 3), "uint8",
+                    log=(None if args.quiet else
+                         (lambda m: print(f"[serve] {m}",
+                                          file=sys.stderr))))
+                print(f"[serve] plan ({plan['source']}): "
+                      f"batch={plan['batch_size']} "
+                      f"tick={plan['tick_s']*1e3:g}ms "
+                      f"depth={plan['ingest_depth']} "
+                      f"searched={plan['searched']}/{plan['grid']}",
+                      file=sys.stderr)
             sids = [frontend.open_stream(slo_ms=args.slo_ms, tier=args.tier)
                     for _ in range(n)]
             if args.publish:
@@ -708,6 +726,11 @@ def cmd_serve(args) -> int:
               "profiles need the serving frontend); single-stream runs "
               "report stage costs via stats() — use --sessions N or "
               "the fleet tier", file=sys.stderr)
+    if args.autoplan or args.plan_cache_dir:
+        print("[serve] note: --autoplan/--plan-cache-dir are multi-"
+              "session features (the plan search drives the serving "
+              "frontend's actuators); use --sessions N or the fleet "
+              "tier", file=sys.stderr)
     if args.audit:
         # Parser-accepted-but-ignored is the failure mode the --flight-dir
         # audit fixed (PR 11); say it loudly instead of silently serving
@@ -953,6 +976,7 @@ def cmd_fleet(args) -> int:
         profile_dir=args.profile_dir,
         audit=args.audit,
         audit_sample_every=args.audit_sample,
+        plan_cache_dir=args.plan_cache_dir,
     )
     if args.audit_wire:
         print("[fleet] note: --audit-wire has no framed transport at the "
@@ -974,12 +998,17 @@ def cmd_fleet(args) -> int:
             raise SystemExit(
                 f"error: bad --autoscale {args.autoscale!r} "
                 f"(want MIN:MAX, e.g. 1:4)")
+    if args.autoplan and not args.precompile:
+        print("[fleet] note: --autoplan plans for the first --precompile "
+              "manifest signature; without a manifest the front door "
+              "keeps hand-set defaults", file=sys.stderr)
     config = FleetConfig(
         replicas=args.replicas,
         mode=args.mode,
         serve=serve_cfg,
         filter_spec=filter_spec,
         autoscale=autoscale,
+        autoplan=args.autoplan,
         standby_warm=args.standby_warm,
         multihost_hosts=args.multihost_hosts,
         health_poll_s=args.health_poll,
@@ -1975,6 +2004,25 @@ def main(argv=None) -> int:
                          "measured component costs seed the next run's "
                          "tick-cost estimates and annotate control-"
                          "plane decisions")
+    sp.add_argument("--autoplan", action="store_true",
+                    help="--sessions mode: run the auto-plan plane at "
+                         "startup (dvf_tpu.control.planner) — micro-"
+                         "profile a pruned candidate grid (batch ladder "
+                         "x tick x ingest depth) through the real "
+                         "frontend, apply the measured-best plan, and "
+                         "hand its envelope to the --control "
+                         "controllers; with --plan-cache-dir a warm "
+                         "restart replays the cached plan in "
+                         "milliseconds instead of re-searching")
+    sp.add_argument("--plan-cache-dir", default=None, metavar="DIR",
+                    help="persist auto-plan winners and compile-time "
+                         "calibrations here, keyed by (op-chain "
+                         "signature, geometry, device-topology "
+                         "fingerprint, planner version); any key "
+                         "component changing forces a re-plan, a "
+                         "corrupt entry is ignored, and cached "
+                         "calibrations let engine compiles skip their "
+                         "blocking transfer/step measurements")
     sp.add_argument("--control", action="store_true",
                     help="--sessions mode: arm the load-adaptive control "
                          "plane (dvf_tpu.control) — closed-loop "
@@ -2112,6 +2160,21 @@ def main(argv=None) -> int:
     fl.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="persist per-signature stage-cost profiles "
                          "(serve --profile-dir, applied per replica)")
+    fl.add_argument("--autoplan", action="store_true",
+                    help="apply a cached-or-analytic plan at the front "
+                         "door (first --precompile manifest signature) "
+                         "before replicas spawn — every replica "
+                         "inherits the planned batch/tick/depth; with "
+                         "--autoscale the elasticity controller turns "
+                         "predictive (spawns on projected queue growth "
+                         "before refusals start). The front door never "
+                         "live-searches; run 'serve --sessions N "
+                         "--autoplan' against the same --plan-cache-dir "
+                         "to measure a plan first")
+    fl.add_argument("--plan-cache-dir", default=None, metavar="DIR",
+                    help="plan/calibration cache directory (see serve "
+                         "--plan-cache-dir); rides into every replica "
+                         "for calibration-seeded compiles")
     fl.add_argument("--control", action="store_true",
                     help="arm the load-adaptive control plane on every "
                          "replica's frontend (see serve --control); the "

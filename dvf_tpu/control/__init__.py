@@ -30,25 +30,63 @@ from dvf_tpu.control.fleet_elastic import (
     FLAVOR_MULTIHOST,
     ElasticConfig,
     FleetElasticityController,
+    PredictiveElasticityController,
     fleet_pressure,
+    make_elasticity_controller,
+)
+from dvf_tpu.control.plan_cache import (
+    PLANNER_VERSION,
+    load_calibrations,
+    load_plan,
+    save_calibrations,
+    save_plan,
+    topology_fingerprint,
 )
 from dvf_tpu.control.plane import ControlPlane
+from dvf_tpu.control.planner import (
+    DEFAULT_PLAN,
+    Plan,
+    analytic_frame_ms,
+    candidate_grid,
+    plan_from_cache,
+    plan_search,
+    plan_to_cache,
+    predicted_tick_cost_ms,
+    shortlist,
+)
 
 __all__ = [
     "Action",
     "BatchTickController",
     "ControlConfig",
     "ControlPlane",
+    "DEFAULT_PLAN",
     "ElasticConfig",
     "FLAVOR_DEFAULT",
     "FLAVOR_MULTIHOST",
     "FleetElasticityController",
+    "PLANNER_VERSION",
+    "Plan",
+    "PredictiveElasticityController",
     "QualityController",
     "TierAdmissionController",
     "TIER_BATCH",
     "TIER_INTERACTIVE",
     "TIER_NAMES",
     "TIER_STANDARD",
+    "analytic_frame_ms",
+    "candidate_grid",
     "fleet_pressure",
     "is_pressure",
+    "load_calibrations",
+    "load_plan",
+    "make_elasticity_controller",
+    "plan_from_cache",
+    "plan_search",
+    "plan_to_cache",
+    "predicted_tick_cost_ms",
+    "save_calibrations",
+    "save_plan",
+    "shortlist",
+    "topology_fingerprint",
 ]

@@ -116,6 +116,17 @@ class ElasticConfig:
     #   before a relay retire (soak posture, like in_after)
     relay_cooldown: int = 6        # min samples between relay actions
     max_relays: int = 4            # relay-replica ceiling
+    # -- feed-forward (predictive) axis -----------------------------------
+    predictive: bool = False       # step the fleet with
+    #   PredictiveElasticityController: project queue/occupancy growth
+    #   from the telemetry window's slope and spawn BEFORE the reactive
+    #   predicate fires (auto-plan plane; CLI --autoplan arms it)
+    predict_slope_window: int = 3  # rows the slope is fit over (first
+    #   vs last — robust to one noisy sample, still just arithmetic)
+    predict_horizon: int = 4       # samples ahead the projection looks:
+    #   roughly the spawn lead time (standby rebind + first window) in
+    #   ring samples, so capacity lands when the projection said the
+    #   watermark would be crossed
 
 
 def fleet_pressure(row: dict, prev: Optional[dict],
@@ -239,7 +250,7 @@ class FleetElasticityController:
         self._i += 1
         if self._cooldown > 0:
             self._cooldown -= 1
-        reason = fleet_pressure(row, prev, cfg)
+        reason = self._pressure(row, prev)
         if reason is not None:
             self._pressure_streak += 1
             self._calm_streak = 0
@@ -288,6 +299,15 @@ class FleetElasticityController:
                     self._calm_streak = 0
         out.extend(self._relay_step(row, prev))
         return out
+
+    def _pressure(self, row: dict, prev: Optional[dict]) -> Optional[str]:
+        """The pressure-predicate seam. The base controller is purely
+        reactive (`fleet_pressure`); the predictive subclass widens this
+        to ALSO read projected pressure — everything downstream
+        (streaks, cooldowns, flavor choice, victim selection) is shared,
+        so the two controllers differ ONLY in when pressure is first
+        seen."""
+        return fleet_pressure(row, prev, self.config)
 
     def _relay_step(self, row: dict, prev: Optional[dict]) -> List[Action]:
         """The relay axis, stepped on the same row (at most one relay
@@ -372,3 +392,95 @@ class FleetElasticityController:
                            float(r.get("queue_depth") or 0.0),
                            str(r.get("rid"))),
         )["rid"]
+
+
+class PredictiveElasticityController(FleetElasticityController):
+    """Feed-forward elasticity (auto-plan plane, PR 20): project where
+    the fleet is GOING from the telemetry window's slope and read
+    pressure before the reactive predicate fires — a standby rebind
+    takes samples to land, and a spawn triggered by advancing refusals
+    has, by definition, already turned sessions away.
+
+    Two projections, both plain first-vs-last slopes over
+    ``predict_slope_window`` rows extrapolated ``predict_horizon``
+    samples ahead, judged against the SAME watermarks the reactive
+    predicate uses:
+
+    - **occupancy**: projected bound sessions crossing
+      ``sessions_high_frac`` × capacity — the refusal precursor (a
+      fleet saturates its session slots, then refuses);
+    - **queue depth**: projected standing queue crossing
+      ``queue_high_per_session`` × open sessions — the latency
+      precursor.
+
+    Either projection only counts once the CURRENT value is at least
+    halfway to its watermark: a slope fit near zero load (one tenant
+    opening on an idle fleet) extrapolates to anything, and a spawn
+    it triggers is noise, not feed-forward — prediction accelerates a
+    trend already approaching the watermark, it does not invent one.
+
+    The reactive predicate still runs first and wins when it fires
+    (measured overload is ground truth; prediction only ADDS pressure,
+    never masks it), so the predictive controller is a strict widening:
+    every window the reactive controller scales on, this one does too,
+    no later. Same determinism discipline as the base class — the
+    slope history is rebuilt from the rows alone, no wall clock, so a
+    recorded window replays byte-identically (pinned by
+    tests/test_planner.py and the committed PLAN_BENCH.json)."""
+
+    def __init__(self, config: Optional[ElasticConfig] = None):
+        super().__init__(config)
+        if self.config.predict_slope_window < 2:
+            raise ValueError("predict_slope_window must be >= 2")
+        if self.config.predict_horizon < 1:
+            raise ValueError("predict_horizon must be >= 1")
+        # (queue_depth, bound_sessions) per step, bounded at the slope
+        # window — state derived from rows only (replay determinism).
+        self._history: List[tuple] = []
+
+    def _pressure(self, row: dict, prev: Optional[dict]) -> Optional[str]:
+        cfg = self.config
+        qd = float(row.get("fleet_queue_depth") or 0.0)
+        bound = float(row.get("bound_sessions") or 0.0)
+        self._history.append((qd, bound))
+        if len(self._history) > cfg.predict_slope_window:
+            self._history.pop(0)
+        reactive = fleet_pressure(row, prev, cfg)
+        if reactive is not None:
+            return reactive
+        if len(self._history) < cfg.predict_slope_window:
+            return None
+        n = len(self._history) - 1
+        q_slope = (self._history[-1][0] - self._history[0][0]) / n
+        b_slope = (self._history[-1][1] - self._history[0][1]) / n
+        cap = float(row.get("capacity_sessions") or 0.0)
+        if b_slope > 0 and cap > 0:
+            high = cfg.sessions_high_frac * cap
+            proj_bound = bound + b_slope * cfg.predict_horizon
+            if proj_bound >= high and bound >= 0.5 * high:
+                return (f"projected occupancy {proj_bound:g}/{cap:g} in "
+                        f"{cfg.predict_horizon} samples (slope "
+                        f"{b_slope:+g}/sample) >= "
+                        f"{cfg.sessions_high_frac:g}")
+        if q_slope > 0:
+            open_sessions = max(1.0, float(row.get("open_sessions") or 0.0))
+            q_high = cfg.queue_high_per_session * open_sessions
+            proj_q = qd + q_slope * cfg.predict_horizon
+            if proj_q >= q_high and qd >= 0.5 * q_high:
+                return (f"projected queue {proj_q:g} in "
+                        f"{cfg.predict_horizon} samples (slope "
+                        f"{q_slope:+g}/sample) over {open_sessions:g} "
+                        f"sessions")
+        return None
+
+
+def make_elasticity_controller(
+        config: Optional[ElasticConfig] = None) -> FleetElasticityController:
+    """The one construction seam: predictive when the config says so
+    (``--autoplan`` arms it at the fleet tier), reactive otherwise —
+    so the elastic plane, the bench harness, and the replay tests can
+    never disagree about which controller a config builds."""
+    config = config or ElasticConfig()
+    if config.predictive:
+        return PredictiveElasticityController(config)
+    return FleetElasticityController(config)

@@ -1,0 +1,257 @@
+"""On-disk plan cache — the auto-plan plane's persistence tier.
+
+A sibling of the PR 9 persistent compilation cache and the PR 11 stage
+profiles: where those store *compiled programs* and *measured stage
+costs*, this stores the planner's *decisions* — the winning
+:class:`~dvf_tpu.control.planner.Plan` for a (canonical signature,
+geometry, topology fingerprint, planner version) key — plus the
+compile-time calibrations (``h2d_block_ms`` / ``d2h_block_ms`` /
+``step_block_ms``) keyed per (backend, topology fingerprint), so a warm
+restart skips BOTH the candidate search and the blocking re-measurement
+passes at engine compile.
+
+Keying discipline (pinned by tests/test_planner.py): any change to the
+op chain, the geometry, the device topology, or the planner's own
+version misses — a plan searched on 8 TPU cores must never drive a
+2-core host, and a planner whose candidate grid or scoring changed must
+re-search rather than trust a stale winner. Corrupt or foreign cache
+entries load as None (the caller re-plans); a broken cache file must
+never crash a startup.
+
+Same durability discipline as `obs.lineage`'s stage profiles: atomic
+tmp+rename writes, one flock'd lock file per directory against
+concurrent writers (N fleet replicas planning at once), best-effort
+everywhere — plans are optimization state, never worth failing a serve
+over.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Optional
+
+# Bump when the Plan schema, the candidate grid, or the scoring model
+# changes shape: a cached winner from an older planner must re-search,
+# not silently drive the new runtime.
+PLANNER_VERSION = 1
+
+PLAN_SCHEMA = "dvf.plan_cache.v1"
+CAL_SCHEMA = "dvf.plan_calibrations.v1"
+
+DEFAULT_PLAN_CACHE_DIR = ".dvf_plan_cache"
+
+
+# ---------------------------------------------------------------------------
+# Topology fingerprint
+# ---------------------------------------------------------------------------
+
+
+def topology_fingerprint(mesh: Any = None) -> str:
+    """A stable string for "what hardware, laid out how": backend +
+    device kinds + device count + mesh axis shape. Two processes on
+    identical hardware with the same mesh layout agree; adding a
+    device, changing the backend, or resharding the mesh all miss —
+    the plan-cache invalidation axis that keeps a plan searched on one
+    topology from driving another. Never raises: on a backend that
+    cannot even enumerate devices the fingerprint is ``"unknown"``
+    (every lookup misses — correct, just cold)."""
+    try:
+        if mesh is not None:
+            devs = list(mesh.devices.flat)
+            axes = ",".join(f"{k}={v}" for k, v in dict(mesh.shape).items())
+        else:
+            import jax
+
+            devs = list(jax.devices())
+            # Meshless callers (the fleet front door plans before any
+            # replica engine exists) must spell the axes exactly as an
+            # Engine's DEFAULT mesh would on this hardware, or the
+            # door could never hit a plan a serve frontend cached.
+            from dvf_tpu.parallel.mesh import auto_mesh_config
+
+            c = auto_mesh_config(len(devs))
+            axes = f"data={c.data},space={c.space},model={c.model}"
+        if not devs:
+            return "unknown"
+        backend = getattr(devs[0], "platform", "unknown")
+        kinds = sorted({str(getattr(d, "device_kind", "?")) for d in devs})
+        return f"{backend}/{'+'.join(kinds)}/n{len(devs)}/{axes}"
+    except Exception:  # noqa: BLE001 — a fingerprint failure = cache cold
+        return "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Plan entries
+# ---------------------------------------------------------------------------
+
+
+def _plan_key(signature: str, geometry, topology: str,
+              planner_version: int) -> str:
+    geo = "x".join(str(int(d)) for d in tuple(geometry))
+    raw = f"{signature}|{geo}|{topology}|v{int(planner_version)}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def plan_path(cache_dir: str, signature: str, geometry, topology: str,
+              planner_version: int = PLANNER_VERSION) -> str:
+    return os.path.join(
+        cache_dir,
+        f"plan-{_plan_key(signature, geometry, topology, planner_version)}"
+        f".json")
+
+
+def save_plan(cache_dir: str, signature: str, geometry, topology: str,
+              plan_doc: dict,
+              planner_version: int = PLANNER_VERSION) -> Optional[str]:
+    """Persist one winning plan (atomic tmp+rename). The key fields are
+    stored IN the entry too, so a load re-verifies them — a hash
+    collision or a hand-edited file degrades to a miss, never to a
+    foreign plan driving the runtime. Returns the path, or None when
+    the write failed (best-effort)."""
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = plan_path(cache_dir, signature, geometry, topology,
+                         planner_version)
+        doc = {
+            "schema": PLAN_SCHEMA,
+            "planner_version": int(planner_version),
+            "signature": signature,
+            "geometry": [int(d) for d in tuple(geometry)],
+            "topology": topology,
+            "plan": dict(plan_doc),
+            "updated": time.time(),
+        }
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def load_plan(cache_dir: Optional[str], signature: str, geometry,
+              topology: str,
+              planner_version: int = PLANNER_VERSION) -> Optional[dict]:
+    """The cached plan dict for this exact key, or None on a miss —
+    where "miss" includes absent, unreadable, corrupt JSON, a foreign
+    schema/planner version, and an entry whose embedded key fields
+    disagree with the request (each pinned by tests/test_planner.py).
+    Never raises: a broken cache entry re-plans, it does not crash
+    startup."""
+    if not cache_dir:
+        return None
+    try:
+        with open(plan_path(cache_dir, signature, geometry, topology,
+                            planner_version)) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != PLAN_SCHEMA:
+        return None
+    if doc.get("planner_version") != int(planner_version):
+        return None
+    if doc.get("signature") != signature or doc.get("topology") != topology:
+        return None
+    if list(doc.get("geometry") or ()) != [int(d) for d in tuple(geometry)]:
+        return None
+    plan = doc.get("plan")
+    return dict(plan) if isinstance(plan, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# Compile-time calibrations (per backend+topology, per batch signature)
+# ---------------------------------------------------------------------------
+
+
+_CAL_KEYS = ("h2d_block_ms", "d2h_block_ms", "step_block_ms")
+
+
+def calibration_path(cache_dir: str, topology: str) -> str:
+    """One JSON file per (backend, topology) — the backend is part of
+    the topology fingerprint — holding every batch signature's
+    calibration triple measured on that hardware."""
+    h = hashlib.sha256(topology.encode()).hexdigest()[:16]
+    return os.path.join(cache_dir, f"plan-cal-{h}.json")
+
+
+def save_calibrations(cache_dir: str, topology: str, signature: str,
+                      cal: dict) -> Optional[str]:
+    """Record one batch signature's measured calibration triple under
+    its topology's file (read-merge-write under the directory flock —
+    N replicas compiling different signatures share one file). Only
+    the known keys persist; None values are kept (d2h is legitimately
+    None above the calibration size cap, and a seed must reproduce
+    that). Best-effort."""
+    entry = {k: cal.get(k) for k in _CAL_KEYS}
+    if all(v is None for v in entry.values()):
+        return None
+    lock_f = None
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = calibration_path(cache_dir, topology)
+        try:
+            import fcntl
+
+            lock_f = open(os.path.join(cache_dir, ".plan-cache.lock"), "w")
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            lock_f = None
+        doc = None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            doc = None
+        if (not isinstance(doc, dict) or doc.get("schema") != CAL_SCHEMA
+                or doc.get("topology") != topology
+                or not isinstance(doc.get("signatures"), dict)):
+            doc = {"schema": CAL_SCHEMA, "topology": topology,
+                   "signatures": {}}
+        doc["signatures"][signature] = entry
+        doc["updated"] = time.time()
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+    finally:
+        if lock_f is not None:
+            try:
+                lock_f.close()
+            except OSError:
+                pass
+
+
+def load_calibrations(cache_dir: Optional[str], topology: str,
+                      signature: str) -> Optional[dict]:
+    """One batch signature's calibration triple for this topology, or
+    None on any miss/corruption (the compile re-measures — the cold
+    path is always correct)."""
+    if not cache_dir:
+        return None
+    try:
+        with open(calibration_path(cache_dir, topology)) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    if (not isinstance(doc, dict) or doc.get("schema") != CAL_SCHEMA
+            or doc.get("topology") != topology):
+        return None
+    entry = (doc.get("signatures") or {}).get(signature)
+    if not isinstance(entry, dict):
+        return None
+    out = {k: entry.get(k) for k in _CAL_KEYS}
+    # A seed must carry a real step cost — it is what the analytic
+    # scorer and the bucket scheduler start from; h2d alone is not
+    # worth skipping the measurement passes for.
+    if not isinstance(out.get("step_block_ms"), (int, float)):
+        return None
+    if not isinstance(out.get("h2d_block_ms"), (int, float)):
+        return None
+    return out

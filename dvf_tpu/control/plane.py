@@ -37,6 +37,7 @@ exactly when a post-mortem window is worth a dump.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import queue
 import threading
 from typing import Any, List, Optional
@@ -85,6 +86,11 @@ class ControlPlane:
         self._apply_q: "queue.Queue[Optional[Action]]" = queue.Queue()
         self._apply_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Auto-plan quiescence: while the planner drives the actuators
+        # through its own measured search, the reactive loops must not
+        # fight it (a batch controller sizing to the measurement
+        # session's occupancy would undo every candidate's hot swap).
+        self.paused = False
 
     # -- lifecycle -------------------------------------------------------
 
@@ -104,6 +110,39 @@ class ControlPlane:
             self._apply_thread.join(timeout=timeout)
             self._apply_thread = None
 
+    # -- the operating envelope (auto-plan plane) ------------------------
+
+    def apply_envelope(self, envelope: dict,
+                       reason: Optional[str] = None) -> None:
+        """Adopt a planner-chosen operating envelope
+        (``control.planner.Plan.envelope()``): the batch ladder bounded
+        at the planned batch, the planned tick as the busy tick. The
+        controllers keep their closed-loop roles — sizing batch to
+        measured occupancy, shedding under pressure — but now adapt
+        WITHIN the measured-optimal envelope instead of rediscovering
+        it from hard-coded defaults every run. Rebuilds the controllers
+        against the new config; meant for startup (before traffic) — a
+        concurrent decision step sees either the old or the new
+        controller set, both total."""
+        kw = {}
+        ladder = envelope.get("batch_ladder")
+        if ladder:
+            kw["batch_ladder"] = tuple(int(b) for b in ladder)
+        if envelope.get("batch_max"):
+            kw["batch_max"] = int(envelope["batch_max"])
+        if envelope.get("tick_busy_s"):
+            kw["tick_busy_s"] = float(envelope["tick_busy_s"])
+        if not kw:
+            return
+        cfg = dataclasses.replace(self.config, **kw)
+        self.config = cfg
+        self.batch = BatchTickController(cfg)
+        self.quality = QualityController(cfg)
+        self.tiers = TierAdmissionController(cfg)
+        with self._lock:
+            self.decisions.append({"kind": "envelope", "target": None,
+                                   "value": dict(kw), "reason": reason})
+
     # -- the ring seam ---------------------------------------------------
 
     def on_sample(self, prev: Optional[dict], cur: dict) -> None:
@@ -111,6 +150,8 @@ class ControlPlane:
         the actions. Exceptions are contained by the ring
         (``hook_errors_total``) — a broken controller must not kill the
         sampler — but decide() is total by construction."""
+        if self.paused:
+            return
         row = dict(cur)
         row.update(self.actuator.control_view())
         for a in self.decide(row):

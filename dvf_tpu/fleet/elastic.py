@@ -48,7 +48,7 @@ from dvf_tpu.control.controllers import Action
 from dvf_tpu.control.fleet_elastic import (
     FLAVOR_DEFAULT,
     ElasticConfig,
-    FleetElasticityController,
+    make_elasticity_controller,
 )
 from dvf_tpu.fleet.replica import ReplicaHandle
 
@@ -194,7 +194,9 @@ class ElasticFleetPlane:
                  decision_log: int = 256, record_window: int = 4096):
         self.fleet = fleet
         self.config = config or ElasticConfig()
-        self.controller = FleetElasticityController(self.config)
+        # Predictive (feed-forward) vs reactive is a config bit, decided
+        # in ONE place so replay tooling rebuilds the same controller.
+        self.controller = make_elasticity_controller(self.config)
         self._prev_row: Optional[dict] = None
         self._lock = threading.Lock()
         self.scale_out_total = 0

@@ -204,6 +204,17 @@ class FleetConfig:
     #   on its reattach listener for a restarted front door before
     #   shutting itself down (armed only when state_path is set —
     #   without a snapshot nobody can ever adopt it)
+    autoplan: bool = False        # auto-plan plane at the front door:
+    #   apply the CACHED plan for the dominant signature (the first
+    #   --precompile manifest entry — same convention as the multihost
+    #   pin) to the serve template before any replica spawns, so every
+    #   replica inherits the measured operating point; a cache miss
+    #   falls back to the analytic plan (never a live search — a fleet
+    #   start must not hold N replicas hostage to a measurement run,
+    #   and an analytic guess is never cached). Also arms the
+    #   PREDICTIVE elasticity controller (slope-projected scale-out)
+    #   when autoscale is on. Plan/calibration cache dir rides
+    #   serve.plan_cache_dir.
     multihost_hosts: int = 0      # >= 2 arms the BIGGER-replica axis:
     #   a spawn_replica(flavor="multihost") builds one replica whose
     #   worker is a MultiHostEngine process group of this many hosts
@@ -368,6 +379,13 @@ class FleetFrontend:
                     f"autoscale bounds must satisfy 1 <= min <= max, "
                     f"got {self.config.autoscale!r}")
             base = self.config.elastic or ElasticConfig()
+            if self.config.autoplan and not base.predictive:
+                # Feed-forward elasticity is the auto-plan plane's
+                # fleet leg: project queue/occupancy growth from the
+                # telemetry slope and spawn BEFORE refusals advance
+                # (reactive pressure still wins whenever it fires
+                # first — control.fleet_elastic).
+                base = dataclasses.replace(base, predictive=True)
             elastic_cfg = dataclasses.replace(
                 base, min_replicas=lo, max_replicas=hi)
             self.desired = min(max(self.config.replicas, lo), hi)
@@ -438,6 +456,13 @@ class FleetFrontend:
         self._pump_errors = 0
         self.relay_spawns = 0     # applied spawn_broadcast_relay calls
         self.relay_retires = 0    # applied retire_broadcast_relay calls
+        # -- auto-plan plane (ISSUE 20): the front door applies a
+        # cached (or analytic) plan BEFORE any replica exists, so every
+        # replica — initial, respawn, standby, elastic spawn — inherits
+        # the planned operating point through the serve template.
+        self.applied_plan: Optional[dict] = None
+        if self.config.autoplan:
+            self._front_door_plan()
         for i in range(self.desired):
             rid = f"r{i}"
             self._replicas[rid] = self._make_replica(rid, i)
@@ -481,6 +506,67 @@ class FleetFrontend:
                 if device_ms:
                     self._profile_device_ms = max(device_ms)
 
+    def _front_door_plan(self) -> None:
+        """Apply a cache-or-analytic plan to the serve TEMPLATE (config
+        docstring: no live search at this tier, analytic guesses never
+        cached). Plans the first --precompile manifest signature; with
+        no manifest there is nothing to plan for and the hand-set
+        template stands."""
+        from dvf_tpu.control import plan_cache as _pc
+        from dvf_tpu.control import planner as _planner
+
+        entries = []
+        if self.config.precompile:
+            try:
+                from dvf_tpu.runtime.signature import parse_manifest
+
+                entries = parse_manifest(self.config.precompile)
+            except (ValueError, TypeError):
+                entries = []
+        if not entries:
+            return
+        key = entries[0]["key"]
+        signature = key.render()
+        geometry = tuple(key.geometry)
+        topo = _pc.topology_fingerprint()
+        scfg = self.config.serve
+        t0 = time.perf_counter()
+        plan = _planner.plan_from_cache(scfg.plan_cache_dir, signature,
+                                        geometry, topo)
+        cache = "hit"
+        if plan is None:
+            cache = "miss"
+            cal = _pc.load_calibrations(
+                scfg.plan_cache_dir, topo,
+                f"b{scfg.batch_size}|{signature}")
+            prof = None
+            if scfg.profile_dir:
+                from dvf_tpu.obs.lineage import load_stage_profile
+
+                prof = load_stage_profile(scfg.profile_dir, signature)
+            grid = _planner.candidate_grid(batch_cap=scfg.batch_size)
+            plan, _comp = _planner.plan_search(
+                grid, None, cal=cal, cal_batch=scfg.batch_size,
+                stage_profile=prof)
+        # Replicas inherit by template mutation: every replica built
+        # from here on compiles at the planned point. autoplan itself
+        # stays OFF on replicas (_make_replica/_local_factory strip
+        # it) — the front door planned; a replica re-searching under
+        # live tenants would fight the plan it was handed.
+        scfg.batch_size = plan.batch_size
+        scfg.tick_s = plan.tick_s
+        scfg.ingest_depth = plan.ingest_depth
+        scfg.ingest = plan.ingest
+        scfg.egress = plan.egress
+        self.applied_plan = plan.to_doc()
+        wall = (time.perf_counter() - t0) * 1e3
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.PLAN, cause=ledger_mod.CAUSE_AUTOPLAN,
+                signature=signature, cache=cache,
+                wall_ms=round(wall, 3), plan=plan.to_doc(),
+                topology=topo, legs=0, grid=plan.grid)
+
     def _next_rid(self) -> str:
         return f"r{next(self._rid_counter)}"
 
@@ -500,6 +586,10 @@ class FleetFrontend:
                 for f in dataclasses.fields(ServeConfig)
                 if f.name not in ("chaos", "replica_label")
             }
+            # The front door plans; a replica re-searching under live
+            # tenants would fight it. plan_cache_dir stays — replicas
+            # still seed their compile calibrations from it.
+            serve_fields["autoplan"] = False
             affinity = None
             if self.config.pin_replicas_to_cores:
                 import os as _os
@@ -557,7 +647,7 @@ class FleetFrontend:
                 chaos = FaultPlan.parse(config.chaos_spec,
                                         seed=config.chaos_seed + index)
             scfg = dataclasses.replace(config.serve, replica_label=rid,
-                                       chaos=chaos)
+                                       chaos=chaos, autoplan=False)
             engine = Engine(self.filter,
                             mesh=make_mesh(auto_mesh_config(len(chunk)),
                                            devices=chunk))
@@ -2275,6 +2365,10 @@ class FleetFrontend:
                 "reattach_grace_s": self.config.reattach_grace_s,
                 "replay_window": self.config.serve.replay_window,
             },
+            # Auto-plan plane: the plan the front door applied to the
+            # serve template (None = hand-set defaults).
+            **({"plan": self.applied_plan}
+               if self.applied_plan is not None else {}),
             "aggregate": merge_latency_snapshots(
                 {rid: (e or {}).get("latency")
                  for rid, e in exports.items()}),

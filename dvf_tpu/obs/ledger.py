@@ -80,6 +80,12 @@ PARTITION = "partition"              # continuity plane: a liveness timeout
 #   declared a link partitioned; carries the peer and the reconnect
 #   outcome. Budgeted like any fault, ledgered because a partition is a
 #   reconfiguration of the wire, not a per-frame error.
+PLAN = "plan"                        # auto-plan plane: a plan decision —
+#   cache hit, live search, or analytic fallback. Carries the chosen
+#   plan doc, its source, the measured search cost (wall_ms) and the
+#   candidate counts (legs live-profiled / grid size), so "the warm
+#   restart's plan step cost < 50 ms and ran no search" is auditable
+#   from the ledger alone.
 
 # Causes (why the reconfiguration happened) — data, not an enum; these
 # are the spellings the runtime emits.
@@ -93,6 +99,7 @@ CAUSE_AUTOSCALE = "autoscale"
 CAUSE_MANUAL = "manual"
 CAUSE_MORPH = "morph"        # live session filter-chain swap (morph_stream)
 CAUSE_ROLLOUT = "rollout"    # fleet rolling config/version rollout
+CAUSE_AUTOPLAN = "autoplan"  # auto-plan plane decision (search/cache hit)
 
 # The dedicated trace lane reconfiguration events land on (serve's
 # stage lanes are 0..4; lineage uses none; 6 keeps clear of all).

@@ -67,6 +67,7 @@ class Engine:
         out_uint8: bool = True,
         chaos=None,
         op_chain: Optional[str] = None,
+        calibration_seed: Optional[dict] = None,
     ):
         self.filter = filt
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -88,6 +89,16 @@ class Engine:
         self._state: Any = None
         self._sharding = None  # chosen per batch signature in compile()
         self._replicated = replicated(self.mesh)
+        self.calibration_seed = calibration_seed  # optional persisted
+        #   {h2d_block_ms, d2h_block_ms, step_block_ms} triple (plan
+        #   cache, keyed per backend+topology — control.plan_cache):
+        #   when present AND it carries real h2d+step numbers, compile()
+        #   adopts it and SKIPS the blocking re-measurement passes — a
+        #   warm restart pays trace+compile+warmup only. d2h may be
+        #   None in a valid seed (measured above the calibration cap).
+        self.calibration_seeded = False  # did the last compile() adopt
+        #   the seed (vs measure)? — what the ledger's compile events
+        #   record so warm-start behavior is auditable
         self.h2d_block_ms: Optional[float] = None  # calibrated blocking
         #   whole-batch device_put at the compiled signature (measured on
         #   compile()'s warmup put) — the un-overlapped transfer cost the
@@ -267,11 +278,28 @@ class Engine:
         zeros = np.zeros(batch_shape, dtype=dtype)
         warm = jax.device_put(zeros, self._sharding)
         jax.block_until_ready(warm)
-        del warm
-        t0 = time.perf_counter()
-        dummy = jax.device_put(zeros, self._sharding)
-        jax.block_until_ready(dummy)
-        self.h2d_block_ms = (time.perf_counter() - t0) * 1e3
+        # Persisted-calibration fast path (auto-plan plane): a seed with
+        # real h2d+step numbers — measured earlier on this same
+        # backend+topology and loaded from the plan cache — replaces
+        # every timed pass below. The warmup put and warmup step still
+        # run (they ARE the compile warm + output-signature discovery);
+        # what a warm restart skips is the blocking measurement choreo:
+        # the second put, the whole-batch D2H copy, and the extra
+        # donated step with its two state rebuilds.
+        seed = self.calibration_seed
+        seeded = (isinstance(seed, dict)
+                  and isinstance(seed.get("h2d_block_ms"), (int, float))
+                  and isinstance(seed.get("step_block_ms"), (int, float)))
+        self.calibration_seeded = seeded
+        if seeded:
+            self.h2d_block_ms = float(seed["h2d_block_ms"])
+            dummy = warm
+        else:
+            del warm
+            t0 = time.perf_counter()
+            dummy = jax.device_put(zeros, self._sharding)
+            jax.block_until_ready(dummy)
+            self.h2d_block_ms = (time.perf_counter() - t0) * 1e3
         out, _ = self._step(dummy, self._state)
         out.block_until_ready()
         # Output signature + sharding: what the egress fetcher lays its
@@ -288,7 +316,13 @@ class Engine:
         # the tunneled bench chip a 400 MB batch-64 warmup fetch would
         # cost ~20 s of compile budget for a signature the egress path
         # never streams (device-resident benches fetch checksums only).
-        if out.nbytes <= _D2H_CALIBRATION_CAP_BYTES:
+        if seeded:
+            # d2h may legitimately be None in a valid seed (the original
+            # measurement was above the calibration cap) — reproduce it.
+            d2h = seed.get("d2h_block_ms")
+            self.d2h_block_ms = (float(d2h)
+                                 if isinstance(d2h, (int, float)) else None)
+        elif out.nbytes <= _D2H_CALIBRATION_CAP_BYTES:
             dst = np.empty(out.shape, out.dtype)
             dst.fill(0)
             t0 = time.perf_counter()
@@ -305,7 +339,9 @@ class Engine:
         # a cheap bucket starve behind an expensive one). The step
         # donates its operands, so state is rebuilt once more. Skipped
         # above the calibration cap for the same reason D2H is.
-        if zeros.nbytes <= _D2H_CALIBRATION_CAP_BYTES:
+        if seeded:
+            self.step_block_ms = float(seed["step_block_ms"])
+        elif zeros.nbytes <= _D2H_CALIBRATION_CAP_BYTES:
             cal = jax.device_put(zeros, self._sharding)
             t0 = time.perf_counter()
             out2, _ = self._step(cal, self._state)

@@ -283,6 +283,25 @@ class ServeConfig:
     #   Perfetto lane, and flight-dump ledger.json. Default ON: events
     #   are reconfiguration-rate, not frame-rate (overhead gated ≤2%
     #   fps by benchmarks/LEDGER_BENCH.json). False = none of it.
+    autoplan: bool = False        # auto-plan plane (control.planner):
+    #   at startup, resolve an operating plan for the primary signature
+    #   — plan-cache hit (warm restart: < 50 ms, no search), else a
+    #   measured candidate search (analytic prune from the compile-time
+    #   calibrations + stage profiles, then short paced bursts through
+    #   THIS frontend for ≤ 1/3 of the grid), apply the winner (batch
+    #   size, tick, ingest/egress + depth) and hand the PR 10
+    #   controllers its envelope. Every decision ledgers as a PLAN
+    #   event with its measured search cost (--autoplan on the CLI).
+    autoplan_burst_frames: int = 48  # paced frames per live candidate
+    #   leg (short on purpose: the search runs before traffic is
+    #   admitted, and the analytic prune already did the ranking)
+    plan_cache_dir: Optional[str] = None  # on-disk plan + calibration
+    #   cache (control.plan_cache), sibling of the PR 9 compile cache:
+    #   winning plans keyed by (signature, geometry, topology
+    #   fingerprint, planner version); compile-time calibration triples
+    #   keyed per topology — warm restarts skip both the plan search
+    #   and the blocking calibration passes at engine compile. None
+    #   with autoplan on = plan is searched but never persisted.
 
 
 class _Bucket:
@@ -656,6 +675,13 @@ class ServeFrontend:
         #   reads shed deltas; the controller's own moves must not feed
         #   back as overload evidence)
         self.resize_compile_errors = 0
+        # -- auto-plan plane (dvf_tpu.control.planner) --------------------
+        self.applied_plan: Optional[dict] = None  # the Plan doc driving
+        #   this frontend (autoplan() or a fleet front door applied it);
+        #   None = the hand-set ServeConfig defaults
+        self._topology: Optional[str] = None  # cached topology
+        #   fingerprint (control.plan_cache) — the plan/calibration
+        #   cache's invalidation axis; computed once from the mesh
         control_sample_s = 0.0
         if self.config.control:
             from dvf_tpu.control import ControlConfig, ControlPlane
@@ -1471,6 +1497,7 @@ class ServeFrontend:
             self._check_admission_locked(tier=t)
             bucket, create_key = self._route_locked(chain, declared)
             if bucket is not None:
+                self._price_admission_locked(bucket, t, cfg.slo_ms)
                 sid_out = self._register_session_locked(
                     bucket, session_id, cfg, sink)
         if bucket is not None:
@@ -1584,6 +1611,43 @@ class ServeFrontend:
             raise AdmissionError(
                 f"session limit reached ({self.config.max_sessions} "
                 f"open); close a stream or raise max_sessions")
+
+    def _price_admission_locked(self, bucket: "_Bucket", tier: int,
+                                slo_ms: float) -> None:
+        """Feed-forward admission pricing (the auto-plan plane's third
+        leg, armed by ``config.autoplan``): BEFORE a tenant is
+        admitted, predict what its bucket's scheduling round will cost
+        with it aboard — from the persisted stage-cost profile
+        (obs.lineage) a previous run measured, else the live tick
+        EWMA — and refuse a non-interactive tenant whose predicted
+        steady-state latency already breaches its own SLO. The
+        reactive tier controller (control.controllers) refuses AFTER
+        queues build and refusals advance; this prices the marginal
+        tenant from the profile so the refusal lands before its first
+        frame is ever queued. Nothing measured yet → admit (the cold
+        path stays reactive, exactly as before this plane)."""
+        if not self.config.autoplan or tier <= 0:
+            return
+        from dvf_tpu.control.planner import predicted_tick_cost_ms
+        cost = predicted_tick_cost_ms(bucket.stage_profile,
+                                      batch_size=bucket.batch_size)
+        if cost is None:
+            cost = bucket._tick_cost_ms
+        if not cost:
+            return
+        occupants = len(bucket.sessions) + 1
+        rounds = -(-occupants // max(1, bucket.batch_size))  # ceil
+        predicted_ms = float(cost) * rounds
+        if predicted_ms > float(slo_ms):
+            self.admission_rejections += 1
+            raise AdmissionError(
+                f"admission priced out (feed-forward): predicted "
+                f"steady-state latency {predicted_ms:.1f} ms for "
+                f"tenant {occupants} of bucket {bucket.label()!r} "
+                f"(predicted tick {float(cost):.2f} ms x {rounds} "
+                f"scheduling rounds) exceeds its {float(slo_ms):g} ms "
+                f"SLO; warm signatures this frontend serves cheaply: "
+                f"{self._warm_signatures()}")
 
     def _route_locked(
         self, chain: Optional[str], declared: Optional[tuple],
@@ -1743,10 +1807,32 @@ class ServeFrontend:
                         f"chains only")
                 with self._lock:
                     self._filters_by_chain.setdefault(key.op_chain, filt)
+            seed = None
+            cal_sig = f"b{self.config.batch_size}|{key.render()}"
+            if self.config.plan_cache_dir:
+                # Warm-restart calibration seed (control.plan_cache): a
+                # previous run on this exact (topology, batch signature)
+                # already measured the H2D/D2H/step block costs — the
+                # compile adopts them and skips its blocking measurement
+                # passes (engine.calibration_seeded records the
+                # adoption, and the ledgered compile's wall shows it).
+                from dvf_tpu.control import plan_cache as _pc
+                seed = _pc.load_calibrations(
+                    self.config.plan_cache_dir,
+                    self._topology_fingerprint(), cal_sig)
             eng = Engine(filt, mesh=self.engine.mesh,
-                         chaos=self.config.chaos, op_chain=key.op_chain)
+                         chaos=self.config.chaos, op_chain=key.op_chain,
+                         calibration_seed=seed)
             eng.compile((self.config.batch_size, *key.geometry),
                         key.np_dtype)
+            if self.config.plan_cache_dir and not eng.calibration_seeded:
+                from dvf_tpu.control import plan_cache as _pc
+                _pc.save_calibrations(
+                    self.config.plan_cache_dir,
+                    self._topology_fingerprint(), cal_sig,
+                    {"h2d_block_ms": eng.h2d_block_ms,
+                     "d2h_block_ms": eng.d2h_block_ms,
+                     "step_block_ms": eng.step_block_ms})
             return eng
 
         try:
@@ -1789,6 +1875,324 @@ class ServeFrontend:
             self.pool.release(key)  # stays warm, un-leased
             warmed.append(key.render())
         return warmed
+
+    # -- auto-plan plane (dvf_tpu.control.planner / plan_cache) ----------
+
+    def _topology_fingerprint(self) -> str:
+        """Cached: what hardware this frontend drives, laid out how —
+        the plan/calibration cache's invalidation axis."""
+        if self._topology is None:
+            from dvf_tpu.control.plan_cache import topology_fingerprint
+            self._topology = topology_fingerprint(self.engine.mesh)
+        return self._topology
+
+    def _cal_signature(self, bucket: "_Bucket") -> Optional[str]:
+        """The calibration-cache key for a bucket's compile: the batch
+        size is part of the measured shape, so it is part of the key."""
+        try:
+            key = bucket.key or bucket.engine.signature_key
+            if key is None:
+                key = make_key(bucket.op_chain, bucket.frame_shape,
+                               bucket.frame_dtype)
+            return f"b{bucket.batch_size}|{key.render()}"
+        except Exception:  # noqa: BLE001 — an unparseable display-name
+            return None    #   chain just skips the calibration cache
+
+    def _seed_calibrations(self, bucket: "_Bucket") -> None:
+        """Before a bucket engine's FIRST compile: adopt the persisted
+        (topology, batch signature) calibration triple from the plan
+        cache so ``Engine.compile`` skips its blocking measurement
+        passes on a warm restart. No cache dir, already compiled, or
+        any cache miss → no-op (the cold path re-measures; always
+        correct)."""
+        eng = bucket.engine
+        if (not self.config.plan_cache_dir
+                or eng.calibration_seed is not None
+                or eng.stats.compile_count > 0
+                or bucket.frame_shape is None):
+            return
+        sig = self._cal_signature(bucket)
+        if sig is None:
+            return
+        from dvf_tpu.control import plan_cache as _pc
+        eng.calibration_seed = _pc.load_calibrations(
+            self.config.plan_cache_dir, self._topology_fingerprint(), sig)
+
+    def _save_calibrations(self, bucket: "_Bucket", before: int) -> None:
+        """After a compile that actually MEASURED (ran here, was not
+        seeded): persist the calibration triple so the next restart on
+        this topology skips the measurement passes."""
+        eng = bucket.engine
+        if (not self.config.plan_cache_dir
+                or eng.stats.compile_count == before
+                or eng.calibration_seeded):
+            return
+        sig = self._cal_signature(bucket)
+        if sig is None:
+            return
+        from dvf_tpu.control import plan_cache as _pc
+        _pc.save_calibrations(
+            self.config.plan_cache_dir, self._topology_fingerprint(), sig,
+            {"h2d_block_ms": eng.h2d_block_ms,
+             "d2h_block_ms": eng.d2h_block_ms,
+             "step_block_ms": eng.step_block_ms})
+
+    def autoplan(self, frame_shape, frame_dtype="uint8",
+                 op_chain: Optional[str] = None,
+                 log: Optional[Any] = None) -> Optional[dict]:
+        """Plan this frontend's operating point for one signature —
+        the auto-plan plane's entry point (``--autoplan`` on the CLI).
+        Call AFTER :meth:`start` (the measured search pushes paced
+        bursts through the live dispatch path).
+
+        Warm restart: the cached winner for (canonical signature,
+        geometry, topology fingerprint, planner version) applies in
+        O(one JSON read) — no search, no traffic; the ledgered ``plan``
+        event's ``wall_ms`` is the auditable "plan step under 50 ms"
+        bound. Cold: the candidate grid is scored analytically from the
+        compile-time calibration triple, the best ≤ 1/3 is
+        live-profiled through a real measurement session (each
+        candidate applied via the SAME actuators the controllers use —
+        batch hot swap, tick write, depth-aware assembler rebuild), and
+        the measured winner is applied, cached, and ledgered with its
+        search cost. Returns the applied plan doc."""
+        from dvf_tpu.control import planner as planner_mod
+
+        t0 = time.perf_counter()
+        say = log if log is not None else (lambda _m: None)
+        chain = (self._buckets[0].op_chain if op_chain is None
+                 else canonical_op_chain_or_verbatim(op_chain))
+        key = make_key(chain, frame_shape, frame_dtype)
+        signature = key.render()
+        shape = tuple(key.geometry)
+        topo = self._topology_fingerprint()
+        cache_dir = self.config.plan_cache_dir
+        plan = planner_mod.plan_from_cache(cache_dir, signature, shape,
+                                           topo)
+        if plan is not None:
+            self._apply_plan(plan, reason="plan cache hit")
+            wall = (time.perf_counter() - t0) * 1e3
+            if self.ledger is not None:
+                self.ledger.record(
+                    ledger_mod.PLAN, cause=ledger_mod.CAUSE_AUTOPLAN,
+                    signature=signature, cache="hit",
+                    wall_ms=round(wall, 3), plan=plan.to_doc(),
+                    topology=topo, legs=0, grid=0)
+            say(f"autoplan: cache hit {plan.label()} ({wall:.1f} ms)")
+            return plan.to_doc()
+        base = planner_mod.Plan(
+            batch_size=self.config.batch_size, tick_s=self.config.tick_s,
+            ingest_depth=self.config.ingest_depth)
+        # Quiesce the reactive loops for the search: the batch
+        # controller would size the measurement bucket to its
+        # occupancy of one, undoing every candidate's hot swap
+        # mid-burst. Resumed after the winner's envelope is applied.
+        if self.control_plane is not None:
+            self.control_plane.paused = True
+        try:
+            sid = self.open_stream(op_chain=chain, frame_shape=shape,
+                                   frame_dtype=key.dtype, tier=0,
+                                   slo_ms=120000.0)
+            frame = np.zeros(shape, dtype=key.np_dtype)
+            try:
+                # Warmup burst at the hand-set defaults: compiles the
+                # program on the real serving path and measures (or
+                # adopts from the calibration cache) the triple the
+                # analytic pruner seeds from.
+                warm = self._measure_plan_candidate(sid, frame, base)
+                if "error" in warm:
+                    raise ServeError(f"autoplan warmup failed: "
+                                     f"{warm['error']}")
+                with self._lock:
+                    bucket = self._sessions[sid].bucket
+                eng = bucket.engine
+                cal = {"h2d_block_ms": eng.h2d_block_ms,
+                       "d2h_block_ms": eng.d2h_block_ms,
+                       "step_block_ms": eng.step_block_ms}
+                # The hand-set batch is a starting guess, not a bound:
+                # the grid probes up to 2x above it (whether a bigger
+                # batch pays is exactly what measuring decides — the
+                # analytic-only fleet path stays capped at the hand-set
+                # batch because nothing measured says otherwise). The
+                # winner becomes the envelope's ladder top.
+                grid = planner_mod.candidate_grid(
+                    batch_cap=2 * base.batch_size)
+                def measure(p):
+                    # Best-of-2: the first burst after a hot swap pays
+                    # cold staging (fresh program, empty assembler
+                    # ring) — the second burst is the steady state the
+                    # plan will actually run at. Same repeat discipline
+                    # as the bench table's A/B legs.
+                    a = self._measure_plan_candidate(sid, frame, p)
+                    if "error" in a:
+                        return a
+                    b = self._measure_plan_candidate(sid, frame, p)
+                    return a if "error" in b or a["fps"] >= b["fps"] \
+                        else b
+
+                plan, comp = planner_mod.plan_search(
+                    grid, measure,
+                    cal=cal, cal_batch=base.batch_size,
+                    stage_profile=bucket.stage_profile, log=log)
+            except BaseException:
+                # A failed search must not leave a half-applied
+                # candidate driving the frontend: restore the hand-set
+                # point.
+                self.config.ingest_depth = base.ingest_depth
+                self.set_tick_interval(base.tick_s)
+                with self._lock:
+                    s = self._sessions.get(sid)
+                    b = s.bucket if s is not None else None
+                if b is not None and b.batch_size != base.batch_size:
+                    self.request_batch_size(b.label(), base.batch_size,
+                                            reason="autoplan aborted")
+                raise
+            finally:
+                self.close(sid, drain=False)
+            self._apply_plan(plan, reason="measured plan search")
+        finally:
+            if self.control_plane is not None:
+                self.control_plane.paused = False
+        planner_mod.plan_to_cache(cache_dir, signature, shape, topo, plan)
+        wall = (time.perf_counter() - t0) * 1e3
+        if self.ledger is not None:
+            self.ledger.record(
+                ledger_mod.PLAN, cause=ledger_mod.CAUSE_AUTOPLAN,
+                signature=signature, cache="miss",
+                wall_ms=round(wall, 3), plan=plan.to_doc(),
+                topology=topo, legs=plan.searched, grid=plan.grid,
+                reason=f"winner {comp.get('winner')}")
+        say(f"autoplan: live-profiled {plan.searched}/{plan.grid} -> "
+            f"{plan.label()} ({wall:.0f} ms)")
+        return plan.to_doc()
+
+    def apply_plan_doc(self, doc: dict,
+                       reason: Optional[str] = None) -> bool:
+        """Apply an externally-chosen plan doc (the fleet front door
+        plans once and pushes the winner to replicas). Returns False on
+        an implausible doc — never raises over an optimization."""
+        from dvf_tpu.control.planner import Plan
+
+        plan = Plan.from_doc(doc)
+        if plan is None:
+            return False
+        self._apply_plan(plan, reason=reason or "fleet plan")
+        return True
+
+    def _apply_plan(self, plan, reason: Optional[str] = None) -> None:
+        """Make ``plan`` this frontend's operating point: the config
+        fields (future buckets compile at the planned batch/depth), the
+        live dispatch tick, every live bucket's batch size (hot swap
+        when pinned, direct when nothing has flowed yet), and the
+        control plane's operating envelope — the PR 10 reactive loops
+        then adapt WITHIN the planned envelope (ladder bounded at the
+        planned batch, planned tick as the busy tick) instead of
+        rediscovering it from hard-coded defaults."""
+        with self._lock:
+            self.config.batch_size = plan.batch_size
+            self.config.ingest_depth = plan.ingest_depth
+            self.config.tick_s = plan.tick_s
+            self.config.ingest = plan.ingest
+            self.config.egress = plan.egress
+            buckets = list(self._buckets)
+        for b in buckets:
+            with self._lock:
+                unpinned = b.frame_shape is None
+                if unpinned:
+                    b.batch_size = plan.batch_size
+                    b.ingest_mode = plan.ingest
+                    b.egress_mode = plan.egress
+            if not unpinned and b.batch_size != plan.batch_size:
+                self.request_batch_size(b.label(), plan.batch_size,
+                                        reason=reason or "autoplan")
+        self.set_tick_interval(plan.tick_s)
+        if self.control_plane is not None:
+            self.control_plane.apply_envelope(plan.envelope(),
+                                              reason=reason)
+        self.applied_plan = plan.to_doc()
+
+    def _measure_plan_candidate(self, sid: str, frame: np.ndarray,
+                                plan) -> dict:
+        """One candidate's live leg: apply its knobs through the REAL
+        actuators (batch hot swap via :meth:`request_batch_size` — the
+        same compile-aside path the controllers use — the tick write,
+        and the ingest-depth config the next assembler rebuild picks
+        up), then push a paced burst of ``autoplan_burst_frames``
+        frames through the measurement session and report sustained
+        fps. The row shape matches the bench table's A/B legs
+        (``fps`` or ``error``), so `benchtools.ab_comparison` ranks
+        the search — one shared paced-measurement path."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            bucket = s.bucket if s is not None else None
+        if bucket is None:
+            return {"error": f"measurement session {sid!r} gone"}
+        self.config.ingest_depth = plan.ingest_depth
+        self.set_tick_interval(plan.tick_s)
+        if bucket.batch_size != plan.batch_size:
+            with self._lock:
+                if bucket.frame_shape is None:
+                    bucket.batch_size = plan.batch_size
+            if bucket.batch_size != plan.batch_size:
+                self.request_batch_size(
+                    bucket.label(), plan.batch_size,
+                    reason=f"autoplan candidate {plan.label()}")
+                deadline = time.time() + 30.0
+                while bucket.batch_size != plan.batch_size:
+                    if time.time() > deadline:
+                        return {"error": f"hot swap to batch "
+                                         f"{plan.batch_size} timed out"}
+                    time.sleep(0.002)
+        # Quiet the pipe first: a previous candidate's over-submitted
+        # frames may still be IN FLIGHT (not just queued for poll), and
+        # arriving mid-burst they would inflate this candidate's fps.
+        # Wait until nothing has arrived for 50 ms before measuring.
+        quiet_deadline = time.perf_counter() + 5.0
+        last_arrival = time.perf_counter()
+        while time.perf_counter() - last_arrival < 0.05:
+            if self.poll(sid):
+                last_arrival = time.perf_counter()
+            if time.perf_counter() > quiet_deadline:
+                break
+            time.sleep(0.002)
+        n = max(4, int(self.config.autoplan_burst_frames))
+        # Paced: keep ~2 batches of standing work so batching engages,
+        # but never more than the per-session ingress bound — a frame
+        # dropped at ingress never delivers, which would read as a
+        # stalled (infinitely slow) candidate instead of a paced one.
+        backlog = max(2, min(2 * plan.batch_size, self.config.queue_size))
+        delivered = in_flight = 0
+        t0 = time.perf_counter()
+        deadline = t0 + 60.0
+        last_progress = t0
+        while delivered < n:
+            while in_flight < backlog:
+                self.submit(sid, frame)
+                in_flight += 1
+            got = self.poll(sid)
+            delivered += len(got)
+            in_flight -= len(got)
+            if got:
+                last_progress = time.perf_counter()
+                continue
+            now = time.perf_counter()
+            if now > deadline:
+                return {"error": f"burst stalled at "
+                                 f"{delivered}/{n} delivered"}
+            if now - last_progress > 2.0:
+                # A shed frame (drop-oldest racing a mid-burst resize
+                # swap) never delivers; after 2 s of silence assume
+                # the standing work evaporated and re-prime rather
+                # than waiting out the deadline on ghosts. Throughput
+                # stays honest — the clock keeps running and fps is
+                # delivered-work over total wall.
+                in_flight = 0
+                last_progress = now
+            time.sleep(0.001)
+        wall = time.perf_counter() - t0
+        return {"fps": round(n / wall, 2), "frames": n,
+                "wall_s": round(wall, 4), "batch": plan.batch_size,
+                "tick_s": plan.tick_s, "depth": plan.ingest_depth}
 
     # -- control-plane actuator surface (dvf_tpu.control) ----------------
     # The ControlPlane's apply thread calls these; the decisions behind
@@ -2514,9 +2918,17 @@ class ServeFrontend:
         (control-plane-resizable) batch size."""
         shape = (bucket.batch_size, *bucket.frame_shape)
         dtype = np.dtype(bucket.frame_dtype)
-        if bucket.assembler is None or bucket.assembler.batch_shape != shape:
+        if (bucket.assembler is None
+                or bucket.assembler.batch_shape != shape
+                or bucket.assembler.depth != self.config.ingest_depth):
+            # The depth check is the auto-plan seam: a planned (or
+            # candidate) ingest depth lands in config and the next
+            # rebuild picks it up — exactly how a batch resize already
+            # re-derives the slab layout.
             before = bucket.engine.stats.compile_count
+            self._seed_calibrations(bucket)
             bucket.engine.ensure_compiled(shape, dtype)
+            self._save_calibrations(bucket, before)
             # A compile that actually ran here is the legacy lazy pin
             # (default bucket, first traffic) — ledger it as an
             # admission-cause compile ON THE DISPATCH THREAD, which is
@@ -3259,6 +3671,10 @@ class ServeFrontend:
             "open_buckets": len(buckets),
             "buckets": {b.label(): b.stats_row() for b in buckets},
             "pool": self.pool.stats(),
+            # Auto-plan plane: the Plan doc driving this frontend (None
+            # = hand-set defaults) — provenance says cache/measured.
+            **({"plan": self.applied_plan}
+               if self.applied_plan is not None else {}),
             **self.router.stats(),
             "aggregate": LatencyStats.merged(
                 [s.latency for s in every.values()]),

@@ -6,9 +6,14 @@
 # relay-hop audit, serve publish tee) PLUS the continuity soak smoke
 # (benchmarks/continuity_bench.py --smoke: seeded chaos with
 # byte-identical reassembly + front-door kill -9 recovery, ~10 s)
+# PLUS the auto-plan gate (benchmarks/plan_bench.py --check: the
+# committed PLAN_BENCH.json must still clear every acceptance gate —
+# planned>=1.15x default, chosen within 5% of exhaustive best at <=1/3
+# live-profiled, warm plan step <50 ms, deterministic predictive
+# replay spawning before the first refusal)
 # PLUS the perf-regression sentinel (benchmarks/sentinel.py --quick).
 # Exit nonzero on a test failure, an audit/broadcast/continuity miss,
-# OR a measured perf regression —
+# a stale plan artifact, OR a measured perf regression —
 # the same bar the GitHub Actions workflow (.github/workflows/ci.yml)
 # enforces on every push.
 set -uo pipefail
@@ -49,6 +54,14 @@ crc=$?
 if [ "$crc" -ne 0 ]; then
     echo "ci_tier1: CONTINUITY MISS (continuity_bench rc=$crc)" >&2
     exit "$crc"
+fi
+
+echo "== auto-plan gate (committed PLAN_BENCH.json acceptance) =="
+JAX_PLATFORMS=cpu python benchmarks/plan_bench.py --check
+prc=$?
+if [ "$prc" -ne 0 ]; then
+    echo "ci_tier1: PLAN GATE MISS (plan_bench --check rc=$prc)" >&2
+    exit "$prc"
 fi
 
 echo "== perf-regression sentinel =="
